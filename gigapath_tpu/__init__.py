@@ -1,0 +1,17 @@
+"""gigapath_tpu — a TPU-native (JAX/XLA/Pallas/pjit) whole-slide-image
+foundation-model framework with the capabilities of Prov-GigaPath.
+
+The framework is a ground-up redesign for TPU of the two-stage WSI pipeline in
+the reference repo (qimingfan10/Prov-gigapath-replication):
+
+- a ViT-G/14 *tile encoder* over 256x256 pathology tiles (``models/vit.py``),
+- a LongNet (dilated-attention) *slide encoder* over up to ~10^6 tile
+  embeddings + 2-D coordinates (``models/slide_encoder.py``),
+- preprocessing (slide -> tiles), fine-tuning, linear-probe, and pretraining
+  harnesses around them.
+
+Everything under ``jit`` is static-shape, bf16-friendly, and sharded over a
+single ``jax.sharding.Mesh`` with named axes (data, seq, expert, model).
+"""
+
+__version__ = "0.1.0"

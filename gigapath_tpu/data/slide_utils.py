@@ -1,0 +1,72 @@
+"""Slide pyramid-level resolution helpers (host-side).
+
+Capability parity with reference ``gigapath/preprocessing/data/slide_utils.py``
+(``find_level_for_target_mpp:3``): read the slide's microns-per-pixel from
+TIFF resolution tags and find the pyramid level closest to a target MPP.
+
+OpenSlide is an optional dependency (a C library); all entry points accept
+either an open slide handle or a path, and degrade with a clear error if
+OpenSlide is unavailable.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+try:  # pragma: no cover - optional C library
+    import openslide  # type: ignore
+
+    HAS_OPENSLIDE = True
+except ImportError:  # pragma: no cover
+    openslide = None
+    HAS_OPENSLIDE = False
+
+
+def _open(slide_path):
+    if openslide is None:
+        raise ImportError(
+            "openslide-python is required for WSI I/O; install it or pass a "
+            "slide object with `.properties` and `.level_downsamples`."
+        )
+    return openslide.OpenSlide(str(slide_path))
+
+
+def get_slide_mpp(slide) -> Optional[float]:
+    """Base-level microns-per-pixel from resolution tags, if present.
+
+    Accepts any object with an openslide-style ``properties`` mapping. Checks
+    ``openslide.mpp-x`` first, then falls back to the TIFF X-resolution tag
+    (pixels per cm -> um/px), as the reference does.
+    """
+    props = slide.properties
+    mpp = props.get("openslide.mpp-x")
+    if mpp is not None:
+        return float(mpp)
+    x_res = props.get("tiff.XResolution")
+    unit = props.get("tiff.ResolutionUnit")
+    if x_res is not None and unit == "centimeter":
+        return 10000.0 / float(x_res)
+    return None
+
+
+def find_level_for_target_mpp(slide_path, target_mpp: float, tolerance: float = 0.1) -> Optional[int]:
+    """Find the pyramid level whose MPP is within ``tolerance`` of the target.
+
+    Returns the level index, or ``None`` if no level matches.
+    """
+    slide = _open(slide_path) if isinstance(slide_path, (str, bytes)) or hasattr(slide_path, "__fspath__") else slide_path
+
+    base_mpp = get_slide_mpp(slide)
+    if base_mpp is None:
+        logging.warning("No resolution metadata found in %s", slide_path)
+        return None
+
+    for level, downsample in enumerate(slide.level_downsamples):
+        level_mpp = base_mpp * downsample
+        if abs(level_mpp - target_mpp) < tolerance:
+            logging.info("Level %d matches target MPP %.3f (level MPP %.3f)", level, target_mpp, level_mpp)
+            return level
+
+    logging.warning("No level with MPP within %.2f of %.2f found", tolerance, target_mpp)
+    return None

@@ -1,0 +1,112 @@
+"""Array tiling math for WSI preprocessing (host-side numpy).
+
+Capability parity with reference ``gigapath/preprocessing/data/tiling.py``:
+symmetric padding to a tile multiple, reshape/transpose into a batch of square
+tiles with XY coordinates, and the inverse assembly. This runs on the host CPU
+feeding the TPU input pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import numpy as np
+
+
+def get_1d_padding(length: int, tile_size: int) -> Tuple[int, int]:
+    """(before, after) padding making ``length`` divisible by ``tile_size``."""
+    total = -length % tile_size
+    return total // 2, total - total // 2
+
+
+def pad_for_tiling_2d(
+    array: np.ndarray,
+    tile_size: int,
+    channels_first: bool = True,
+    **pad_kwargs: Any,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Symmetrically pad so both spatial dims divide ``tile_size``.
+
+    Returns the padded array and the XY offset the padding introduced
+    (add it to original-frame coordinates to index the padded array).
+    """
+    if channels_first:
+        h, w = array.shape[1], array.shape[2]
+    else:
+        h, w = array.shape[0], array.shape[1]
+    ph = get_1d_padding(h, tile_size)
+    pw = get_1d_padding(w, tile_size)
+    pads = [ph, pw]
+    pads.insert(0 if channels_first else 2, (0, 0))
+    padded = np.pad(array, pads, **pad_kwargs)
+    return padded, np.array([pw[0], ph[0]])
+
+
+def tile_array_2d(
+    array: np.ndarray,
+    tile_size: int,
+    channels_first: bool = True,
+    **pad_kwargs: Any,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Cut an image into non-overlapping square tiles.
+
+    Returns ``(tiles, coords)`` where tiles are NCHW (or NHWC if
+    ``channels_first=False``) and coords are the XY top-left corner of each
+    tile in the *original* (pre-padding) frame, so edge tiles can have
+    negative coordinates.
+    """
+    padded, (ox, oy) = pad_for_tiling_2d(array, tile_size, channels_first, **pad_kwargs)
+    if channels_first:
+        c, h, w = padded.shape
+    else:
+        h, w, c = padded.shape
+    nh, nw = h // tile_size, w // tile_size
+
+    if channels_first:
+        tiles = padded.reshape(c, nh, tile_size, nw, tile_size)
+        tiles = tiles.transpose(1, 3, 0, 2, 4).reshape(nh * nw, c, tile_size, tile_size)
+    else:
+        tiles = padded.reshape(nh, tile_size, nw, tile_size, c)
+        tiles = tiles.transpose(0, 2, 1, 3, 4).reshape(nh * nw, tile_size, tile_size, c)
+
+    ys = tile_size * np.arange(nh) - oy
+    xs = tile_size * np.arange(nw) - ox
+    coords = np.stack(np.meshgrid(xs, ys), axis=-1).reshape(-1, 2)
+    return tiles, coords
+
+
+def assemble_tiles_2d(
+    tiles: np.ndarray,
+    coords: np.ndarray,
+    fill_value: float = np.nan,
+    channels_first: bool = True,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Inverse of :func:`tile_array_2d`: paste tiles back at their XY coords.
+
+    Returns the smallest array containing all tiles plus the XY offset that
+    was added to tile coordinates to index into it.
+    """
+    if coords.shape[0] != tiles.shape[0]:
+        raise ValueError(
+            f"Tile coordinates and values must have the same length, "
+            f"got {coords.shape[0]} and {tiles.shape[0]}"
+        )
+    if channels_first:
+        _, c, tile_size, _ = tiles.shape
+    else:
+        _, tile_size, _, c = tiles.shape
+
+    xs, ys = coords[:, 0], coords[:, 1]
+    x_min, y_min = xs.min(), ys.min()
+    width = xs.max() + tile_size - x_min
+    height = ys.max() + tile_size - y_min
+    shape = (c, height, width) if channels_first else (height, width, c)
+    out = np.full(shape, fill_value, dtype=np.result_type(tiles.dtype, type(fill_value)))
+
+    offset = np.array([-x_min, -y_min])
+    for tile, x, y in zip(tiles, xs + offset[0], ys + offset[1]):
+        if channels_first:
+            out[:, y : y + tile_size, x : x + tile_size] = tile
+        else:
+            out[y : y + tile_size, x : x + tile_size, :] = tile
+    return out, offset

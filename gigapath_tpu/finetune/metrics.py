@@ -1,0 +1,115 @@
+"""Classification metrics for the fine-tuning harnesses (host-side sklearn).
+
+Capability parity with reference ``finetune/metrics.py``: auroc / auprc /
+balanced accuracy / accuracy / quadratic-weighted kappa, with micro / macro /
+per-class averaging, dispatched by task config (multi_label vs
+multi_class/binary). Metric values are plain Python floats computed on host
+numpy arrays — there is no reason to put sklearn metrics on the TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+from sklearn.metrics import (
+    accuracy_score,
+    average_precision_score,
+    balanced_accuracy_score,
+    cohen_kappa_score,
+    roc_auc_score,
+)
+
+# Metrics computed on hard argmax predictions rather than probabilities.
+_ARGMAX_METRICS = ("bacc", "acc", "qwk")
+
+
+class MakeMetrics:
+    """A single named metric with an averaging strategy.
+
+    ``metric`` is one of auroc / auprc / bacc / acc / qwk; ``average`` is
+    'micro', 'macro', or ``None`` for per-class scores (keyed by label name
+    from ``label_dict``).
+    """
+
+    def __init__(self, metric: str = "auroc", average: Optional[str] = "micro",
+                 label_dict: Optional[dict] = None):
+        self.metric = metric
+        self.average = average
+        self.label_dict = label_dict
+
+    def get_metric(self, labels: np.ndarray, probs: np.ndarray):
+        if self.metric == "auroc":
+            return roc_auc_score(labels, probs, average=self.average)
+        if self.metric == "auprc":
+            return average_precision_score(labels, probs, average=self.average)
+        if self.metric == "bacc":
+            return balanced_accuracy_score(labels, probs)
+        if self.metric == "acc":
+            return accuracy_score(labels, probs)
+        if self.metric == "qwk":
+            return cohen_kappa_score(labels, probs, weights="quadratic")
+        raise ValueError(f"Invalid metric: {self.metric}")
+
+    def process_preds(self, labels: np.ndarray, probs: np.ndarray):
+        if self.metric in _ARGMAX_METRICS:
+            return np.argmax(labels, axis=1), np.argmax(probs, axis=1)
+        return labels, probs
+
+    @property
+    def get_metric_name(self):
+        if self.metric in ("auroc", "auprc"):
+            if self.average is not None:
+                return f"{self.average}_{self.metric}"
+            keys = sorted(self.label_dict.keys(), key=lambda k: self.label_dict[k])
+            return [f"{key}_{self.metric}" for key in keys]
+        return self.metric
+
+    def __call__(self, labels: np.ndarray, probs: np.ndarray) -> Dict[str, float]:
+        labels, probs = self.process_preds(labels, probs)
+        name = self.get_metric_name
+        score = self.get_metric(labels, probs)
+        if isinstance(name, list):
+            return dict(zip(name, score))
+        return {name: score}
+
+
+def calculate_multilabel_metrics(
+    probs: np.ndarray, labels: np.ndarray, label_dict, add_metrics: Optional[List[str]] = None
+) -> Dict[str, float]:
+    metrics = ["auroc", "auprc"] + (add_metrics or [])
+    results: Dict[str, float] = {}
+    for average in ["micro", "macro", None]:
+        for metric in metrics:
+            results.update(MakeMetrics(metric, average, label_dict)(labels, probs))
+    return results
+
+
+def calculate_multiclass_or_binary_metrics(
+    probs: np.ndarray, labels: np.ndarray, label_dict, add_metrics: Optional[List[str]] = None
+) -> Dict[str, float]:
+    metrics = ["bacc", "acc", "auroc", "auprc"] + (add_metrics or [])
+    results: Dict[str, float] = {}
+    # argmax metrics ignore `average`; compute them once instead of per-average
+    # (the reference recomputes them under the same key, finetune/metrics.py:86-89)
+    for metric in metrics:
+        if metric in _ARGMAX_METRICS:
+            results.update(MakeMetrics(metric, None, label_dict)(labels, probs))
+    for average in ["macro", None]:
+        for metric in metrics:
+            if metric not in _ARGMAX_METRICS:
+                results.update(MakeMetrics(metric, average, label_dict)(labels, probs))
+    return results
+
+
+def calculate_metrics_with_task_cfg(
+    probs: np.ndarray, labels: np.ndarray, task_cfg: dict
+) -> Dict[str, float]:
+    """Dispatch on the task config's ``setting`` (multi_label vs multi_class)."""
+    if task_cfg.get("setting", "multi_class") == "multi_label":
+        return calculate_multilabel_metrics(
+            probs, labels, task_cfg["label_dict"], task_cfg.get("add_metrics")
+        )
+    return calculate_multiclass_or_binary_metrics(
+        probs, labels, task_cfg["label_dict"], task_cfg.get("add_metrics")
+    )

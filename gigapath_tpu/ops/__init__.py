@@ -1,0 +1,1 @@
+from gigapath_tpu.ops import pos_embed  # noqa: F401

@@ -1,0 +1,45 @@
+import numpy as np
+import pytest
+
+from gigapath_tpu.data.box_utils import Box, get_bounding_box
+
+
+def test_box_validation():
+    with pytest.raises(ValueError):
+        Box(0, 0, 0, 5)
+    with pytest.raises(ValueError):
+        Box(0, 0, 5, -1)
+
+
+def test_box_algebra():
+    b = Box(2, 3, 4, 5)
+    assert b + (1, -1) == Box(3, 2, 4, 5)
+    assert b * 2 == Box(4, 6, 8, 10)
+    assert 2 * b == Box(4, 6, 8, 10)
+    assert b / 2 == Box(1, 1, 2, 2)
+    assert b.add_margin(1) == Box(1, 2, 6, 7)
+
+
+def test_box_clip():
+    a = Box(0, 0, 10, 10)
+    b = Box(5, 5, 10, 10)
+    assert a.clip(b) == Box(5, 5, 5, 5)
+    assert a.clip(Box(20, 20, 5, 5)) is None
+
+
+def test_box_slices_roundtrip():
+    b = Box(2, 3, 4, 5)
+    assert Box.from_slices(b.to_slices()) == b
+    arr = np.zeros((10, 10))
+    arr[b.to_slices()] = 1
+    assert arr.sum() == b.w * b.h
+
+
+def test_get_bounding_box():
+    mask = np.zeros((10, 12))
+    mask[3:7, 2:9] = 1
+    assert get_bounding_box(mask) == Box(x=2, y=3, w=7, h=4)
+    with pytest.raises(RuntimeError):
+        get_bounding_box(np.zeros((4, 4)))
+    with pytest.raises(TypeError):
+        get_bounding_box(np.zeros((4, 4, 4)))

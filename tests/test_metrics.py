@@ -1,0 +1,52 @@
+"""Mirrors the reference's runnable self-check (finetune/metrics.py:103-128)."""
+
+import numpy as np
+
+from gigapath_tpu.finetune.metrics import (
+    calculate_metrics_with_task_cfg,
+    calculate_multiclass_or_binary_metrics,
+    calculate_multilabel_metrics,
+)
+
+PROBS = np.array(
+    [
+        [0.7, 0.2, 0.1],
+        [0.4, 0.3, 0.3],
+        [0.1, 0.8, 0.1],
+        [0.2, 0.3, 0.5],
+        [0.4, 0.4, 0.2],
+        [0.1, 0.2, 0.7],
+    ]
+)
+LABELS = np.eye(3)[[0, 0, 1, 1, 2, 2]]
+LABEL_DICT = {"A": 0, "B": 1, "C": 2}
+
+
+def test_multiclass_metrics_keys_and_ranges():
+    res = calculate_multiclass_or_binary_metrics(PROBS, LABELS, LABEL_DICT)
+    assert "macro_auroc" in res and "macro_auprc" in res
+    assert {"A_auroc", "B_auroc", "C_auroc"} <= set(res)
+    assert res["acc"] == 4 / 6
+    for v in res.values():
+        assert 0.0 <= v <= 1.0
+
+
+def test_multilabel_metrics():
+    res = calculate_multilabel_metrics(PROBS, LABELS, LABEL_DICT)
+    assert "micro_auroc" in res and "macro_auroc" in res
+    assert "A_auprc" in res
+
+
+def test_task_cfg_dispatch_with_qwk():
+    probs = np.eye(6)[[0, 5, 2, 3, 2, 2, 1, 1, 4]]
+    labels = np.eye(6)[[0, 2, 1, 1, 4, 5, 2, 3, 2]]
+    cfg = {
+        "setting": "multi_class",
+        "label_dict": {str(i): i for i in range(6)},
+        "add_metrics": ["qwk"],
+    }
+    res = calculate_metrics_with_task_cfg(probs, labels, cfg)
+    assert "qwk" in res
+    cfg_ml = {"setting": "multi_label", "label_dict": {str(i): i for i in range(6)}}
+    res_ml = calculate_metrics_with_task_cfg(probs, labels, cfg_ml)
+    assert "micro_auroc" in res_ml

@@ -1,0 +1,45 @@
+"""On-the-fly sincos embedding must match the reference's full-table gather."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from gigapath_tpu.ops import pos_embed as pe
+
+
+def test_table_matches_known_structure():
+    table = pe.get_2d_sincos_pos_embed(8, 4, cls_token=True)
+    assert table.shape == (17, 8)
+    # cls row is zeros
+    np.testing.assert_array_equal(table[0], np.zeros(8))
+    # position (0,0) -> sin(0)=0, cos(0)=1 pattern
+    np.testing.assert_allclose(table[1], [0, 0, 1, 1, 0, 0, 1, 1], atol=1e-7)
+
+
+def test_on_the_fly_matches_table():
+    ngrids, dim, tile = 16, 24, 256
+    table = pe.get_2d_sincos_pos_embed(dim, ngrids, cls_token=True)
+    rng = np.random.default_rng(0)
+    coords = rng.integers(0, ngrids * tile, size=(2, 37, 2)).astype(np.float32)
+    pos = pe.coords_to_pos(jnp.asarray(coords), tile, ngrids)
+    gathered = table[np.asarray(pos)]
+    on_the_fly = pe.pos_embed_for_coords(dim, jnp.asarray(coords), tile, ngrids)
+    np.testing.assert_allclose(np.asarray(on_the_fly), gathered, atol=1e-5)
+
+
+def test_coords_to_pos_values():
+    coords = jnp.array([[[0.0, 0.0], [256.0, 512.0], [300.0, 100.0]]])
+    pos = pe.coords_to_pos(coords, 256, 1000)
+    np.testing.assert_array_equal(np.asarray(pos), [[1, 1 * 1000 + 2 + 1, 1 * 1000 + 0 + 1]])
+
+
+def test_interpolate_identity():
+    table = pe.get_2d_sincos_pos_embed(8, 4, cls_token=True)
+    out = pe.interpolate_pos_embed_table(table, 4)
+    np.testing.assert_array_equal(out, table)
+
+
+def test_interpolate_resize():
+    table = pe.get_2d_sincos_pos_embed(8, 4, cls_token=True)
+    out = pe.interpolate_pos_embed_table(table, 8)
+    assert out.shape == (65, 8)
+    np.testing.assert_array_equal(out[0], table[0])

@@ -1,0 +1,41 @@
+import numpy as np
+import pytest
+
+from gigapath_tpu.data.tiling import (
+    assemble_tiles_2d,
+    get_1d_padding,
+    pad_for_tiling_2d,
+    tile_array_2d,
+)
+
+
+@pytest.mark.parametrize("length,tile,expected", [(10, 5, (0, 0)), (11, 5, (2, 2)), (13, 5, (1, 1)), (1, 4, (1, 2))])
+def test_get_1d_padding(length, tile, expected):
+    assert get_1d_padding(length, tile) == expected
+
+
+@pytest.mark.parametrize("channels_first", [True, False])
+def test_pad_for_tiling(channels_first):
+    img = np.arange(3 * 5 * 7).reshape((3, 5, 7) if channels_first else (5, 7, 3))
+    padded, offset = pad_for_tiling_2d(img, 4, channels_first, constant_values=0)
+    shape = padded.shape[1:] if channels_first else padded.shape[:2]
+    assert shape == (8, 8)
+    assert offset.tolist() == [0, 1]  # (x_off, y_off): w 7->8 pad (0,1), h 5->8 pad (1,2)
+
+
+@pytest.mark.parametrize("channels_first", [True, False])
+def test_tile_roundtrip(channels_first):
+    rng = np.random.default_rng(1)
+    img = rng.normal(size=(3, 8, 12) if channels_first else (8, 12, 3))
+    tiles, coords = tile_array_2d(img, 4, channels_first)
+    assert tiles.shape[0] == (8 // 4) * (12 // 4)
+    assert coords.shape == (tiles.shape[0], 2)
+    assembled, offset = assemble_tiles_2d(tiles, coords, fill_value=0.0, channels_first=channels_first)
+    np.testing.assert_allclose(assembled, img)
+
+
+def test_tile_coords_negative_when_padded():
+    img = np.zeros((1, 5, 5))
+    tiles, coords = tile_array_2d(img, 4, True, constant_values=0)
+    assert tiles.shape == (4, 1, 4, 4)
+    assert coords.min() < 0  # padding shifts the first tile into negative coords
