@@ -1,8 +1,9 @@
 """Slide pyramid-level resolution helpers (host-side).
 
 Capability parity with reference ``gigapath/preprocessing/data/slide_utils.py``
-(``find_level_for_target_mpp:3``): read the slide's microns-per-pixel from
-TIFF resolution tags and find the pyramid level closest to a target MPP.
+(``find_level_for_target_mpp:3``): read microns-per-pixel for both axes from
+TIFF resolution tags and find the pyramid level whose X *and* Y MPP are within
+tolerance of the target.
 
 OpenSlide is an optional dependency (a C library); all entry points accept
 either an open slide handle or a path, and degrade with a clear error if
@@ -12,7 +13,7 @@ OpenSlide is unavailable.
 from __future__ import annotations
 
 import logging
-from typing import Optional
+from typing import Optional, Tuple
 
 try:  # pragma: no cover - optional C library
     import openslide  # type: ignore
@@ -27,45 +28,61 @@ def _open(slide_path):
     if openslide is None:
         raise ImportError(
             "openslide-python is required for WSI I/O; install it or pass a "
-            "slide object with `.properties` and `.level_downsamples`."
+            "slide object with `.properties`, `.level_count` and "
+            "`.level_downsamples`."
         )
     return openslide.OpenSlide(str(slide_path))
 
 
-def get_slide_mpp(slide) -> Optional[float]:
-    """Base-level microns-per-pixel from resolution tags, if present.
+def get_slide_mpp(slide) -> Optional[Tuple[float, float]]:
+    """Base-level (mpp_x, mpp_y) from resolution tags, if present.
 
     Accepts any object with an openslide-style ``properties`` mapping. Checks
-    ``openslide.mpp-x`` first, then falls back to the TIFF X-resolution tag
-    (pixels per cm -> um/px), as the reference does.
+    ``openslide.mpp-*`` first, then falls back to the TIFF resolution tags
+    (pixels per cm -> um/px) like the reference (``slide_utils.py:19-29``).
     """
     props = slide.properties
-    mpp = props.get("openslide.mpp-x")
-    if mpp is not None:
-        return float(mpp)
+    mpp_x = props.get("openslide.mpp-x")
+    mpp_y = props.get("openslide.mpp-y")
+    if mpp_x is not None and mpp_y is not None:
+        return float(mpp_x), float(mpp_y)
     x_res = props.get("tiff.XResolution")
+    y_res = props.get("tiff.YResolution")
     unit = props.get("tiff.ResolutionUnit")
-    if x_res is not None and unit == "centimeter":
-        return 10000.0 / float(x_res)
-    return None
+    if x_res is None or y_res is None:
+        return None
+    if unit != "centimeter":
+        logging.warning("Resolution unit is %r, not centimeters; cannot derive MPP", unit)
+        return None
+    return 10000.0 / float(x_res), 10000.0 / float(y_res)
 
 
 def find_level_for_target_mpp(slide_path, target_mpp: float, tolerance: float = 0.1) -> Optional[int]:
-    """Find the pyramid level whose MPP is within ``tolerance`` of the target.
+    """Find the pyramid level whose X and Y MPP are within ``tolerance``.
 
-    Returns the level index, or ``None`` if no level matches.
+    Returns the level index, or ``None`` if no level matches (including
+    anisotropic slides where only one axis matches, which the reference also
+    rejects, ``slide_utils.py:43``).
     """
-    slide = _open(slide_path) if isinstance(slide_path, (str, bytes)) or hasattr(slide_path, "__fspath__") else slide_path
+    slide = (
+        slide_path
+        if hasattr(slide_path, "properties")
+        else _open(slide_path)
+    )
 
-    base_mpp = get_slide_mpp(slide)
-    if base_mpp is None:
-        logging.warning("No resolution metadata found in %s", slide_path)
+    mpp = get_slide_mpp(slide)
+    if mpp is None:
+        logging.warning("No usable resolution metadata found in %s", slide_path)
         return None
+    mpp_x, mpp_y = mpp
 
-    for level, downsample in enumerate(slide.level_downsamples):
-        level_mpp = base_mpp * downsample
-        if abs(level_mpp - target_mpp) < tolerance:
-            logging.info("Level %d matches target MPP %.3f (level MPP %.3f)", level, target_mpp, level_mpp)
+    for level in range(slide.level_count):
+        downsample = slide.level_downsamples[level]
+        if (
+            abs(mpp_x * downsample - target_mpp) < tolerance
+            and abs(mpp_y * downsample - target_mpp) < tolerance
+        ):
+            logging.info("Level %d corresponds to approximately %s MPP", level, target_mpp)
             return level
 
     logging.warning("No level with MPP within %.2f of %.2f found", tolerance, target_mpp)

@@ -1,0 +1,193 @@
+"""Multi-head attention with log-sum-exp output.
+
+TPU-native counterpart of reference ``torchscale/component/multihead_attention.py``
+and ``torchscale/component/flash_attention.py``. The reference needs two CUDA
+kernel stacks (flash-attn, xformers CUTLASS) because its LSE output is required
+by dilated attention's branch recombination (``dilated_attention.py:119-128``).
+Here the op is a single function: a pure-jnp softmax attention that always
+returns ``(out, lse)``, which XLA fuses well at the segment sizes dilated
+attention produces, plus an opt-in Pallas flash kernel
+(:mod:`gigapath_tpu.ops.flash_attention`) for long dense segments.
+
+Shapes follow the flash-attn convention the reference uses at the kernel
+boundary: q/k/v are ``[B, L, H, D]``, lse is ``[B, H, L]``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+# Large-but-finite mask value: keeps fully-masked rows NaN-free (exp(-1e8)=0,
+# lse=-1e8 instead of -inf) which the dilated-branch recombination relies on.
+NEG_INF = -1e8
+
+
+def attention_with_lse(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    scale: Optional[float] = None,
+    bias: Optional[jnp.ndarray] = None,
+    key_padding_mask: Optional[jnp.ndarray] = None,
+    is_causal: bool = False,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Softmax attention returning ``(out [B,Lq,H,D], lse [B,H,Lq])``.
+
+    Softmax statistics are accumulated in fp32 regardless of input dtype
+    (bf16-safe); the output is cast back to the input dtype.
+
+    - ``bias``: additive logits bias broadcastable to ``[B, H, Lq, Lk]``
+      (T5 relative-position bias or a pre-built attn_mask).
+    - ``key_padding_mask``: ``[B, Lk]`` bool, True = padding.
+    - ``is_causal``: lower-triangular mask (query i attends keys <= i).
+    """
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    if scale is None:
+        scale = D**-0.5
+
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ).astype(jnp.float32) * scale
+
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    if key_padding_mask is not None:
+        logits = jnp.where(key_padding_mask[:, None, None, :], NEG_INF, logits)
+    if is_causal:
+        qi = jnp.arange(Lq)[:, None] + (Lk - Lq)  # align ends when Lq != Lk
+        ki = jnp.arange(Lk)[None, :]
+        logits = jnp.where(ki > qi, NEG_INF, logits)
+
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)  # [B, H, Lq]
+    probs = jnp.exp(logits - lse[..., None])
+
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = probs * keep / (1.0 - dropout_rate)
+
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out.astype(q.dtype), lse
+
+
+class MultiheadAttention(nn.Module):
+    """Self/cross attention block with q/k/v/out projections.
+
+    Parity with reference ``multihead_attention.py:20-171``: optional xPos
+    rotary position, optional sub-LayerNorm on the attention output
+    (``subln``), and an inner attention op returning ``(out, lse)``. The
+    Multiway (BEiT-3) wrapping of the projections is composed at the
+    architecture layer rather than baked in here.
+    """
+
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.0
+    self_attention: bool = True
+    encoder_decoder_attention: bool = False
+    subln: bool = False
+    layernorm_eps: float = 1e-5
+    xpos_rel_pos: bool = False
+    xpos_scale_base: int = 512
+    dtype: Any = None
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    def _attend(
+        self,
+        q: jnp.ndarray,
+        k: jnp.ndarray,
+        v: jnp.ndarray,
+        *,
+        key_padding_mask=None,
+        attn_mask=None,
+        rel_pos=None,
+        is_causal: bool = False,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        """Inner attention on [B, L, H, D] tensors -> [B, Lq, H*D].
+
+        Subclasses (DilatedAttention) override this to restructure the
+        sequence around the core op.
+        """
+        bias = None
+        if attn_mask is not None:
+            bias = attn_mask
+        if rel_pos is not None:
+            rel = rel_pos.reshape(q.shape[0], self.num_heads, q.shape[1], k.shape[1])
+            bias = rel if bias is None else bias + rel
+        rng = None
+        if self.dropout > 0.0 and not deterministic:
+            rng = self.make_rng("dropout")
+        out, _ = attention_with_lse(
+            q,
+            k,
+            v,
+            bias=bias,
+            key_padding_mask=key_padding_mask,
+            is_causal=is_causal,
+            dropout_rate=0.0 if deterministic else self.dropout,
+            dropout_rng=rng,
+        )
+        return out.reshape(out.shape[0], out.shape[1], self.embed_dim)
+
+    @nn.compact
+    def __call__(
+        self,
+        query: jnp.ndarray,
+        key: jnp.ndarray,
+        value: jnp.ndarray,
+        *,
+        key_padding_mask: Optional[jnp.ndarray] = None,
+        attn_mask: Optional[jnp.ndarray] = None,
+        rel_pos: Optional[jnp.ndarray] = None,
+        is_causal: bool = False,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        assert self.self_attention ^ self.encoder_decoder_attention
+        B, Lq, _ = query.shape
+        H, Dh = self.num_heads, self.head_dim
+
+        proj = lambda name: nn.Dense(  # noqa: E731
+            self.embed_dim,
+            use_bias=True,
+            dtype=self.dtype,
+            kernel_init=nn.initializers.xavier_uniform(),
+            name=name,
+        )
+        q = proj("q_proj")(query).reshape(B, Lq, H, Dh)
+        k = proj("k_proj")(key).reshape(B, key.shape[1], H, Dh)
+        v = proj("v_proj")(value).reshape(B, value.shape[1], H, Dh)
+
+        if self.xpos_rel_pos and self.self_attention:
+            from gigapath_tpu.ops.xpos import apply_xpos
+
+            k = apply_xpos(k, scale_base=self.xpos_scale_base, downscale=True)
+            q = apply_xpos(q, scale_base=self.xpos_scale_base, downscale=False)
+
+        attn = self._attend(
+            q,
+            k,
+            v,
+            key_padding_mask=key_padding_mask,
+            attn_mask=attn_mask,
+            rel_pos=rel_pos,
+            is_causal=is_causal,
+            deterministic=deterministic,
+        )
+
+        if self.subln and self.self_attention:
+            attn = nn.LayerNorm(
+                epsilon=self.layernorm_eps, dtype=self.dtype, name="inner_attn_ln"
+            )(attn)
+
+        return proj("out_proj")(attn)

@@ -1,0 +1,26 @@
+"""Stochastic depth (DropPath).
+
+Parity with reference ``torchscale/component/droppath.py`` (which delegates to
+timm's ``drop_path``): per-sample Bernoulli keep on the batch axis, rescaled
+by the keep probability at train time, identity at eval.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class DropPath(nn.Module):
+    drop_prob: float = 0.0
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        if self.drop_prob == 0.0 or deterministic:
+            return x
+        keep_prob = 1.0 - self.drop_prob
+        rng = self.make_rng("dropout")
+        shape = (x.shape[0],) + (1,) * (x.ndim - 1)
+        mask = jax.random.bernoulli(rng, keep_prob, shape)
+        return jnp.where(mask, x / keep_prob, jnp.zeros_like(x))

@@ -1,0 +1,90 @@
+"""Feed-forward blocks: FFN (with optional sub-LN) and GLU.
+
+Parity with reference ``torchscale/component/feedforward_network.py`` and
+``gate_linear_unit.py``: fc1 -> activation (fp32) -> [sub-LN] -> fc2 with
+activation- and output-dropout; GLU variant gates fc1 with a parallel linear
+(all bias-free). Expert construction for MoE lives in
+:mod:`gigapath_tpu.ops.moe` (per-expert init is a vmapped param axis there,
+replacing the reference's per-rank seeded loop, ``feedforward_network.py:43-91``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def get_activation_fn(activation: str) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    if activation == "relu":
+        return nn.relu
+    if activation == "gelu":
+        return nn.gelu
+    if activation == "swish":
+        return nn.silu
+    raise NotImplementedError(f"unknown activation: {activation}")
+
+
+class FeedForwardNetwork(nn.Module):
+    embed_dim: int
+    ffn_dim: int
+    activation_fn: str = "gelu"
+    dropout: float = 0.0
+    activation_dropout: float = 0.0
+    layernorm_eps: float = 1e-5
+    subln: bool = False
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        act = get_activation_fn(self.activation_fn)
+        h = nn.Dense(
+            self.ffn_dim,
+            dtype=self.dtype,
+            kernel_init=nn.initializers.xavier_uniform(),
+            name="fc1",
+        )(x)
+        h = act(h.astype(jnp.float32)).astype(h.dtype)
+        h = nn.Dropout(self.activation_dropout)(h, deterministic=deterministic)
+        if self.subln:
+            h = nn.LayerNorm(epsilon=self.layernorm_eps, dtype=self.dtype, name="ffn_layernorm")(h)
+        out = nn.Dense(
+            self.embed_dim,
+            dtype=self.dtype,
+            kernel_init=nn.initializers.xavier_uniform(),
+            name="fc2",
+        )(h)
+        return nn.Dropout(self.dropout)(out, deterministic=deterministic)
+
+
+class GLU(nn.Module):
+    embed_dim: int
+    ffn_dim: int
+    activation_fn: str = "gelu"
+    dropout: float = 0.0
+    activation_dropout: float = 0.0
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        act = get_activation_fn(self.activation_fn)
+        dense = lambda n: nn.Dense(  # noqa: E731
+            self.ffn_dim,
+            use_bias=False,
+            dtype=self.dtype,
+            kernel_init=nn.initializers.xavier_uniform(),
+            name=n,
+        )
+        g = dense("gate")(x)
+        h = dense("fc1")(x)
+        h = act(h.astype(jnp.float32)).astype(h.dtype) * g
+        h = nn.Dropout(self.activation_dropout)(h, deterministic=deterministic)
+        out = nn.Dense(
+            self.embed_dim,
+            use_bias=False,
+            dtype=self.dtype,
+            kernel_init=nn.initializers.xavier_uniform(),
+            name="fc2",
+        )(h)
+        return nn.Dropout(self.dropout)(out, deterministic=deterministic)

@@ -1,0 +1,52 @@
+"""Fused attention entry point: (out, lse) with backend dispatch.
+
+Role parity with reference ``torchscale/component/flash_attention.py``, which
+tiers flash-attn CUDA -> xformers CUTLASS -> None by GPU capability. On TPU
+the tiers are: Pallas flash kernel (long segments, memory-bound) or the
+XLA-fused jnp op (short segments, default) — both emit the LSE that dilated
+attention's branch fusion requires.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from gigapath_tpu.ops.attention import attention_with_lse
+
+# Segments at least this long route to the Pallas kernel on TPU by default:
+# below it, XLA's fused dense attention is faster than paying kernel overhead.
+PALLAS_MIN_SEQ = 1024
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except RuntimeError:  # pragma: no cover
+        return False
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    is_causal: bool = False,
+    bias: Optional[jnp.ndarray] = None,
+    use_pallas: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Attention on [B, L, H, D] returning ``(out [B,L,H,D], lse [B,H,L])``."""
+    if use_pallas is None:
+        use_pallas = (
+            _on_tpu()
+            and bias is None
+            and q.shape[1] >= PALLAS_MIN_SEQ
+            and q.shape[1] == k.shape[1]
+        )
+    if use_pallas:
+        from gigapath_tpu.ops.pallas_flash import pallas_flash_attention
+
+        return pallas_flash_attention(q, k, v, is_causal=is_causal)
+    return attention_with_lse(q, k, v, is_causal=is_causal, bias=bias)

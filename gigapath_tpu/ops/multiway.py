@@ -1,0 +1,41 @@
+"""Multiway (BEiT-3 style) two-branch module split.
+
+Parity with reference ``torchscale/component/multiway_network.py``: a wrapper
+holding two copies (A/B) of a module; tokens before ``split_position`` go
+through A, the rest through B. The reference mutates ``split_position`` on
+module objects via ``apply`` (``set_split_position``); functional flax passes
+it as a call argument instead, which is also what makes it jittable (the
+split position is static per trace).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class MultiwayNetwork(nn.Module):
+    """Wraps ``module_fn`` twice (branches A and B), splitting on an axis."""
+
+    module_fn: Callable[..., nn.Module]
+    dim: int = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *args, split_position: int = -1, **kwargs):
+        a = self.module_fn(name="A")
+        b = self.module_fn(name="B")
+        if split_position == -1:
+            return a(x, *args, **kwargs)
+        if split_position == 0:
+            return b(x, *args, **kwargs)
+        x1, x2 = jnp.split(x, [split_position], axis=self.dim)
+        return jnp.concatenate([a(x1, *args, **kwargs), b(x2, *args, **kwargs)], axis=self.dim)
+
+
+def multiway_wrapper(multiway: bool, module_fn: Callable[..., nn.Module], dim: int = 1):
+    """Factory parity with ``MultiwayWrapper`` — identity unless multiway."""
+    if multiway:
+        return MultiwayNetwork(module_fn=module_fn, dim=dim)
+    return module_fn()

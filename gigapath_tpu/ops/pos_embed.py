@@ -80,15 +80,23 @@ def sincos_pos_embed_from_grid_pos(
     """On-the-fly embedding for flat table indices ``pos`` (cls offset removed).
 
     ``pos`` is the flat row index ``i*ngrids + j``; this reproduces the exact
-    table-row the reference would have gathered (including the wrap-around a
-    flat index implies when ``j >= ngrids``).
+    table-row the reference would have gathered, including the wrap-around a
+    flat index implies when ``j >= ngrids`` and torch's negative-index
+    wrapping for negative positions (padded edge tiles can have negative
+    coords). A wrapped index landing on the cls row (all zeros in the table)
+    is reproduced as zeros.
     """
-    pos = pos.astype(jnp.int32)
-    i = pos // ngrids
-    j = pos % ngrids
+    table_rows = ngrids * ngrids + 1
+    pos = pos.astype(jnp.int32) + 1  # back to full-table row index
+    pos = jnp.where(pos < 0, pos + table_rows, pos)  # torch negative indexing
+    is_cls_row = pos == 0
+    grid_pos = pos - 1
+    i = grid_pos // ngrids
+    j = grid_pos % ngrids
     emb_j = _sincos_1d(embed_dim // 2, j)  # first half encodes the w/j coord
     emb_i = _sincos_1d(embed_dim // 2, i)
-    return jnp.concatenate([emb_j, emb_i], axis=-1)
+    emb = jnp.concatenate([emb_j, emb_i], axis=-1)
+    return jnp.where(is_cls_row[..., None], 0.0, emb)
 
 
 def coords_to_pos(coords: jnp.ndarray, tile_size: int, ngrids: int) -> jnp.ndarray:

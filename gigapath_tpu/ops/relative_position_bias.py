@@ -1,0 +1,64 @@
+"""T5-style bucketed relative position bias.
+
+Parity with reference ``torchscale/component/relative_position_bias.py``:
+log-bucketed relative distances (half the buckets for exact small offsets,
+half log-spaced up to ``max_distance``), an embedding of buckets -> per-head
+bias, returned as ``[batch*heads, qlen, klen]`` additive logits.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+
+def relative_position_bucket(
+    relative_position: jnp.ndarray,
+    bidirectional: bool = True,
+    num_buckets: int = 32,
+    max_distance: int = 128,
+) -> jnp.ndarray:
+    ret = jnp.zeros_like(relative_position)
+    n = -relative_position
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + (n < 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_if_large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact)
+        / math.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    val_if_large = jnp.minimum(val_if_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_if_large)
+
+
+class RelativePositionBias(nn.Module):
+    bidirectional: bool = True
+    num_buckets: int = 32
+    max_distance: int = 128
+    n_heads: int = 12
+
+    @nn.compact
+    def __call__(self, batch_size: int, qlen: int, klen: int, step: int = 0) -> jnp.ndarray:
+        context = np.arange(step, step + qlen)[:, None]
+        memory = np.arange(klen)[None, :]
+        buckets = relative_position_bucket(
+            jnp.asarray(memory - context),
+            bidirectional=self.bidirectional,
+            num_buckets=self.num_buckets,
+            max_distance=self.max_distance,
+        )
+        table = nn.Embed(self.num_buckets, self.n_heads, name="relative_attention_bias")
+        values = table(buckets)  # [qlen, klen, heads]
+        values = values.transpose(2, 0, 1)[None]  # [1, heads, qlen, klen]
+        values = jnp.broadcast_to(values, (batch_size,) + values.shape[1:])
+        return values.reshape(-1, qlen, klen)

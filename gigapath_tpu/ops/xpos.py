@@ -1,0 +1,63 @@
+"""xPos extrapolatable rotary position embedding.
+
+Parity with reference ``torchscale/component/xpos_relative_position.py``:
+rotate-every-two rotary embedding whose amplitude is scaled per-pair by
+``((2i + 0.4d)/(1.4d)) ** (pos/scale_base)`` — keys are downscaled, queries
+upscaled. Positions are centered around zero as in the reference
+(``XPOS.forward:50-53``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _rotate_every_two(x: jnp.ndarray) -> jnp.ndarray:
+    x1 = x[..., ::2]
+    x2 = x[..., 1::2]
+    return jnp.stack((-x2, x1), axis=-1).reshape(x.shape)
+
+
+def _duplicate_interleave(m: jnp.ndarray) -> jnp.ndarray:
+    """[L, D/2] -> [L, D] with each column doubled in place."""
+    return jnp.repeat(m, 2, axis=-1)
+
+
+def xpos_scale(length: int, head_dim: int, scale_base: int, offset: int = 0) -> jnp.ndarray:
+    """Per-(position, pair) amplitude scale, centered positions. [L, D/2]."""
+    per_dim = (jnp.arange(0, head_dim, 2) + 0.4 * head_dim) / (1.4 * head_dim)
+    min_pos = -(length + offset) // 2
+    positions = jnp.arange(min_pos, min_pos + length + offset, dtype=jnp.float32)
+    scale = per_dim[None, :] ** (positions[:, None] / scale_base)
+    return scale[-length:]
+
+
+def apply_xpos(
+    x: jnp.ndarray,
+    *,
+    scale_base: int = 512,
+    offset: int = 0,
+    downscale: bool = False,
+) -> jnp.ndarray:
+    """Apply xPos to [..., L, H, D] or [B, L, D] along the length axis.
+
+    Accepts [B, L, H, D] (per-head) by operating on the last axis; length is
+    taken from axis -3 for 4-D inputs, axis -2 otherwise.
+    """
+    head_dim = x.shape[-1]
+    length = x.shape[-3] if x.ndim == 4 else x.shape[-2]
+    scale = xpos_scale(length, head_dim, scale_base, offset)  # [L, D/2]
+    if downscale:
+        scale = 1.0 / scale
+
+    # sinusoid over the *scale magnitudes* as in the reference
+    # (fixed_pos_embedding is fed the scale matrix, xpos_relative_position.py:54)
+    inv_freq = 1.0 / (10000 ** (jnp.arange(0, scale.shape[-1]) / scale.shape[-1]))
+    sinusoid = jnp.arange(length, dtype=jnp.float32)[:, None] * inv_freq[None, :]
+    sin = _duplicate_interleave(jnp.sin(sinusoid) * scale)
+    cos = _duplicate_interleave(jnp.cos(sinusoid) * scale)
+
+    if x.ndim == 4:  # [B, L, H, D]: broadcast over heads
+        sin = sin[None, :, None, :]
+        cos = cos[None, :, None, :]
+    return (x * cos) + (_rotate_every_two(x) * sin)

@@ -1,0 +1,101 @@
+"""Unit tests for the small torchscale-parity components."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gigapath_tpu.ops.droppath import DropPath
+from gigapath_tpu.ops.feedforward import GLU, FeedForwardNetwork, get_activation_fn
+from gigapath_tpu.ops.multiway import MultiwayNetwork
+from gigapath_tpu.ops.norms import RMSNorm
+from gigapath_tpu.ops.relative_position_bias import RelativePositionBias, relative_position_bucket
+from gigapath_tpu.ops.xpos import apply_xpos
+
+
+def test_ffn_shapes_and_subln(rng):
+    ffn = FeedForwardNetwork(embed_dim=16, ffn_dim=32, subln=True)
+    x = jnp.asarray(rng.normal(size=(2, 5, 16)), jnp.float32)
+    params = ffn.init(jax.random.PRNGKey(0), x)
+    assert "ffn_layernorm" in params["params"]
+    out = ffn.apply(params, x)
+    assert out.shape == x.shape
+
+
+def test_glu_shapes(rng):
+    glu = GLU(embed_dim=16, ffn_dim=32, activation_fn="swish")
+    x = jnp.asarray(rng.normal(size=(2, 5, 16)), jnp.float32)
+    params = glu.init(jax.random.PRNGKey(0), x)
+    # bias-free by parity with reference gate_linear_unit.py
+    assert "bias" not in params["params"]["fc1"]
+    assert glu.apply(params, x).shape == x.shape
+
+
+def test_activation_fns():
+    for name in ["relu", "gelu", "swish"]:
+        assert get_activation_fn(name) is not None
+    try:
+        get_activation_fn("nope")
+        raise AssertionError("should have raised")
+    except NotImplementedError:
+        pass
+
+
+def test_rmsnorm_matches_formula(rng):
+    x = rng.normal(size=(2, 7, 8)).astype(np.float32)
+    norm = RMSNorm(dim=8)
+    params = norm.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    out = norm.apply(params, jnp.asarray(x))
+    expected = x / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(np.asarray(out), expected, atol=1e-5)
+
+
+def test_droppath_eval_identity_train_scales(rng):
+    dp = DropPath(drop_prob=0.5)
+    x = jnp.ones((64, 3, 4))
+    params = dp.init({"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)}, x, False)
+    out_eval = dp.apply(params, x, True)
+    np.testing.assert_array_equal(np.asarray(out_eval), np.asarray(x))
+    out_train = dp.apply(params, x, False, rngs={"dropout": jax.random.PRNGKey(2)})
+    vals = np.unique(np.asarray(out_train))
+    assert set(np.round(vals, 4)) <= {0.0, 2.0}  # dropped or rescaled by 1/keep
+
+
+def test_relative_position_bucket_properties():
+    rel = jnp.arange(-50, 50)
+    buckets = relative_position_bucket(rel, num_buckets=32, max_distance=128)
+    b = np.asarray(buckets)
+    assert b.min() >= 0 and b.max() < 32
+    assert b[50] == 0  # zero offset -> bucket 0
+
+
+def test_relative_position_bias_module():
+    mod = RelativePositionBias(num_buckets=32, max_distance=128, n_heads=4)
+    params = mod.init(jax.random.PRNGKey(0), 2, 5, 5)
+    out = mod.apply(params, 2, 5, 5)
+    assert out.shape == (2 * 4, 5, 5)
+
+
+def test_xpos_scaling_antisymmetry(rng):
+    """q-upscale and k-downscale cancel: scaled dot q·k == rotary-only dot."""
+    x = rng.normal(size=(1, 9, 2, 8)).astype(np.float32)
+    q = np.asarray(apply_xpos(jnp.asarray(x), downscale=False))
+    k = np.asarray(apply_xpos(jnp.asarray(x), downscale=True))
+    # at equal positions the xpos scales cancel exactly
+    dots_qk = (q * k).sum(-1)
+    base = np.asarray(apply_xpos(jnp.asarray(x), scale_base=10**9, downscale=False))
+    dots_base = (base * base).sum(-1)
+    np.testing.assert_allclose(dots_qk, dots_base, rtol=1e-3, atol=1e-3)
+
+
+def test_multiway_split(rng):
+    import flax.linen as nn
+    from functools import partial
+
+    mod = MultiwayNetwork(module_fn=partial(nn.Dense, 8), dim=1)
+    x = jnp.asarray(rng.normal(size=(2, 6, 8)), jnp.float32)
+    params = mod.init(jax.random.PRNGKey(0), x, split_position=3)
+    full = mod.apply(params, x, split_position=3)
+    a_only = mod.apply(params, x, split_position=-1)
+    b_only = mod.apply(params, x, split_position=0)
+    np.testing.assert_allclose(np.asarray(full[:, :3]), np.asarray(a_only[:, :3]), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(full[:, 3:]), np.asarray(b_only[:, 3:]), atol=1e-6)

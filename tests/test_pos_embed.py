@@ -32,6 +32,20 @@ def test_coords_to_pos_values():
     np.testing.assert_array_equal(np.asarray(pos), [[1, 1 * 1000 + 2 + 1, 1 * 1000 + 0 + 1]])
 
 
+def test_negative_coords_match_torch_wraparound():
+    """Padded edge tiles have negative coords; reference table gather wraps
+    like torch negative indexing. Verify exact emulation."""
+    import torch
+
+    ngrids, dim, tile = 8, 16, 256
+    table = torch.from_numpy(pe.get_2d_sincos_pos_embed(dim, ngrids, cls_token=True))
+    coords = np.array([[[-128.0, 64.0], [-256.0, -256.0], [0.0, -512.0]]], np.float32)
+    pos = np.asarray(pe.coords_to_pos(jnp.asarray(coords), tile, ngrids))
+    ref = table[torch.from_numpy(pos).long()].numpy()
+    ours = np.asarray(pe.pos_embed_for_coords(dim, jnp.asarray(coords), tile, ngrids))
+    np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+
 def test_interpolate_identity():
     table = pe.get_2d_sincos_pos_embed(8, 4, cls_token=True)
     out = pe.interpolate_pos_embed_table(table, 4)

@@ -1,0 +1,40 @@
+"""find_level_for_target_mpp with a fake openslide handle (no C library)."""
+
+from gigapath_tpu.data.slide_utils import find_level_for_target_mpp, get_slide_mpp
+
+
+class FakeSlide:
+    def __init__(self, props, downsamples):
+        self.properties = props
+        self.level_downsamples = downsamples
+        self.level_count = len(downsamples)
+
+
+def test_finds_matching_level():
+    s = FakeSlide(
+        {"tiff.XResolution": "40000", "tiff.YResolution": "40000", "tiff.ResolutionUnit": "centimeter"},
+        [1.0, 2.0, 4.0],
+    )  # base mpp 0.25 -> level 1 = 0.5
+    assert get_slide_mpp(s) == (0.25, 0.25)
+    assert find_level_for_target_mpp(s, 0.5) == 1
+
+
+def test_openslide_mpp_property_preferred():
+    s = FakeSlide({"openslide.mpp-x": "0.5", "openslide.mpp-y": "0.5"}, [1.0])
+    assert find_level_for_target_mpp(s, 0.5) == 0
+
+
+def test_anisotropic_slide_rejected():
+    s = FakeSlide(
+        {"openslide.mpp-x": "0.5", "openslide.mpp-y": "0.7"}, [1.0, 2.0]
+    )  # Y axis never within tolerance -> None (parity: reference requires both)
+    assert find_level_for_target_mpp(s, 0.5) is None
+
+
+def test_missing_metadata():
+    assert find_level_for_target_mpp(FakeSlide({}, [1.0]), 0.5) is None
+    s = FakeSlide(
+        {"tiff.XResolution": "40000", "tiff.YResolution": "40000", "tiff.ResolutionUnit": "inch"},
+        [1.0],
+    )
+    assert find_level_for_target_mpp(s, 0.5) is None
