@@ -1,0 +1,279 @@
+"""Architecture configs (Encoder / Decoder / EncoderDecoder / RetNet).
+
+Parity with reference ``torchscale/architecture/config.py``: the same field
+surface and the same ``postprocessing()`` invariants (deepnorm vs subln
+exclusivity, xmoe implications). Two deliberate fixes over the reference:
+
+- stringified ``segment_length`` / ``dilated_ratio`` are parsed with
+  ``ast.literal_eval`` instead of ``eval`` (the reference ``eval()``s user
+  strings, ``config.py:71-73``);
+- configs are dataclasses with ``override()`` and ``asdict`` support rather
+  than kwargs-bags, so unknown keys fail loudly unless passed through
+  ``extras``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Union
+
+IntList = Union[None, str, List[int]]
+
+
+def _parse_int_list(value: IntList) -> Optional[List[int]]:
+    if value is None or value == "":
+        return None
+    if isinstance(value, str):
+        parsed = ast.literal_eval(value)
+    else:
+        parsed = value
+    return [int(x) for x in parsed]
+
+
+@dataclass
+class _MoEFieldsMixin:
+    moe_freq: int = 0
+    moe_top1_expert: bool = False
+    moe_expert_count: int = 0
+    moe_gating_use_fp32: bool = True
+    moe_eval_capacity_token_fraction: float = 0.25
+    moe_second_expert_policy: str = "random"
+    moe_normalize_gate_prob_before_dropping: bool = False
+    use_xmoe: bool = False
+
+
+def _shared_postprocess(cfg) -> None:
+    cfg.segment_length = _parse_int_list(getattr(cfg, "segment_length", None))
+    cfg.dilated_ratio = _parse_int_list(getattr(cfg, "dilated_ratio", None))
+    if cfg.deepnorm:
+        cfg.subln = False
+        if hasattr(cfg, "encoder_normalize_before"):
+            cfg.encoder_normalize_before = False
+        if hasattr(cfg, "decoder_normalize_before"):
+            cfg.decoder_normalize_before = False
+    if cfg.subln:
+        cfg.deepnorm = False
+        if hasattr(cfg, "encoder_normalize_before"):
+            cfg.encoder_normalize_before = True
+        if hasattr(cfg, "decoder_normalize_before"):
+            cfg.decoder_normalize_before = True
+    if cfg.use_xmoe:
+        cfg.moe_normalize_gate_prob_before_dropping = True
+        cfg.moe_second_expert_policy = "random"
+        assert cfg.moe_freq > 0 and cfg.moe_expert_count > 0
+
+
+class _ConfigBase:
+    def override(self, args: Any) -> None:
+        """Overwrite fields from an argparse-like namespace (non-None only)."""
+        for f in dataclasses.fields(self):
+            value = getattr(args, f.name, None)
+            if value is not None:
+                setattr(self, f.name, value)
+        self.postprocessing()
+
+    def asdict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "_ConfigBase":
+        names = {f.name for f in dataclasses.fields(cls)}
+        known = {k: v for k, v in d.items() if k in names}
+        extras = {k: v for k, v in d.items() if k not in names}
+        cfg = cls(**known)
+        # parity with the reference kwargs-bag: unknown keys (e.g. the dead
+        # 'block_shift' in the LongNet registry) are tolerated but recorded
+        cfg.extras.update(extras)
+        return cfg
+
+
+@dataclass
+class EncoderConfig(_ConfigBase, _MoEFieldsMixin):
+    encoder_embed_dim: int = 768
+    encoder_attention_heads: int = 12
+    encoder_ffn_embed_dim: int = 3072
+    encoder_layers: int = 12
+    encoder_normalize_before: bool = True
+    normalize_output: bool = True
+    activation_fn: str = "gelu"
+    dropout: float = 0.0
+    drop_path_rate: float = 0.0
+    attention_dropout: float = 0.0
+    activation_dropout: float = 0.0
+    no_scale_embedding: bool = True
+    layernorm_embedding: bool = False
+    rel_pos_buckets: int = 0
+    max_rel_pos: int = 0
+    deepnorm: bool = False
+    subln: bool = True
+    bert_init: bool = False
+    multiway: bool = False
+    share_encoder_input_output_embed: bool = False
+    max_source_positions: int = 1024
+    no_output_layer: bool = False
+    layernorm_eps: float = 1e-5
+    vocab_size: int = -1
+    img_size: int = 224
+    patch_size: int = 16
+    in_chans: int = 3
+    checkpoint_activations: bool = False
+    fsdp: bool = False
+    ddp_rank: int = 0
+    xpos_rel_pos: bool = False
+    xpos_scale_base: int = 512
+    flash_attention: bool = False
+    segment_length: IntList = None
+    dilated_ratio: IntList = None
+    seq_parallel: bool = False
+    extras: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        self.postprocessing()
+
+    def postprocessing(self):
+        _shared_postprocess(self)
+
+
+@dataclass
+class DecoderConfig(_ConfigBase, _MoEFieldsMixin):
+    decoder_embed_dim: int = 768
+    decoder_attention_heads: int = 12
+    decoder_ffn_embed_dim: int = 3072
+    decoder_layers: int = 12
+    decoder_normalize_before: bool = True
+    activation_fn: str = "gelu"
+    dropout: float = 0.0
+    drop_path_rate: float = 0.0
+    attention_dropout: float = 0.0
+    activation_dropout: float = 0.0
+    no_scale_embedding: bool = True
+    layernorm_embedding: bool = False
+    rel_pos_buckets: int = 0
+    max_rel_pos: int = 0
+    deepnorm: bool = False
+    subln: bool = True
+    bert_init: bool = False
+    multiway: bool = False
+    share_decoder_input_output_embed: bool = False
+    max_target_positions: int = 1024
+    no_output_layer: bool = False
+    layernorm_eps: float = 1e-5
+    vocab_size: int = -1
+    checkpoint_activations: bool = False
+    fsdp: bool = False
+    ddp_rank: int = 0
+    xpos_rel_pos: bool = False
+    xpos_scale_base: int = 512
+    flash_attention: bool = False
+    segment_length: IntList = None
+    dilated_ratio: IntList = None
+    seq_parallel: bool = False
+    extras: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        self.postprocessing()
+
+    def postprocessing(self):
+        _shared_postprocess(self)
+
+
+@dataclass
+class EncoderDecoderConfig(_ConfigBase, _MoEFieldsMixin):
+    encoder_embed_dim: int = 768
+    encoder_attention_heads: int = 12
+    encoder_ffn_embed_dim: int = 3072
+    encoder_layers: int = 12
+    encoder_normalize_before: bool = True
+    normalize_output: bool = True
+    decoder_embed_dim: int = 768
+    decoder_attention_heads: int = 12
+    decoder_ffn_embed_dim: int = 3072
+    decoder_layers: int = 12
+    decoder_normalize_before: bool = True
+    activation_fn: str = "gelu"
+    dropout: float = 0.0
+    drop_path_rate: float = 0.0
+    attention_dropout: float = 0.0
+    activation_dropout: float = 0.0
+    no_scale_embedding: bool = True
+    layernorm_embedding: bool = False
+    rel_pos_buckets: int = 0
+    max_rel_pos: int = 0
+    deepnorm: bool = False
+    subln: bool = True
+    bert_init: bool = False
+    multiway: bool = False
+    share_all_embeddings: bool = False
+    share_decoder_input_output_embed: bool = False
+    max_source_positions: int = 1024
+    max_target_positions: int = 1024
+    no_output_layer: bool = False
+    layernorm_eps: float = 1e-5
+    vocab_size: int = -1
+    checkpoint_activations: bool = False
+    fsdp: bool = False
+    ddp_rank: int = 0
+    xpos_rel_pos: bool = False
+    xpos_scale_base: int = 512
+    flash_attention: bool = False
+    segment_length: IntList = None
+    dilated_ratio: IntList = None
+    seq_parallel: bool = False
+    extras: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        self.postprocessing()
+
+    def postprocessing(self):
+        _shared_postprocess(self)
+
+
+@dataclass
+class RetNetConfig(_ConfigBase, _MoEFieldsMixin):
+    decoder_embed_dim: int = 768
+    decoder_value_embed_dim: int = 1280
+    decoder_retention_heads: int = 3
+    decoder_ffn_embed_dim: int = 1280
+    decoder_layers: int = 12
+    decoder_normalize_before: bool = True
+    activation_fn: str = "gelu"
+    dropout: float = 0.0
+    drop_path_rate: float = 0.0
+    activation_dropout: float = 0.0
+    no_scale_embedding: bool = True
+    layernorm_embedding: bool = False
+    rel_pos_buckets: int = 0
+    max_rel_pos: int = 0
+    deepnorm: bool = False
+    subln: bool = True
+    multiway: bool = False
+    share_decoder_input_output_embed: bool = False
+    max_target_positions: int = 1024
+    no_output_layer: bool = False
+    layernorm_eps: float = 1e-6
+    chunkwise_recurrent: bool = False
+    recurrent_chunk_size: int = 512
+    vocab_size: int = -1
+    checkpoint_activations: bool = False
+    fsdp: bool = False
+    ddp_rank: int = 0
+    xpos_rel_pos: bool = False
+    xpos_scale_base: int = 512
+    extras: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        self.postprocessing()
+
+    def postprocessing(self):
+        if self.deepnorm:
+            self.subln = False
+            self.decoder_normalize_before = False
+        if self.subln:
+            self.deepnorm = False
+            self.decoder_normalize_before = True
+        if self.use_xmoe:
+            self.moe_normalize_gate_prob_before_dropping = True
+            self.moe_second_expert_policy = "random"
+            assert self.moe_freq > 0 and self.moe_expert_count > 0

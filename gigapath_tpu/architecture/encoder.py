@@ -1,0 +1,258 @@
+"""Transformer encoder stack (pre/post-LN, DropPath, deepnorm, MoE hooks).
+
+Parity with reference ``torchscale/architecture/encoder.py``: EncoderLayer is
+self-attn + FFN-or-MoE with sub-LN/deepnorm variants and per-depth DropPath;
+Encoder assembles the stack with embed scaling, optional text embedding /
+output projection, relative position bias, and per-layer activation
+checkpointing. TPU mapping:
+
+- fairscale ``checkpoint_wrapper`` -> ``flax.linen.remat`` per layer;
+- fairscale FSDP ``wrap`` -> parameter sharding is annotated at the pjit
+  level (:mod:`gigapath_tpu.parallel.sharding`), no module wrapper needed;
+- apex FusedLayerNorm -> ``nn.LayerNorm`` (XLA fuses it);
+- the sub-LN / deepnorm post-init weight scaling is a param-tree transform
+  (:func:`gigapath_tpu.architecture.init.apply_init_scaling`) applied by the
+  factories, since flax init is functional.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from gigapath_tpu.architecture.config import EncoderConfig
+from gigapath_tpu.ops.attention import MultiheadAttention
+from gigapath_tpu.ops.droppath import DropPath
+from gigapath_tpu.ops.feedforward import FeedForwardNetwork
+from gigapath_tpu.ops.relative_position_bias import RelativePositionBias
+
+
+class EncoderLayer(nn.Module):
+    """One encoder block. ``build_self_attention`` is the subclass hook the
+    LongNet layer overrides to swap in dilated attention (parity with
+    reference ``EncoderLayer.build_self_attention:102``)."""
+
+    args: EncoderConfig
+    depth: int
+    is_moe_layer: bool = False
+    is_encoder_decoder: bool = False
+    dtype: Any = None
+
+    def build_self_attention(self) -> nn.Module:
+        return MultiheadAttention(
+            embed_dim=self.args.encoder_embed_dim,
+            num_heads=self.args.encoder_attention_heads,
+            dropout=self.args.attention_dropout,
+            self_attention=True,
+            subln=self.args.subln,
+            layernorm_eps=self.args.layernorm_eps,
+            xpos_rel_pos=self.args.xpos_rel_pos,
+            xpos_scale_base=self.args.xpos_scale_base,
+            dtype=self.dtype,
+            name="self_attn",
+        )
+
+    @property
+    def alpha(self) -> float:
+        if not self.args.deepnorm:
+            return 1.0
+        if self.is_encoder_decoder:
+            return (
+                math.pow(
+                    math.pow(self.args.encoder_layers, 4) * getattr(self.args, "decoder_layers", 1),
+                    0.0625,
+                )
+                * 0.81
+            )
+        return math.pow(2.0 * self.args.encoder_layers, 0.25)
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        encoder_padding_mask: Optional[jnp.ndarray] = None,
+        attn_mask: Optional[jnp.ndarray] = None,
+        rel_pos: Optional[jnp.ndarray] = None,
+        deterministic: bool = True,
+    ):
+        args = self.args
+        if args.multiway:
+            raise NotImplementedError(
+                "multiway encoder layers land with the BEiT-3 model family"
+            )
+        ln = lambda name: nn.LayerNorm(  # noqa: E731
+            epsilon=args.layernorm_eps, dtype=self.dtype, name=name
+        )
+        if args.drop_path_rate > 0:
+            prob = float(np.linspace(0, args.drop_path_rate, args.encoder_layers)[self.depth])
+            drop_path = DropPath(prob)
+        else:
+            drop_path = None
+        dropout = nn.Dropout(args.dropout)
+
+        if attn_mask is not None:
+            attn_mask = jnp.where(attn_mask.astype(bool), -1e8, 0.0)
+
+        residual = x
+        if args.encoder_normalize_before:
+            x = ln("self_attn_layer_norm")(x)
+        x = self.build_self_attention()(
+            x,
+            x,
+            x,
+            key_padding_mask=encoder_padding_mask,
+            attn_mask=attn_mask,
+            rel_pos=rel_pos,
+            deterministic=deterministic,
+        )
+        x = dropout(x, deterministic=deterministic)
+        if drop_path is not None:
+            x = drop_path(x, deterministic=deterministic)
+        x = residual * self.alpha + x
+        if not args.encoder_normalize_before:
+            x = ln("self_attn_layer_norm")(x)
+
+        residual = x
+        if args.encoder_normalize_before:
+            x = ln("final_layer_norm")(x)
+        if not self.is_moe_layer:
+            x = FeedForwardNetwork(
+                embed_dim=args.encoder_embed_dim,
+                ffn_dim=args.encoder_ffn_embed_dim,
+                activation_fn=args.activation_fn,
+                dropout=args.dropout,
+                activation_dropout=args.activation_dropout,
+                layernorm_eps=args.layernorm_eps,
+                subln=args.subln,
+                dtype=self.dtype,
+                name="ffn",
+            )(x, deterministic=deterministic)
+            l_aux = None
+        else:
+            try:
+                from gigapath_tpu.ops.moe.moe_layer import MOELayer
+            except ImportError as e:  # pragma: no cover
+                raise NotImplementedError(
+                    "MoE layers require gigapath_tpu.ops.moe (not built yet)"
+                ) from e
+            x, l_aux = MOELayer.from_config(args, dtype=self.dtype, name="moe_layer")(
+                x, deterministic=deterministic
+            )
+        if drop_path is not None:
+            x = drop_path(x, deterministic=deterministic)
+        x = residual * self.alpha + x
+        if not args.encoder_normalize_before:
+            x = ln("final_layer_norm")(x)
+        return x, l_aux
+
+
+class Encoder(nn.Module):
+    """Encoder stack returning the reference's output dict
+    (``encoder_out`` / ``encoder_states`` / ``l_aux`` ...,
+    ``architecture/encoder.py:393-399``)."""
+
+    args: EncoderConfig
+    is_encoder_decoder: bool = False
+    dtype: Any = None
+
+    layer_cls = EncoderLayer  # subclass hook (LongNetEncoder overrides)
+
+    def build_encoder_layer(self, depth: int, is_moe_layer: bool) -> nn.Module:
+        cls = type(self).layer_cls
+        if self.args.checkpoint_activations:
+            # flax counts the module itself as arg 0, so `deterministic`
+            # (5th call arg) is static_argnums=5
+            cls = nn.remat(cls, static_argnums=(5,))
+        return cls(
+            args=self.args,
+            depth=depth,
+            is_moe_layer=is_moe_layer,
+            is_encoder_decoder=self.is_encoder_decoder,
+            dtype=self.dtype,
+            name=f"layers_{depth}",
+        )
+
+    @nn.compact
+    def __call__(
+        self,
+        src_tokens: Optional[jnp.ndarray] = None,
+        *,
+        token_embeddings: Optional[jnp.ndarray] = None,
+        encoder_padding_mask: Optional[jnp.ndarray] = None,
+        attn_mask: Optional[jnp.ndarray] = None,
+        return_all_hiddens: bool = False,
+        features_only: bool = False,
+        deterministic: bool = True,
+    ) -> Dict[str, Any]:
+        args = self.args
+        assert src_tokens is not None or token_embeddings is not None
+
+        if token_embeddings is None:
+            token_embeddings = nn.Embed(
+                args.vocab_size,
+                args.encoder_embed_dim,
+                dtype=self.dtype,
+                name="embed_tokens",
+            )(src_tokens)
+
+        if encoder_padding_mask is None:
+            encoder_padding_mask = jnp.zeros(token_embeddings.shape[:2], bool)
+
+        embed_scale = 1.0 if args.no_scale_embedding else math.sqrt(args.encoder_embed_dim)
+        x = embed = embed_scale * token_embeddings
+        if args.layernorm_embedding:
+            x = nn.LayerNorm(epsilon=args.layernorm_eps, dtype=self.dtype, name="layernorm_embedding")(x)
+        x = nn.Dropout(args.dropout)(x, deterministic=deterministic)
+        x = x * (1 - encoder_padding_mask[..., None].astype(x.dtype))
+
+        rel_pos_bias = None
+        if args.rel_pos_buckets > 0 and args.max_rel_pos > 0:
+            rel_pos_bias = RelativePositionBias(
+                num_buckets=args.rel_pos_buckets,
+                max_distance=args.max_rel_pos,
+                n_heads=args.encoder_attention_heads,
+                name="relative_position",
+            )(x.shape[0], x.shape[1], x.shape[1])
+
+        encoder_states = []
+        if return_all_hiddens:
+            encoder_states.append(x)
+
+        l_aux = []
+        moe_freq = args.moe_freq
+        for i in range(args.encoder_layers):
+            is_moe_layer = moe_freq != 0 and (i + 1) % moe_freq == 0
+            x, l_aux_i = self.build_encoder_layer(i, is_moe_layer)(
+                x,
+                encoder_padding_mask,
+                attn_mask,
+                rel_pos_bias,
+                deterministic,
+            )
+            if return_all_hiddens:
+                encoder_states.append(x)
+            l_aux.append(l_aux_i)
+
+        if args.encoder_normalize_before and args.normalize_output:
+            x = nn.LayerNorm(epsilon=args.layernorm_eps, dtype=self.dtype, name="layer_norm")(x)
+
+        if not features_only and not args.no_output_layer and args.vocab_size > 0:
+            x = nn.Dense(
+                args.vocab_size,
+                use_bias=False,
+                dtype=self.dtype,
+                kernel_init=nn.initializers.normal(args.encoder_embed_dim**-0.5),
+                name="output_projection",
+            )(x)
+
+        return {
+            "encoder_out": x,
+            "encoder_embedding": embed,
+            "encoder_padding_mask": encoder_padding_mask,
+            "encoder_states": encoder_states,
+            "l_aux": l_aux,
+        }

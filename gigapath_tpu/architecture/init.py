@@ -1,0 +1,63 @@
+"""Post-init parameter-tree transforms (BERT init, sub-LN/deepnorm scaling).
+
+Flax initialization is functional, so the reference's in-place post-init
+rescaling (``architecture/encoder.py:235-270``) becomes a pure function on
+the param tree applied by the model factories:
+
+- sub-LN: multiply ``fc1/fc2/out_proj/v_proj`` kernels by
+  ``sqrt(log(2 * L))`` (encoder) / ``sqrt(log(3 * L_dec) * log(2 * L_enc) / 3)``
+  (encoder-decoder);
+- deepnorm: divide the same kernels by ``(8 * L) ** 0.25``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict
+
+import jax
+
+_SCALED_LEAVES = ("fc1", "fc2", "out_proj", "v_proj")
+
+
+def _scale_tree(params: Dict[str, Any], factor: float) -> Dict[str, Any]:
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def transform(path, leaf):
+        names = [getattr(p, "key", str(p)) for p in path]
+        if any(n in _SCALED_LEAVES for n in names) and names[-1] == "kernel":
+            return leaf * factor
+        return leaf
+
+    treedef = jax.tree_util.tree_structure(params)
+    leaves = [transform(path, leaf) for path, leaf in flat]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def subln_init_scale(num_layers: int, is_encoder_decoder: bool = False, decoder_layers: int = 0) -> float:
+    if is_encoder_decoder:
+        return math.sqrt(math.log(3 * decoder_layers) * math.log(2 * num_layers) / 3)
+    return math.sqrt(math.log(num_layers * 2))
+
+
+def deepnorm_init_scale(num_layers: int, is_encoder_decoder: bool = False, decoder_layers: int = 0) -> float:
+    if is_encoder_decoder:
+        return math.pow(math.pow(num_layers, 4) * decoder_layers, 0.0625) / 1.15
+    return math.pow(8.0 * num_layers, 0.25)
+
+
+def apply_init_scaling(
+    params: Dict[str, Any],
+    *,
+    subln: bool,
+    deepnorm: bool,
+    num_layers: int,
+    is_encoder_decoder: bool = False,
+    decoder_layers: int = 0,
+) -> Dict[str, Any]:
+    """Apply the reference's post-init weight scaling to a flax param tree."""
+    if subln:
+        return _scale_tree(params, subln_init_scale(num_layers, is_encoder_decoder, decoder_layers))
+    if deepnorm:
+        return _scale_tree(params, 1.0 / deepnorm_init_scale(num_layers, is_encoder_decoder, decoder_layers))
+    return params

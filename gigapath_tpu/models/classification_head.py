@@ -1,0 +1,138 @@
+"""Slide-level classification head.
+
+Parity with reference ``gigapath/classification_head.py``: wraps the slide
+encoder, concatenates the selected per-layer embeddings (``feat_layer``
+"5-11" -> layers 5 and 11 of the all-layer output list), and applies a single
+linear classifier. ``feat_layer`` is parsed with int() instead of the
+reference's ``eval`` (``classification_head.py:54``).
+
+Freezing the pretrained encoder is an optimizer concern in JAX — use
+:func:`frozen_param_labels` with ``optax.multi_transform`` instead of
+``requires_grad`` mutation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from gigapath_tpu.utils.registry import create_model_from_registry
+
+
+def parse_feat_layer(feat_layer: str) -> List[int]:
+    return [int(x) for x in str(feat_layer).split("-")]
+
+
+class ClassificationHead(nn.Module):
+    input_dim: int = 1536
+    latent_dim: int = 768
+    feat_layer: str = "11"
+    n_classes: int = 2
+    model_arch: str = "gigapath_slide_enc12l768d"
+    global_pool: bool = False
+    dtype: Any = None
+    slide_kwargs: Optional[dict] = None
+
+    @nn.compact
+    def __call__(
+        self,
+        images: jnp.ndarray,
+        coords: jnp.ndarray,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        if images.ndim == 2:
+            images = images[None]
+        assert images.ndim == 3
+        layers = parse_feat_layer(self.feat_layer)
+
+        slide_encoder = create_model_from_registry(
+            self.model_arch,
+            in_chans=self.input_dim,
+            global_pool=self.global_pool,
+            dtype=self.dtype,
+            name="slide_encoder",
+            **(self.slide_kwargs or {}),
+        )
+        embeds = slide_encoder(images, coords, all_layer_embed=True, deterministic=deterministic)
+        h = jnp.concatenate([embeds[i] for i in layers], axis=-1)
+        assert h.shape[-1] == len(layers) * self.latent_dim, (
+            f"feat dim {h.shape[-1]} != {len(layers)} layers x latent_dim "
+            f"{self.latent_dim}; latent_dim must match the slide encoder width"
+        )
+        logits = nn.Dense(self.n_classes, dtype=self.dtype, name="classifier")(
+            h.reshape(-1, h.shape[-1])
+        )
+        return logits
+
+
+def frozen_param_labels(params, frozen_subtree: str = "slide_encoder"):
+    """Label tree for optax.multi_transform: 'frozen' under the encoder,
+    'trainable' elsewhere (counterpart of the reference's freeze flag,
+    ``classification_head.py:58-63``)."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree_util.tree_structure(params)
+    labels = [
+        "frozen"
+        if any(getattr(p, "key", None) == frozen_subtree for p in path)
+        else "trainable"
+        for path, _ in flat
+    ]
+    return jax.tree_util.tree_unflatten(treedef, labels)
+
+
+def get_model(
+    *,
+    input_dim: int = 1536,
+    latent_dim: int = 768,
+    feat_layer: str = "11",
+    n_classes: int = 2,
+    model_arch: str = "gigapath_slide_enc12l768d",
+    pretrained: str = "",
+    freeze: bool = False,
+    rng=None,
+    dtype: Any = None,
+    **kwargs,
+):
+    """Factory returning ``(module, params)`` with pretrained encoder weights
+    merged into the ``slide_encoder`` subtree (non-strict)."""
+    import os
+
+    from gigapath_tpu.utils.torch_convert import (
+        convert_state_dict,
+        load_torch_state_dict,
+        merge_into_params,
+    )
+
+    model = ClassificationHead(
+        input_dim=input_dim,
+        latent_dim=latent_dim,
+        feat_layer=feat_layer,
+        n_classes=n_classes,
+        model_arch=model_arch,
+        dtype=dtype,
+        slide_kwargs=kwargs or None,
+    )
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    x = jnp.zeros((1, 4, input_dim), jnp.float32)
+    coords = jnp.zeros((1, 4, 2), jnp.float32)
+    params = model.init(rng, x, coords)["params"]
+
+    if pretrained and os.path.exists(pretrained):
+        state = load_torch_state_dict(pretrained)
+        converted = convert_state_dict(state)
+        params["slide_encoder"], missing, unexpected = merge_into_params(
+            params["slide_encoder"], converted
+        )
+        print(
+            f"\033[92m Loaded pretrained slide encoder from {pretrained} "
+            f"({len(missing)} missing, {len(unexpected)} unexpected) \033[00m"
+        )
+    elif pretrained:
+        print(f"\033[93m Pretrained weights not found at {pretrained} \033[00m")
+
+    if freeze:
+        print("Freezing is applied at the optimizer: use frozen_param_labels()")
+    return model, params

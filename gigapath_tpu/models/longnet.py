@@ -1,0 +1,92 @@
+"""LongNet: encoder/decoder with dilated self-attention + factories.
+
+Parity with reference ``torchscale/model/LongNet.py``: subclasses swapping
+self-attention for DilatedAttention, and the ``make_longnet_from_name``
+factory resolving a named config from the registry and injecting
+dropout/drop-path/segment schedule.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple, Union
+
+from flax import linen as nn
+
+from gigapath_tpu.architecture.config import EncoderConfig
+from gigapath_tpu.architecture.encoder import Encoder, EncoderLayer
+from gigapath_tpu.models import longnet_config
+from gigapath_tpu.ops.dilated_attention import DilatedAttention
+
+
+class LongNetEncoderLayer(EncoderLayer):
+    def build_self_attention(self) -> nn.Module:
+        args = self.args
+        assert args.segment_length and args.dilated_ratio, (
+            "LongNet requires a segment_length/dilated_ratio schedule"
+        )
+        return DilatedAttention(
+            embed_dim=args.encoder_embed_dim,
+            num_heads=args.encoder_attention_heads,
+            dropout=args.attention_dropout,
+            self_attention=True,
+            subln=args.subln,
+            layernorm_eps=args.layernorm_eps,
+            xpos_rel_pos=args.xpos_rel_pos,
+            xpos_scale_base=args.xpos_scale_base,
+            segment_length=tuple(args.segment_length),
+            dilated_ratio=tuple(args.dilated_ratio),
+            seq_parallel=args.seq_parallel,
+            seq_axis_name=args.extras.get("seq_axis_name"),
+            seq_axis_size=args.extras.get("seq_axis_size", 1),
+            dtype=self.dtype,
+            name="self_attn",
+        )
+
+
+class LongNetEncoder(Encoder):
+    layer_cls = LongNetEncoderLayer
+
+
+def make_longnet(args) -> Tuple[LongNetEncoder, EncoderConfig]:
+    """Factory parity with reference ``make_longnet:78`` (arch name + overrides)."""
+    cfg_dict = longnet_config.get_config(args.arch)
+    if hasattr(args, "dropout"):
+        cfg_dict["dropout"] = args.dropout
+    if hasattr(args, "drop_path_rate"):
+        cfg_dict["drop_path_rate"] = args.drop_path_rate
+    cfg = EncoderConfig.from_dict(cfg_dict)
+    return LongNetEncoder(args=cfg), cfg
+
+
+def make_longnet_from_name(
+    config_name: str,
+    dilated_ratio: Union[str, list] = "[1, 2, 4, 8, 16]",
+    segment_length: Union[str, list] = "[1024, 2048, 4096, 8192, 16384]",
+    drop_path_rate: float = 0.1,
+    dropout: float = 0.1,
+    *,
+    dtype: Any = None,
+    seq_parallel: bool = False,
+    seq_axis_name: Optional[str] = None,
+    seq_axis_size: int = 1,
+    checkpoint_activations: bool = False,
+) -> Tuple[LongNetEncoder, EncoderConfig]:
+    """Build a LongNet encoder from a registry name.
+
+    Returns ``(module, config)`` — flax modules are constructed lazily, so
+    unlike the reference (which prints the param count at build,
+    ``LongNet.py:127``) parameters exist only after ``module.init``.
+    """
+    cfg_dict = longnet_config.get_config(config_name)
+    cfg_dict.update(
+        dropout=dropout,
+        drop_path_rate=drop_path_rate,
+        dilated_ratio=dilated_ratio,
+        segment_length=segment_length,
+        seq_parallel=seq_parallel,
+        checkpoint_activations=checkpoint_activations,
+    )
+    cfg = EncoderConfig.from_dict(cfg_dict)
+    cfg.extras["seq_axis_name"] = seq_axis_name
+    cfg.extras["seq_axis_size"] = seq_axis_size
+    return LongNetEncoder(args=cfg, dtype=dtype), cfg

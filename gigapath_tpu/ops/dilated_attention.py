@@ -156,6 +156,8 @@ def dilated_attention(
     attn_fn: Optional[AttnFn] = None,
     seq_axis_name: Optional[str] = None,
     seq_axis_size: int = 1,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
 ) -> jnp.ndarray:
     """Multi-branch dilated attention on [B, L, H, D] tensors -> [B, L, H, D].
 
@@ -163,17 +165,34 @@ def dilated_attention(
     jnp op; pass the Pallas flash kernel for long dense segments. When
     ``seq_axis_name`` is set (inside ``shard_map``), L is the *local* shard
     length and branches whose segment exceeds it gather K/V across the axis.
+    ``dropout_rate`` is attention-probability dropout inside each branch
+    (parity with the reference forwarding dropout to flash-attn).
     """
     if attn_fn is None:
         attn_fn = attention_with_lse
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        if attn_fn is not attention_with_lse:
+            raise NotImplementedError(
+                "attention dropout is only supported on the jnp attention path"
+            )
+        base_fn = attn_fn
+        rngs = jax.random.split(dropout_rng, len(segment_lengths))
+
+        def make_attn_fn(branch_rng):
+            return lambda *a, **kw: base_fn(
+                *a, dropout_rate=dropout_rate, dropout_rng=branch_rng, **kw
+            )
     assert len(segment_lengths) == len(dilated_ratios)
     B, L, H, Dh = q.shape
 
     outs, lses = [], []
-    for sl, r in zip(segment_lengths, dilated_ratios):
+    for i, (sl, r) in enumerate(zip(segment_lengths, dilated_ratios)):
+        branch_fn = attn_fn
+        if dropout_rate > 0.0 and dropout_rng is not None:
+            branch_fn = make_attn_fn(rngs[i])
         o, l = _dilated_branch(
             q, k, v, int(sl), int(r),
-            is_causal=is_causal, offset=offset, attn_fn=attn_fn,
+            is_causal=is_causal, offset=offset, attn_fn=branch_fn,
             seq_axis_name=seq_axis_name, seq_axis_size=seq_axis_size,
         )
         outs.append(o)
@@ -287,6 +306,9 @@ class DilatedAttention(MultiheadAttention):
         # The reference's live path ignores key_padding_mask inside dilated
         # attention (SURVEY §2.7: the collate returns a pad mask the model
         # never consumes); zero-padding keys contribute like zero-logit keys.
+        rng = None
+        if self.dropout > 0.0 and not deterministic:
+            rng = self.make_rng("dropout")
         out = dilated_attention(
             q,
             k,
@@ -297,5 +319,7 @@ class DilatedAttention(MultiheadAttention):
             attn_fn=self.attn_fn,
             seq_axis_name=self.seq_axis_name if self.seq_parallel else None,
             seq_axis_size=self.seq_axis_size if self.seq_parallel else 1,
+            dropout_rate=0.0 if deterministic else self.dropout,
+            dropout_rng=rng,
         )
         return out.reshape(out.shape[0], out.shape[1], self.embed_dim)

@@ -44,9 +44,19 @@ def flash_attention(
             and bias is None
             and q.shape[1] >= PALLAS_MIN_SEQ
             and q.shape[1] == k.shape[1]
+            and _pallas_available()
         )
     if use_pallas:
         from gigapath_tpu.ops.pallas_flash import pallas_flash_attention
 
         return pallas_flash_attention(q, k, v, is_causal=is_causal)
     return attention_with_lse(q, k, v, is_causal=is_causal, bias=bias)
+
+
+def _pallas_available() -> bool:
+    try:
+        import gigapath_tpu.ops.pallas_flash  # noqa: F401
+
+        return True
+    except ImportError:  # pragma: no cover
+        return False

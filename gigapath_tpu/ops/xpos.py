@@ -50,10 +50,12 @@ def apply_xpos(
     if downscale:
         scale = 1.0 / scale
 
-    # sinusoid over the *scale magnitudes* as in the reference
-    # (fixed_pos_embedding is fed the scale matrix, xpos_relative_position.py:54)
+    # sinusoid positions run over length+offset rows then keep the last
+    # `length`, exactly like the scale rows (reference builds sin/cos from the
+    # same sliced matrix, xpos_relative_position.py:54-60)
     inv_freq = 1.0 / (10000 ** (jnp.arange(0, scale.shape[-1]) / scale.shape[-1]))
-    sinusoid = jnp.arange(length, dtype=jnp.float32)[:, None] * inv_freq[None, :]
+    positions = jnp.arange(length + offset, dtype=jnp.float32)[-length:]
+    sinusoid = positions[:, None] * inv_freq[None, :]
     sin = _duplicate_interleave(jnp.sin(sinusoid) * scale)
     cos = _duplicate_interleave(jnp.cos(sinusoid) * scale)
 

@@ -1,0 +1,26 @@
+"""Model registry: named architecture factories.
+
+Replaces the reference's dependency on timm's global ``@register_model``
+registry (``slide_encoder.py:255-270``) with a small explicit one.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+MODEL_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_model(fn: Callable) -> Callable:
+    MODEL_REGISTRY[fn.__name__] = fn
+    return fn
+
+
+def create_model_from_registry(arch: str, **kwargs):
+    if arch not in MODEL_REGISTRY:
+        raise KeyError(f"unknown model arch {arch!r}; known: {sorted(MODEL_REGISTRY)}")
+    return MODEL_REGISTRY[arch](**kwargs)
+
+
+def list_models() -> List[str]:
+    return sorted(MODEL_REGISTRY)

@@ -1,0 +1,142 @@
+"""Torch-checkpoint -> flax param-tree conversion with non-strict reporting.
+
+Counterpart of the reference's ``load_state_dict(strict=False)`` +
+missing/unexpected key printout (``gigapath/slide_encoder.py:236-248``),
+plus the actual tensor-layout translation a cross-framework load needs
+(Linear kernels transpose, LayerNorm weight->scale).
+
+torch is only needed to *read* ``.pth`` files (CPU); the converted tree is
+pure numpy/jax and all model code is torch-free.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def _to_numpy(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        return t.detach().cpu().float().numpy()
+    return np.asarray(t)
+
+
+def load_torch_state_dict(path: str) -> Dict[str, Any]:
+    """Read a torch checkpoint file; unwraps the common ``{"model": ...}``."""
+    import torch
+
+    state = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(state, dict) and "model" in state and all(
+        hasattr(v, "shape") for v in state["model"].values()
+    ):
+        state = state["model"]
+    if isinstance(state, dict) and "model_state_dict" in state:
+        state = state["model_state_dict"]
+    return state
+
+
+def convert_torch_entry(key: str, value) -> Tuple[Tuple[str, ...], np.ndarray]:
+    """Map one ``a.b.weight``-style torch key to a flax param path + array.
+
+    Rules:
+    - ``*.weight`` on a 2-D tensor -> ``(*, kernel)`` transposed (torch Linear
+      stores [out, in], flax Dense [in, out]);
+    - ``*.weight`` on a 1-D tensor -> ``(*, scale)`` (LayerNorm/RMSNorm);
+    - ``*.weight`` on a 4-D tensor -> ``(*, kernel)`` in HWIO (conv patch
+      embeds; torch stores OIHW);
+    - ``*.bias`` -> ``(*, bias)``; everything else keeps its name
+      (cls_token, pos_embed, ...).
+    """
+    parts = key.split(".")
+    arr = _to_numpy(value)
+    leaf = parts[-1]
+    if leaf == "weight":
+        if arr.ndim == 2:
+            return tuple(parts[:-1] + ["kernel"]), arr.T
+        if arr.ndim == 4:
+            return tuple(parts[:-1] + ["kernel"]), arr.transpose(2, 3, 1, 0)
+        return tuple(parts[:-1] + ["scale"]), arr
+    if leaf == "bias":
+        return tuple(parts[:-1] + ["bias"]), arr
+    return tuple(parts), arr
+
+
+def convert_state_dict(
+    state_dict: Dict[str, Any], skip_prefixes: Tuple[str, ...] = ("pos_embed",)
+) -> Dict[Tuple[str, ...], np.ndarray]:
+    """Convert a full torch state dict to ``{flax path: array}``.
+
+    ``pos_embed`` buffers are skipped by default: the TPU model computes
+    sincos embeddings on the fly (:mod:`gigapath_tpu.ops.pos_embed`).
+    """
+    out = {}
+    for key, value in state_dict.items():
+        if any(key.startswith(p) for p in skip_prefixes):
+            continue
+        # torch ModuleList indexing `layers.0.` -> flax submodule `layers_0.`
+        key = re.sub(r"\blayers\.(\d+)\b", r"layers_\1", key)
+        # fairscale checkpoint_wrapper leaves a `_checkpoint_wrapped_module.`
+        # segment in checkpoints saved with activation checkpointing on
+        key = key.replace("_checkpoint_wrapped_module.", "")
+        path, arr = convert_torch_entry(key, value)
+        out[path] = arr
+    return out
+
+
+def _flatten(tree: Dict[str, Any], prefix=()) -> Dict[Tuple[str, ...], Any]:
+    flat = {}
+    for k, v in tree.items():
+        if isinstance(v, dict):
+            flat.update(_flatten(v, prefix + (k,)))
+        else:
+            flat[prefix + (k,)] = v
+    return flat
+
+
+def _unflatten(flat: Dict[Tuple[str, ...], Any]) -> Dict[str, Any]:
+    tree: Dict[str, Any] = {}
+    for path, v in flat.items():
+        node = tree
+        for k in path[:-1]:
+            node = node.setdefault(k, {})
+        node[path[-1]] = v
+    return tree
+
+
+def merge_into_params(
+    params: Dict[str, Any],
+    converted: Dict[Tuple[str, ...], np.ndarray],
+    *,
+    strict: bool = False,
+) -> Tuple[Dict[str, Any], List[str], List[str]]:
+    """Non-strict merge: returns (new_params, missing_keys, unexpected_keys).
+
+    Shape mismatches are treated as unexpected (reported, not loaded), which
+    is the practical behavior of the reference's non-strict torch load.
+    """
+    flat = _flatten(params)
+    missing = [".".join(p) for p in flat if p not in converted]
+    unexpected = []
+    new_flat = dict(flat)
+    for path, arr in converted.items():
+        if path not in flat:
+            unexpected.append(".".join(path))
+            continue
+        if tuple(flat[path].shape) != tuple(arr.shape):
+            unexpected.append(
+                ".".join(path) + f" (shape {arr.shape} vs {tuple(flat[path].shape)})"
+            )
+            continue
+        new_flat[path] = arr.astype(np.asarray(flat[path]).dtype)
+    if strict and (missing or unexpected):
+        raise ValueError(f"strict load failed; missing={missing}, unexpected={unexpected}")
+    for k in missing:
+        logger.warning("Missing %s", k)
+    for k in unexpected:
+        logger.warning("Unexpected %s", k)
+    return _unflatten(new_flat), missing, unexpected

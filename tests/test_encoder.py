@@ -1,0 +1,141 @@
+"""Encoder stack, configs, LongNet registry."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gigapath_tpu.architecture.config import EncoderConfig
+from gigapath_tpu.architecture.encoder import Encoder
+from gigapath_tpu.architecture.init import apply_init_scaling, subln_init_scale
+from gigapath_tpu.models import longnet_config
+from gigapath_tpu.models.longnet import make_longnet_from_name
+
+
+def test_config_parsing_and_invariants():
+    cfg = EncoderConfig(segment_length="[512, 1024]", dilated_ratio="[1, 2]")
+    assert cfg.segment_length == [512, 1024]
+    assert cfg.dilated_ratio == [1, 2]
+    assert cfg.subln and cfg.encoder_normalize_before and not cfg.deepnorm
+
+    cfg2 = EncoderConfig(deepnorm=True, subln=False)
+    assert not cfg2.encoder_normalize_before and not cfg2.subln
+
+
+def test_config_rejects_code_injection():
+    with pytest.raises((ValueError, SyntaxError)):
+        EncoderConfig(segment_length="__import__('os').getcwd()")
+
+
+def test_config_from_dict_tolerates_registry_extras():
+    cfg = EncoderConfig.from_dict(longnet_config.get_config("LongNet_test"))
+    assert cfg.encoder_layers == 1
+    assert "block_shift" in cfg.extras  # dead key, tolerated like the reference
+
+
+def test_registry_has_all_reference_configs():
+    names = longnet_config.list_configs()
+    assert len(names) == 22
+    assert "LongNet_12_layers_768_dim" in names
+    c = longnet_config.get_config("LongNet_12_layers_768_dim")
+    assert c["encoder_ffn_embed_dim"] == 3072 and c["encoder_attention_heads"] == 16
+    v = longnet_config.get_config("LongNet_Vanilla_12_layers_256_dim")
+    assert v["segment_length"] == "[10000000]" and v["encoder_attention_heads"] == 8
+
+
+def test_plain_encoder_forward(rng):
+    cfg = EncoderConfig(
+        encoder_layers=2, encoder_embed_dim=32, encoder_ffn_embed_dim=64,
+        encoder_attention_heads=4, dropout=0.0,
+    )
+    enc = Encoder(args=cfg)
+    x = jnp.asarray(rng.normal(size=(2, 10, 32)), jnp.float32)
+    params = enc.init(jax.random.PRNGKey(0), token_embeddings=x)
+    out = enc.apply(params, token_embeddings=x, return_all_hiddens=True)
+    assert out["encoder_out"].shape == (2, 10, 32)
+    assert len(out["encoder_states"]) == 3  # input + 2 layers
+    assert len(out["l_aux"]) == 2
+
+
+def test_padding_mask_zeroes_inputs(rng):
+    cfg = EncoderConfig(
+        encoder_layers=1, encoder_embed_dim=16, encoder_ffn_embed_dim=32,
+        encoder_attention_heads=2,
+    )
+    enc = Encoder(args=cfg)
+    x = jnp.asarray(rng.normal(size=(1, 6, 16)), jnp.float32)
+    mask = jnp.array([[False, False, False, False, True, True]])
+    params = enc.init(jax.random.PRNGKey(0), token_embeddings=x)
+    out_masked = enc.apply(params, token_embeddings=x, encoder_padding_mask=mask)
+    x_zeroed = x.at[:, 4:].set(0.0)
+    out_zeroed = enc.apply(params, token_embeddings=x_zeroed, encoder_padding_mask=mask)
+    np.testing.assert_allclose(
+        np.asarray(out_masked["encoder_out"]), np.asarray(out_zeroed["encoder_out"]), atol=1e-5
+    )
+
+
+def test_longnet_from_name_small(rng):
+    enc, cfg = make_longnet_from_name("LongNet_test", dropout=0.0, drop_path_rate=0.0)
+    assert cfg.encoder_layers == 1 and cfg.encoder_embed_dim == 192
+    x = jnp.asarray(rng.normal(size=(1, 20, 192)), jnp.float32)
+    params = enc.init(jax.random.PRNGKey(0), token_embeddings=x)
+    out = enc.apply(params, token_embeddings=x)
+    assert out["encoder_out"].shape == (1, 20, 192)
+    assert np.isfinite(np.asarray(out["encoder_out"])).all()
+
+
+def test_longnet_remat_matches_plain(rng):
+    x = jnp.asarray(rng.normal(size=(1, 12, 192)), jnp.float32)
+    enc, _ = make_longnet_from_name("LongNet_test", dropout=0.0, drop_path_rate=0.0)
+    params = enc.init(jax.random.PRNGKey(0), token_embeddings=x)
+    out = enc.apply(params, token_embeddings=x)["encoder_out"]
+    enc_ckpt, _ = make_longnet_from_name(
+        "LongNet_test", dropout=0.0, drop_path_rate=0.0, checkpoint_activations=True
+    )
+    out_ckpt = enc_ckpt.apply(params, token_embeddings=x)["encoder_out"]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_ckpt), atol=1e-5)
+
+
+def test_remat_with_dropout_traces(rng):
+    """checkpoint_activations + dropout>0 must not hit TracerBoolConversion
+    (deterministic is a static arg under nn.remat)."""
+    enc, _ = make_longnet_from_name(
+        "LongNet_test", dropout=0.3, drop_path_rate=0.1, checkpoint_activations=True
+    )
+    x = jnp.asarray(rng.normal(size=(1, 12, 192)), jnp.float32)
+    params = enc.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        token_embeddings=x, deterministic=False,
+    )
+    out = enc.apply(
+        params, token_embeddings=x, deterministic=False,
+        rngs={"dropout": jax.random.PRNGKey(2)},
+    )
+    assert np.isfinite(np.asarray(out["encoder_out"])).all()
+
+
+def test_dilated_attention_dropout_active(rng):
+    """attention dropout in the dilated path changes outputs at train time."""
+    from gigapath_tpu.ops.dilated_attention import DilatedAttention
+
+    mod = DilatedAttention(
+        embed_dim=32, num_heads=4, dropout=0.5,
+        segment_length=(8,), dilated_ratio=(1,),
+    )
+    x = jnp.asarray(rng.normal(size=(1, 16, 32)), jnp.float32)
+    params = mod.init(jax.random.PRNGKey(0), x, x, x)
+    out_eval = mod.apply(params, x, x, x, deterministic=True)
+    out_train = mod.apply(
+        params, x, x, x, deterministic=False, rngs={"dropout": jax.random.PRNGKey(3)}
+    )
+    assert not np.allclose(np.asarray(out_eval), np.asarray(out_train))
+
+
+def test_subln_init_scaling():
+    params = {"layers_0": {"ffn": {"fc1": {"kernel": jnp.ones((2, 2)), "bias": jnp.ones(2)}},
+                           "self_attn": {"q_proj": {"kernel": jnp.ones((2, 2))}}}}
+    scaled = apply_init_scaling(params, subln=True, deepnorm=False, num_layers=12)
+    s = subln_init_scale(12)
+    np.testing.assert_allclose(scaled["layers_0"]["ffn"]["fc1"]["kernel"], s)
+    np.testing.assert_allclose(scaled["layers_0"]["ffn"]["fc1"]["bias"], 1.0)  # bias untouched
+    np.testing.assert_allclose(scaled["layers_0"]["self_attn"]["q_proj"]["kernel"], 1.0)

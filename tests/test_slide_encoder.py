@@ -1,0 +1,147 @@
+"""LongNetViT slide encoder, factory, checkpoint conversion, classification head."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gigapath_tpu.models import slide_encoder
+from gigapath_tpu.models.classification_head import (
+    ClassificationHead,
+    frozen_param_labels,
+    get_model,
+    parse_feat_layer,
+)
+from gigapath_tpu.models.slide_encoder import LongNetViT, get_optimal_segment_length
+from gigapath_tpu.utils.registry import MODEL_REGISTRY
+
+
+SMALL = dict(
+    embed_dim=192, depth=1, slide_ngrids=100, segment_length=[512, 1024, 2048],
+    dilated_ratio="[1, 2, 4]", dropout=0.0, drop_path_rate=0.0,
+)
+
+
+def _small_vit(**kw):
+    return LongNetViT(in_chans=64, **{**SMALL, **kw})
+
+
+def test_optimal_segment_length_matches_reference_formula():
+    # reference slide_encoder.py:137-154: linspace in log2 from 1024 to
+    # int(log2((max_wsi/tile)^2)), 5 points, floored to int
+    assert get_optimal_segment_length(262144, 256) == [1024, 5792, 32768, 185363, 1048576]
+    # run_panda.sh MAX_WSI_SIZE=250000 -> top segment 2^19
+    sched = get_optimal_segment_length(250000, 256)
+    assert sched[0] == 1024 and sched[-1] == 524288 and len(sched) == 5
+    assert sched == sorted(sched)
+
+
+def test_registry_archs_present():
+    for arch in ["gigapath_slide_enc12l768d", "gigapath_slide_enc24l1024d", "gigapath_slide_enc12l1536d"]:
+        assert arch in MODEL_REGISTRY
+
+
+def test_forward_shapes(rng):
+    model = _small_vit()
+    x = jnp.asarray(rng.normal(size=(2, 17, 64)), jnp.float32)
+    coords = jnp.asarray(rng.integers(0, 100 * 256, size=(2, 17, 2)), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x, coords)["params"]
+    outs = model.apply({"params": params}, x, coords)
+    assert len(outs) == 1 and outs[0].shape == (2, 192)
+    outs_all = model.apply({"params": params}, x, coords, all_layer_embed=True)
+    assert len(outs_all) == 2  # embedding + 1 layer
+    assert all(o.shape == (2, 192) for o in outs_all)
+
+
+def test_global_pool_differs_from_cls(rng):
+    x = jnp.asarray(rng.normal(size=(1, 9, 64)), jnp.float32)
+    coords = jnp.zeros((1, 9, 2), jnp.float32)
+    m1 = _small_vit(global_pool=False)
+    params = m1.init(jax.random.PRNGKey(0), x, coords)["params"]
+    m2 = _small_vit(global_pool=True)
+    o1 = m1.apply({"params": params}, x, coords)[0]
+    o2 = m2.apply({"params": params}, x, coords)[0]
+    assert not np.allclose(np.asarray(o1), np.asarray(o2))
+
+
+def test_create_model_random_init(capsys):
+    model, params = slide_encoder.create_model(
+        "", "gigapath_slide_enc12l768d", in_chans=1536,
+        segment_length=[512], dilated_ratio="[1]", slide_ngrids=100,
+    )
+    n_params = sum(np.prod(p.shape) for p in jax.tree_util.tree_leaves(params))
+    # ~86M params for the 12l/768d flagship (SURVEY §6)
+    assert 80e6 < n_params < 95e6
+
+
+def test_torch_checkpoint_roundtrip(tmp_path, rng):
+    """Save a reference-shaped torch state dict, convert, verify merge."""
+    import torch
+
+    model = _small_vit()
+    x = jnp.asarray(rng.normal(size=(1, 5, 64)), jnp.float32)
+    coords = jnp.zeros((1, 5, 2), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x, coords)["params"]
+
+    D, F = 192, 192  # LongNet_test-ish dims for depth-1 192d
+    state = {
+        "cls_token": torch.randn(1, 1, D),
+        "pos_embed": torch.zeros(1, 100 * 100 + 1, D),  # must be skipped
+        "patch_embed.proj.weight": torch.randn(D, 64),
+        "patch_embed.proj.bias": torch.randn(D),
+        "norm.weight": torch.ones(D),
+        "norm.bias": torch.zeros(D),
+        "encoder.layer_norm.weight": torch.ones(D),
+        "encoder.layer_norm.bias": torch.zeros(D),
+    }
+    for proj in ["q_proj", "k_proj", "v_proj", "out_proj"]:
+        state[f"encoder.layers.0.self_attn.{proj}.weight"] = torch.randn(D, D)
+        state[f"encoder.layers.0.self_attn.{proj}.bias"] = torch.randn(D)
+    state["encoder.layers.0.self_attn.inner_attn_ln.weight"] = torch.ones(D)
+    state["encoder.layers.0.self_attn.inner_attn_ln.bias"] = torch.zeros(D)
+    for ln in ["self_attn_layer_norm", "final_layer_norm"]:
+        state[f"encoder.layers.0.{ln}.weight"] = torch.ones(D)
+        state[f"encoder.layers.0.{ln}.bias"] = torch.zeros(D)
+    state["encoder.layers.0.ffn.fc1.weight"] = torch.randn(768, D)
+    state["encoder.layers.0.ffn.fc1.bias"] = torch.randn(768)
+    state["encoder.layers.0.ffn.fc2.weight"] = torch.randn(D, 768)
+    state["encoder.layers.0.ffn.fc2.bias"] = torch.randn(D)
+    state["encoder.layers.0.ffn.ffn_layernorm.weight"] = torch.ones(768)
+    state["encoder.layers.0.ffn.ffn_layernorm.bias"] = torch.zeros(768)
+
+    from gigapath_tpu.utils.torch_convert import convert_state_dict, merge_into_params
+
+    converted = convert_state_dict(state)  # handles layers.0 -> layers_0
+    new_params, missing, unexpected = merge_into_params(params, converted)
+    # ffn dims differ in the tiny test model (192 vs 768) -> those are reported
+    assert not any("pos_embed" in u for u in unexpected)
+    # the loaded q_proj kernel is the transpose of the torch weight
+    w = state["encoder.layers.0.self_attn.q_proj.weight"].numpy()
+    np.testing.assert_allclose(
+        np.asarray(new_params["encoder"]["layers_0"]["self_attn"]["q_proj"]["kernel"]), w.T
+    )
+
+
+def test_parse_feat_layer():
+    assert parse_feat_layer("5-11") == [5, 11]
+    assert parse_feat_layer("11") == [11]
+
+
+def test_classification_head_forward(rng):
+    head = ClassificationHead(
+        input_dim=64, latent_dim=192, feat_layer="0-1", n_classes=3,
+        model_arch="gigapath_slide_enc12l768d",
+        slide_kwargs=dict(
+            embed_dim=192, depth=1, slide_ngrids=50,
+            segment_length=[256], dilated_ratio="[1]", dropout=0.0, drop_path_rate=0.0,
+        ),
+    )
+    # model_arch registry fn overrides embed_dim/depth via kwargs... use direct module
+    x = jnp.asarray(rng.normal(size=(1, 7, 64)), jnp.float32)
+    coords = jnp.zeros((1, 7, 2), jnp.float32)
+    params = head.init(jax.random.PRNGKey(0), x, coords)["params"]
+    logits = head.apply({"params": params}, x, coords)
+    assert logits.shape == (1, 3)
+    labels = frozen_param_labels(params)
+    flat = jax.tree_util.tree_leaves(labels)
+    assert "frozen" in flat and "trainable" in flat
