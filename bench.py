@@ -1,0 +1,64 @@
+"""Headline benchmark: PANDA-scale slide embedding throughput on one chip.
+
+Runs the flagship slide encoder (gigapath_slide_enc12l768d, 86M params,
+5-branch dilated attention) forward over N=10240 tile embeddings — the
+"PANDA slide-embed wallclock" north star from BASELINE.md — in bf16 under
+jit, and reports tokens/sec.
+
+Timing: iterations are chained inside one jitted fori_loop with a forced
+data dependency and two loop counts are differenced, because the axon tunnel
+makes per-call host timing meaningless (see gigapath_tpu/utils/timing.py).
+
+vs_baseline: the reference publishes no numbers (BASELINE.md), so the
+denominator is an analytic estimate of the reference stack on its stated
+hardware (1x A100, fp16 autocast, flash-attn): forward cost ~2*86e6*N +
+dilated-attention ~0.2 TFLOP => ~2.0 TFLOP per 10240-token slide; A100 fp16
+at a generous 35% MFU => ~109 TFLOPS => ~18.3 ms/slide => ~5.6e5 tokens/s.
+
+Prints exactly one JSON line.
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+
+A100_REF_TOKENS_PER_SEC = 5.6e5  # analytic; see module docstring
+
+N = 10240
+
+
+def main():
+    from gigapath_tpu.models import slide_encoder
+    from gigapath_tpu.utils.timing import chained_seconds_per_iter
+
+    model, params = slide_encoder.create_model(
+        "", "gigapath_slide_enc12l768d", in_chans=1536, dtype=jnp.bfloat16
+    )
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(1, N, 1536)), jnp.bfloat16)
+    coords = jnp.asarray(rng.uniform(0, 250000, (1, N, 2)), jnp.float32)
+
+    def step(x, params, coords):
+        out = model.apply({"params": params}, x, coords)[0]  # [1, 768]
+        # feed a (numerically negligible) function of the output back into
+        # the input so the loop body cannot be hoisted out of fori_loop
+        return x + (out.sum() * 1e-30).astype(x.dtype)
+
+    sec_per_iter, overhead = chained_seconds_per_iter(step, x, args=(params, coords))
+    tokens_per_sec = N / sec_per_iter
+
+    print(
+        json.dumps(
+            {
+                "metric": "slide_embed_tokens_per_sec",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(tokens_per_sec / A100_REF_TOKENS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+from gigapath_tpu.models import slide_encoder  # noqa: F401  (registers archs)

@@ -7,8 +7,9 @@ TPU-native counterpart of reference
   into segments of ``min(sl, L)``; within a segment, heads are partitioned
   into ``r`` phase groups and head group ``p`` attends only positions
   ``p, p+r, ...`` (the reference implements this as a head-rotating
-  einops-diagonal trick, ``dense_to_sparse:16-31``; here it is a static
-  per-head gather that XLA turns into cheap strided loads).
+  einops-diagonal trick, ``dense_to_sparse:16-31``; here it is a scatter-free
+  one-hot einsum select — TPU gathers/scatters over the token axis are slow,
+  a phase-mask contraction is a cheap VPU multiply-add).
 - Attention runs per sparse segment through an op returning ``(out, lse)``.
 - Branch outputs are scattered back to dense positions (uncovered positions
   get ``lse = NEG_INF``) and fused by softmax-weighting of the LSEs across
@@ -83,20 +84,27 @@ def _head_phases(num_heads: int, ratio: int) -> jnp.ndarray:
     return jnp.arange(num_heads) // heads_per_group
 
 
+def _phase_onehot(num_heads: int, ratio: int, dtype) -> jnp.ndarray:
+    """[ratio, H] one-hot: entry (p, h) = 1 iff head h has phase p."""
+    phases = _head_phases(num_heads, ratio)
+    return (phases[None, :] == jnp.arange(ratio)[:, None]).astype(dtype)
+
+
 def dense_to_sparse(x: jnp.ndarray, ratio: int) -> jnp.ndarray:
     """Dilated subsample of segments: [b, g, H, D] -> [b, m, H, D], m=ceil(g/r).
 
-    Head ``h`` keeps positions ``phase(h) + r*j``.
+    Head ``h`` keeps positions ``phase(h) + r*j``. Implemented as a one-hot
+    einsum select (a VPU multiply-add) rather than a gather — TPU scatters /
+    gathers over the token axis are far slower than this contraction.
     """
     if ratio == 1:
         return x
     b, g, H, Dh = x.shape
     x = _pad_to_multiple(x, ratio, axis=1)
     m = x.shape[1] // ratio
-    idx = _head_phases(H, ratio)[:, None] + ratio * jnp.arange(m)[None, :]  # [H, m]
-    xt = x.transpose(0, 2, 1, 3)  # [b, H, gp, D]
-    out = jnp.take_along_axis(xt, idx[None, :, :, None], axis=2)
-    return out.transpose(0, 2, 1, 3)
+    x5 = x.reshape(b, m, ratio, H, Dh)
+    onehot = _phase_onehot(H, ratio, x.dtype)  # [r, H]
+    return jnp.einsum("bmrhd,rh->bmhd", x5, onehot)
 
 
 def sparse_to_dense(
@@ -106,19 +114,18 @@ def sparse_to_dense(
 
     ``out_s`` [b, m, H, D], ``lse_s`` [b, H, m] -> (out [b, g, H, D],
     lse [b, H, g]) with uncovered positions zero / NEG_INF, so they get zero
-    weight in the cross-branch softmax fusion.
+    weight in the cross-branch softmax fusion. Scatter-free: the inverse
+    one-hot broadcast of :func:`dense_to_sparse`.
     """
     b, m, H, Dh = out_s.shape
     if ratio == 1:
         return out_s[:, :seg_len], lse_s[..., :seg_len]
-    gp = m * ratio
-    idx = _head_phases(H, ratio)[:, None] + ratio * jnp.arange(m)[None, :]  # [H, m]
-    heads = jnp.arange(H)[:, None]
-    out_d = jnp.zeros((b, H, gp, Dh), out_s.dtype)
-    out_d = out_d.at[:, heads, idx, :].set(out_s.transpose(0, 2, 1, 3))
-    lse_d = jnp.full((b, H, gp), NEG_INF, lse_s.dtype)
-    lse_d = lse_d.at[:, heads, idx].set(lse_s)
-    return out_d.transpose(0, 2, 1, 3)[:, :seg_len], lse_d[..., :seg_len]
+    onehot = _phase_onehot(H, ratio, out_s.dtype)  # [r, H]
+    out_d = jnp.einsum("bmhd,rh->bmrhd", out_s, onehot).reshape(b, m * ratio, H, Dh)
+    oh_t = _phase_onehot(H, ratio, lse_s.dtype).T  # [H, r]
+    lse_d = lse_s[:, :, :, None] * oh_t[None, :, None, :] + NEG_INF * (1.0 - oh_t[None, :, None, :])
+    lse_d = lse_d.reshape(b, H, m * ratio)
+    return out_d[:, :seg_len], lse_d[..., :seg_len]
 
 
 def _gather_kv_seq_parallel(

@@ -1,0 +1,84 @@
+"""Device mesh construction — the single distributed-backend primitive.
+
+The reference's entire communication surface (NCCL process groups, custom
+all-gather/reduce-scatter autograd functions, MoE all-to-all groups —
+SURVEY §2.6/§5.8) maps to one ``jax.sharding.Mesh`` with named axes:
+
+- ``data``   — batch / ZeRO parameter sharding (DP group, ``component/utils.py:13``)
+- ``seq``    — sequence/context parallelism (``dilated_attention.gather_kv``)
+- ``model``  — tensor parallelism over hidden/head dims (absent in the
+  reference; free on TPU via GSPMD)
+- ``expert`` — MoE expert parallelism (``xmoe/global_groups.py``)
+
+Collectives ride ICI when the mesh is built over a physical slice; XLA
+inserts them from sharding annotations (GSPMD), so there is no hand-written
+communication code outside shard_map regions.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+MESH_AXES = ("data", "seq", "model", "expert")
+
+
+def factorize(n: int, axes: Sequence[str]) -> Dict[str, int]:
+    """Spread ``n`` devices over axes, preferring seq > data > model.
+
+    Long-context is first-class: sequence parallelism gets devices first
+    (the slide encoder's token count dwarfs batch size), then data, then
+    tensor parallelism.
+    """
+    sizes = {a: 1 for a in axes}
+    remaining = n
+    order = [a for a in ("seq", "data", "model", "expert") if a in axes]
+    i = 0
+    while remaining > 1 and order:
+        axis = order[i % len(order)]
+        if remaining % 2 == 0:
+            sizes[axis] *= 2
+            remaining //= 2
+        else:
+            sizes[axis] *= remaining
+            remaining = 1
+        i += 1
+    return sizes
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    *,
+    axis_sizes: Optional[Dict[str, int]] = None,
+    axes: Sequence[str] = ("data", "seq"),
+    devices=None,
+) -> Mesh:
+    """Build a mesh over the first ``n_devices`` (default: all)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    if axis_sizes is None:
+        axis_sizes = factorize(n, axes)
+    else:
+        axes = tuple(axis_sizes.keys())
+    shape = tuple(axis_sizes[a] for a in axes)
+    assert int(np.prod(shape)) == n, f"mesh {axis_sizes} != {n} devices"
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, tuple(axes))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shard_batch_seq(mesh: Mesh, batch_axis: str = "data", seq_axis: str = "seq") -> NamedSharding:
+    """Sharding for [B, L, ...] activations: batch over data, tokens over seq."""
+    names = mesh.axis_names
+    spec = [batch_axis if batch_axis in names else None,
+            seq_axis if seq_axis in names else None]
+    return NamedSharding(mesh, PartitionSpec(*spec))

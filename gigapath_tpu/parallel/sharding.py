@@ -1,0 +1,55 @@
+"""Parameter/activation sharding rules (GSPMD annotations).
+
+Tensor-parallel layout for the transformer stack: attention and FFN kernels
+split over the ``model`` axis (column-parallel fc1/q/k/v, row-parallel
+fc2/out_proj), everything else replicated; optional ZeRO-style sharding of
+the largest replicated kernels over ``data``. XLA inserts the matching
+collectives — this file contains *only* layout decisions, no communication
+code. (The reference has no TP at all, SURVEY §2.6; FSDP maps to the ZeRO
+rule here.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# leaf module name -> (spec for `kernel`); biases/scales stay replicated
+_COLUMN_PARALLEL = ("q_proj", "k_proj", "v_proj", "fc1", "gate")
+_ROW_PARALLEL = ("out_proj", "fc2")
+
+
+def param_spec(path_names, leaf, *, model_axis: str = "model") -> P:
+    """PartitionSpec for one parameter, by its module path."""
+    if path_names and path_names[-1] == "kernel" and hasattr(leaf, "ndim") and leaf.ndim == 2:
+        owner = path_names[-2] if len(path_names) >= 2 else ""
+        if owner in _COLUMN_PARALLEL:
+            return P(None, model_axis)
+        if owner in _ROW_PARALLEL:
+            return P(model_axis, None)
+    return P()
+
+
+def param_shardings(params: Dict[str, Any], mesh: Mesh) -> Dict[str, Any]:
+    """NamedSharding tree for a param tree under ``mesh``.
+
+    If the mesh has no ``model`` axis (or size 1), everything is replicated —
+    the rules degrade gracefully to pure DP/SP meshes.
+    """
+    has_model = "model" in mesh.axis_names and mesh.shape["model"] > 1
+
+    def one(path, leaf):
+        names = [getattr(p, "key", str(p)) for p in path]
+        spec = param_spec(names, leaf) if has_model else P()
+        return NamedSharding(mesh, spec)
+
+    flat = jax.tree_util.tree_flatten_with_path(params)
+    leaves = [one(path, leaf) for path, leaf in flat[0]]
+    return jax.tree_util.tree_unflatten(jax.tree_util.tree_structure(params), leaves)
+
+
+def apply_shardings(params: Dict[str, Any], mesh: Mesh) -> Dict[str, Any]:
+    """device_put the param tree with its sharding rules."""
+    return jax.device_put(params, param_shardings(params, mesh))

@@ -1,0 +1,64 @@
+"""SPMD training-step builders over a named mesh.
+
+One ``jit``-compiled train step, sharded by annotation only: batch over
+``data``, tokens over ``seq``, tensor-parallel kernels over ``model``
+(:mod:`gigapath_tpu.parallel.sharding`). Gradient all-reduce over ``data``
+is inserted by XLA — the explicit NCCL choreography of the reference
+(SURVEY §5.8) has no counterpart here by design.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray, task: str = "multi_class") -> jnp.ndarray:
+    if task == "multi_label":
+        return optax.sigmoid_binary_cross_entropy(logits, labels).mean()
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def make_train_step(
+    model,
+    optimizer: optax.GradientTransformation,
+    *,
+    task: str = "multi_class",
+    loss_fn: Optional[Callable] = None,
+) -> Callable:
+    """Returns ``train_step(params, opt_state, batch, rng) ->
+    (params, opt_state, loss)`` for a classification model taking
+    ``(images, coords)``. Pure and jittable; shard by device_put-ing the
+    inputs with NamedShardings and wrapping in ``jax.jit``."""
+
+    def _loss(params, batch: Dict[str, Any], rng):
+        logits = model.apply(
+            {"params": params},
+            batch["images"],
+            batch["coords"],
+            deterministic=False,
+            rngs={"dropout": rng},
+        )
+        if loss_fn is not None:
+            return loss_fn(logits, batch["labels"])
+        return cross_entropy_loss(logits, batch["labels"], task)
+
+    def train_step(params, opt_state, batch, rng):
+        loss, grads = jax.value_and_grad(_loss)(params, batch, rng)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step
+
+
+def make_eval_step(model) -> Callable:
+    def eval_step(params, batch):
+        return model.apply(
+            {"params": params}, batch["images"], batch["coords"], deterministic=True
+        )
+
+    return eval_step

@@ -1,0 +1,59 @@
+"""Device-time measurement that survives async/remote dispatch.
+
+On this image the TPU is reached through a tunnel where
+``block_until_ready`` returns before execution finishes and every host
+fetch costs ~100 ms round-trip, so per-call wall timing is useless. The
+robust recipe: run the op N times *inside one jitted fori_loop* with a
+forced cross-iteration data dependency (so XLA cannot hoist the body), fetch
+one scalar, and difference two loop counts to cancel the fixed round-trip
+overhead.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def chained_seconds_per_iter(
+    step: Callable[..., jnp.ndarray],
+    x0: jnp.ndarray,
+    *,
+    args: Tuple = (),
+    iters_low: int = 2,
+    iters_high: int = 12,
+    repeats: int = 2,
+) -> Tuple[float, float]:
+    """Median seconds/iter of ``step(carry, *args) -> carry``.
+
+    Returns ``(sec_per_iter, overhead_sec)``. Pass model params and other
+    large arrays via ``args`` — NOT by closing over them: closure constants
+    get serialized into the (size-limited) remote-compile request.
+    """
+
+    def chain(x, extra, n):
+        def body(_, carry):
+            return step(carry, *extra)
+
+        return jax.lax.fori_loop(0, n, body, x).sum()
+
+    lo = jax.jit(lambda x, extra: chain(x, extra, iters_low))
+    hi = jax.jit(lambda x, extra: chain(x, extra, iters_high))
+    float(lo(x0, args))  # compile
+    float(hi(x0, args))
+
+    def timed(f):
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            float(f(x0, args))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_lo, t_hi = timed(lo), timed(hi)
+    per_iter = (t_hi - t_lo) / (iters_high - iters_low)
+    overhead = t_lo - iters_low * per_iter
+    return max(per_iter, 1e-9), overhead
