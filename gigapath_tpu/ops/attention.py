@@ -34,6 +34,7 @@ def attention_with_lse(
     scale: Optional[float] = None,
     bias: Optional[jnp.ndarray] = None,
     key_padding_mask: Optional[jnp.ndarray] = None,
+    kv_valid_len=None,
     is_causal: bool = False,
     dropout_rate: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
@@ -46,6 +47,9 @@ def attention_with_lse(
     - ``bias``: additive logits bias broadcastable to ``[B, H, Lq, Lk]``
       (T5 relative-position bias or a pre-built attn_mask).
     - ``key_padding_mask``: ``[B, Lk]`` bool, True = padding.
+    - ``kv_valid_len``: static [B, H] per-(batch, head) valid key counts
+      (keys at index >= count are masked) — same contract as the Pallas
+      kernel's ragged masking.
     - ``is_causal``: lower-triangular mask (query i attends keys <= i).
     """
     B, Lq, H, D = q.shape
@@ -59,6 +63,11 @@ def attention_with_lse(
 
     if bias is not None:
         logits = logits + bias.astype(jnp.float32)
+    if kv_valid_len is not None:
+        import numpy as np
+
+        lens = jnp.asarray(np.asarray(kv_valid_len, np.int32))[:, :, None, None]
+        logits = jnp.where(jnp.arange(Lk)[None, None, None, :] >= lens, NEG_INF, logits)
     if key_padding_mask is not None:
         logits = jnp.where(key_padding_mask[:, None, None, :], NEG_INF, logits)
     if is_causal:

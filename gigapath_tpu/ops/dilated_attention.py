@@ -41,27 +41,28 @@ from gigapath_tpu.ops.attention import NEG_INF, MultiheadAttention, attention_wi
 AttnFn = Callable[..., Tuple[jnp.ndarray, jnp.ndarray]]
 
 
-def _kv_validity_bias(
-    n_seg: int, seg_len: int, ratio: int, m: int, num_heads: int, real_len: int
+def _kv_valid_lengths(
+    batch: int, n_seg: int, seg_len: int, ratio: int, m: int, num_heads: int, real_len: int
 ) -> Optional[np.ndarray]:
-    """Static additive bias masking sparse key slots that fall beyond the
-    real sequence (zero-padding introduced by segmenting/dilation).
+    """Static per-(batch*segment, head) count of sparse key slots that fall
+    inside the real sequence (zero-padding from segmenting/dilation is
+    excluded).
 
     The reference lets zero-pad keys participate in the softmax
     (``dense_to_sparse`` pads with zeros and flash attention sees them as
     logit-0 keys); masking them instead is strictly better math at segment
-    tails. Returns ``[n_seg, H, 1, m]`` or None when everything is valid.
-    All inputs are trace-time constants, so this is free under jit.
+    tails. Returns ``[batch*n_seg, H]`` int or None when everything is
+    valid. All inputs are trace-time constants, so this is free under jit.
     """
     heads_per_group = -(-num_heads // ratio)
     phases = np.arange(num_heads) // heads_per_group  # [H]
-    seg = np.arange(n_seg)[:, None, None]
-    j = np.arange(m)[None, None, :]
-    abs_pos = seg * seg_len + phases[None, :, None] + ratio * j  # [n, H, m]
-    invalid = abs_pos >= real_len
-    if not invalid.any():
+    seg = np.arange(n_seg)[:, None]
+    # valid j satisfy seg*g + phase + ratio*j < real_len
+    counts = np.ceil((real_len - seg * seg_len - phases[None, :]) / ratio)
+    counts = np.clip(counts, 0, m).astype(np.int32)  # [n_seg, H]
+    if (counts == m).all():
         return None
-    return np.where(invalid, NEG_INF, 0.0).astype(np.float32)[:, :, None, :]
+    return np.tile(counts, (batch, 1))  # [batch*n_seg, H]
 
 
 def _pad_to_multiple(x: jnp.ndarray, mult: int, axis: int) -> jnp.ndarray:
@@ -175,14 +176,22 @@ def dilated_attention(
     ``dropout_rate`` is attention-probability dropout inside each branch
     (parity with the reference forwarding dropout to flash-attn).
     """
-    if attn_fn is None:
-        attn_fn = attention_with_lse
+    attn_fn_was_default = attn_fn is None
+    if attn_fn_was_default:
+        from gigapath_tpu.ops.flash_attention import flash_attention
+
+        attn_fn = flash_attention
     if dropout_rate > 0.0 and dropout_rng is not None:
-        if attn_fn is not attention_with_lse:
+        # attention-probability dropout requires materialized probs; the
+        # default dispatcher is swapped for the jnp path (all gigapath
+        # configs train with attention_dropout=0, so the flash kernel stays
+        # on the hot path). An explicitly-supplied attn_fn is never silently
+        # replaced.
+        if not attn_fn_was_default:
             raise NotImplementedError(
-                "attention dropout is only supported on the jnp attention path"
+                "attention dropout is not supported with a custom attn_fn"
             )
-        base_fn = attn_fn
+        base_fn = attention_with_lse
         rngs = jax.random.split(dropout_rng, len(segment_lengths))
 
         def make_attn_fn(branch_rng):
@@ -260,18 +269,16 @@ def _dilated_branch(
     ks = dense_to_sparse(kp, r)
     vs = dense_to_sparse(vp, r)
 
-    bias = None
+    kv_valid_len = None
     if gather_kv:
         ks = _gather_kv_seq_parallel(ks, sl, k.shape[1], seq_axis_name)
         vs = _gather_kv_seq_parallel(vs, sl, k.shape[1], seq_axis_name)
     else:
-        np_bias = _kv_validity_bias(
-            kp.shape[0] // B, g_k, r, ks.shape[1], H, k.shape[1]
+        kv_valid_len = _kv_valid_lengths(
+            B, kp.shape[0] // B, g_k, r, ks.shape[1], H, k.shape[1]
         )
-        if np_bias is not None:
-            bias = jnp.tile(jnp.asarray(np_bias), (B, 1, 1, 1))
 
-    out_s, lse_s = attn_fn(qs, ks, vs, is_causal=is_causal, bias=bias)
+    out_s, lse_s = attn_fn(qs, ks, vs, is_causal=is_causal, kv_valid_len=kv_valid_len)
 
     out_d, lse_d = sparse_to_dense(out_s, lse_s, r, g_q)
     out = out_d.reshape(B, n_seg * g_q, H, Dh)

@@ -18,7 +18,7 @@ from gigapath_tpu.ops.attention import attention_with_lse
 
 # Segments at least this long route to the Pallas kernel on TPU by default:
 # below it, XLA's fused dense attention is faster than paying kernel overhead.
-PALLAS_MIN_SEQ = 1024
+PALLAS_MIN_SEQ = 512
 
 
 def _on_tpu() -> bool:
@@ -35,22 +35,28 @@ def flash_attention(
     *,
     is_causal: bool = False,
     bias: Optional[jnp.ndarray] = None,
+    kv_valid_len=None,
     use_pallas: Optional[bool] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Attention on [B, L, H, D] returning ``(out [B,L,H,D], lse [B,H,L])``."""
+    """Attention on [B, L, H, D] returning ``(out [B,L,H,D], lse [B,H,L])``.
+
+    ``kv_valid_len``: static [B, H] valid-key counts (ragged tail masking);
+    supported by both the Pallas kernel and the jnp fallback.
+    """
     if use_pallas is None:
         use_pallas = (
             _on_tpu()
             and bias is None
             and q.shape[1] >= PALLAS_MIN_SEQ
-            and q.shape[1] == k.shape[1]
             and _pallas_available()
         )
     if use_pallas:
         from gigapath_tpu.ops.pallas_flash import pallas_flash_attention
 
-        return pallas_flash_attention(q, k, v, is_causal=is_causal)
-    return attention_with_lse(q, k, v, is_causal=is_causal, bias=bias)
+        return pallas_flash_attention(q, k, v, is_causal=is_causal, kv_len=kv_valid_len)
+    return attention_with_lse(
+        q, k, v, is_causal=is_causal, bias=bias, kv_valid_len=kv_valid_len
+    )
 
 
 def _pallas_available() -> bool:
