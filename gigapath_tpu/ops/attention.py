@@ -63,11 +63,13 @@ def attention_with_lse(
 
     if bias is not None:
         logits = logits + bias.astype(jnp.float32)
+    kv_mask = None
     if kv_valid_len is not None:
         import numpy as np
 
         lens = jnp.asarray(np.asarray(kv_valid_len, np.int32))[:, :, None, None]
-        logits = jnp.where(jnp.arange(Lk)[None, None, None, :] >= lens, NEG_INF, logits)
+        kv_mask = jnp.arange(Lk)[None, None, None, :] >= lens
+        logits = jnp.where(kv_mask, NEG_INF, logits)
     if key_padding_mask is not None:
         logits = jnp.where(key_padding_mask[:, None, None, :], NEG_INF, logits)
     if is_causal:
@@ -77,6 +79,10 @@ def attention_with_lse(
 
     lse = jax.scipy.special.logsumexp(logits, axis=-1)  # [B, H, Lq]
     probs = jnp.exp(logits - lse[..., None])
+    if kv_mask is not None:
+        # rows with zero valid keys yield out=0, not a mean over masked slots
+        # (matches the Pallas kernel's explicit zeroing)
+        probs = jnp.where(kv_mask, 0.0, probs)
 
     if dropout_rate > 0.0 and dropout_rng is not None:
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)

@@ -70,7 +70,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref, m_ref, l_ref, ac
     m_prev = m_ref[:, :1]
     l_prev = l_ref[:, :1]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    p = jnp.exp(s - m_new)
+    # explicit zero at masked slots: rows with no valid keys produce out=0
+    # (not a spurious mean of masked values) and zero backward flow
+    p = jnp.where(mask, 0.0, jnp.exp(s - m_new))
     alpha = jnp.exp(m_prev - m_new)
     l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
     acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(

@@ -66,7 +66,12 @@ def test_kv_len_ragged_masking(rng, lens):
     out_p, lse_p = flash(q, k, v, kv_len=kv)
     out_j, lse_j = attention_with_lse(q, k, v, kv_valid_len=kv)
     np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_j), atol=2e-5, rtol=1e-4)
-    np.testing.assert_allclose(np.asarray(lse_p), np.asarray(lse_j), atol=2e-4, rtol=1e-4)
+    # lse is implementation-defined (~NEG_INF scale) on zero-valid rows;
+    # both paths give such rows ~zero weight in the dilated branch fusion
+    nonempty = (kv > 0)[:, :, None] * np.ones((B, H, L), bool)
+    np.testing.assert_allclose(
+        np.asarray(lse_p)[nonempty], np.asarray(lse_j)[nonempty], atol=2e-4, rtol=1e-4
+    )
 
     def loss_p(q, k, v):
         o, _ = flash(q, k, v, kv_len=kv)
