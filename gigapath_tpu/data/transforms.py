@@ -1,0 +1,59 @@
+"""Host-side tile image transforms for the tile encoder.
+
+Numpy/PIL counterpart of reference ``load_tile_encoder_transforms``
+(``gigapath/pipeline.py:106-115``): resize shorter side to 256 (bicubic),
+center-crop 224, scale to [0,1], ImageNet-normalize. Host preprocessing is
+CPU work feeding ``jax.device_put``; kept torch-free.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from gigapath_tpu.models.tile_encoder import IMAGENET_MEAN, IMAGENET_STD
+
+
+def resize_shorter_side(img, size: int = 256):
+    """PIL resize so the shorter side equals ``size`` (torchvision
+    ``Resize(256)`` semantics), bicubic."""
+    from PIL import Image
+
+    w, h = img.size
+    if w <= h:
+        new_w, new_h = size, max(1, round(h * size / w))
+    else:
+        new_w, new_h = max(1, round(w * size / h)), size
+    return img.resize((new_w, new_h), Image.BICUBIC)
+
+
+def center_crop(arr: np.ndarray, size: int = 224) -> np.ndarray:
+    """Center-crop an [H, W, C] array (torchvision ``CenterCrop`` rounding)."""
+    h, w = arr.shape[:2]
+    top = int(round((h - size) / 2.0))
+    left = int(round((w - size) / 2.0))
+    return arr[top : top + size, left : left + size]
+
+
+def normalize(
+    arr: np.ndarray,
+    mean: Sequence[float] = IMAGENET_MEAN,
+    std: Sequence[float] = IMAGENET_STD,
+) -> np.ndarray:
+    return (arr - np.asarray(mean, np.float32)) / np.asarray(std, np.float32)
+
+
+def preprocess_tile(img) -> np.ndarray:
+    """PIL image (or uint8 [H, W, 3] array) -> float32 [224, 224, 3], the
+    tile encoder's expected NHWC input (channels-last; the reference feeds
+    torch NCHW, same values)."""
+    from PIL import Image
+
+    if isinstance(img, np.ndarray):
+        img = Image.fromarray(img)
+    img = img.convert("RGB")
+    img = resize_shorter_side(img, 256)
+    arr = np.asarray(img, np.float32) / 255.0
+    arr = center_crop(arr, 224)
+    return normalize(arr).astype(np.float32)

@@ -70,8 +70,10 @@ def attention_with_lse(
         lens = jnp.asarray(np.asarray(kv_valid_len, np.int32))[:, :, None, None]
         kv_mask = jnp.arange(Lk)[None, None, None, :] >= lens
         logits = jnp.where(kv_mask, NEG_INF, logits)
+    pad_mask = None
     if key_padding_mask is not None:
-        logits = jnp.where(key_padding_mask[:, None, None, :], NEG_INF, logits)
+        pad_mask = key_padding_mask[:, None, None, :]
+        logits = jnp.where(pad_mask, NEG_INF, logits)
     if is_causal:
         qi = jnp.arange(Lq)[:, None] + (Lk - Lq)  # align ends when Lq != Lk
         ki = jnp.arange(Lk)[None, :]
@@ -83,6 +85,10 @@ def attention_with_lse(
         # rows with zero valid keys yield out=0, not a mean over masked slots
         # (matches the Pallas kernel's explicit zeroing)
         probs = jnp.where(kv_mask, 0.0, probs)
+    if pad_mask is not None:
+        # same zeroing for key_padding_mask: a fully-padded row otherwise
+        # degenerates to uniform probs (mean of V) instead of zeros
+        probs = jnp.where(pad_mask, 0.0, probs)
 
     if dropout_rate > 0.0 and dropout_rng is not None:
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)

@@ -199,6 +199,13 @@ def dilated_attention(
                 *a, dropout_rate=dropout_rate, dropout_rng=branch_rng, **kw
             )
     assert len(segment_lengths) == len(dilated_ratios)
+    if offset > 0 and q.shape[1] != k.shape[1]:
+        # queries and keys are segmented independently, so Lq != Lk with a
+        # nonzero offset produces mismatched segment counts inside attn_fn
+        raise NotImplementedError(
+            "incremental decoding (offset > 0) requires Lq == Lk; pad q/k to "
+            "a common length (the encoder path uses offset=0)"
+        )
     B, L, H, Dh = q.shape
 
     outs, lses = [], []

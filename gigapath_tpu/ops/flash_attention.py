@@ -50,6 +50,13 @@ def flash_attention(
             and q.shape[1] >= PALLAS_MIN_SEQ
             and _pallas_available()
         )
+    elif use_pallas and bias is not None:
+        # the Pallas kernel takes no bias; silently dropping it would produce
+        # wrong attention output for an explicit override
+        raise ValueError(
+            "use_pallas=True is incompatible with a non-None bias; "
+            "use the jnp path (use_pallas=False) for biased attention"
+        )
     if use_pallas:
         from gigapath_tpu.ops.pallas_flash import pallas_flash_attention
 

@@ -12,11 +12,19 @@ replacement for that CUDA dependency:
   over K blocks, loop Q) — recomputing probabilities from the saved LSE
   rather than storing the attention matrix.
 
-Notes:
-- no gradient flows through the LSE output (its only consumer, the dilated
-  branch fusion, stop-gradients it — same contract as flash-attn CUDA);
+Performance notes (v5e measurements in scripts/profile_slide.py):
+- kernels index the natural ``[B, L, H, D]`` layout directly via BlockSpec
+  (grid dims for batch and head), so no head-transpose passes over HBM are
+  paid on either side of the call;
+- the softmax scale is folded into the small q block (``block_q x D``
+  elements) instead of the ``block_q x block_k`` logits — the inner loop is
+  VPU-bound, so per-logit ops are what matter;
+- masked slots rely on exp underflow instead of a second ``where``: the
+  running max is floored at ``M_FLOOR`` so ``exp(NEG_INF - m)`` is exactly
+  0.0 in fp32, which also makes fully-masked rows produce out=0 and
+  lse ~ -1e20 (ignored by the branch fusion) without extra per-element work;
 - head_dim is NOT padded: a block whose last dim equals the full array dim
-  satisfies TPU tiling, and padding 48 -> 128 lanes would waste 2.7x MXU
+  satisfies TPU tiling, and padding 64 -> 128 lanes would waste 2x MXU
   work on the contractions;
 - sequence length is zero-padded to the block size with padded *keys masked*
   in every kernel; ragged per-(batch,head) key counts (``kv_len``) are
@@ -26,15 +34,18 @@ Notes:
 from __future__ import annotations
 
 import functools
-import math
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+# Floor for the running softmax max: low enough to never clip real logits,
+# high enough that exp(NEG_INF - M_FLOOR) == 0.0 exactly in fp32.
+M_FLOOR = -1e20
 LANES = 128
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 1024
@@ -46,22 +57,24 @@ def _round_up(n: int, m: int) -> int:
 
 def _fwd_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                 *, scale, causal, block_q, block_k):
-    i, j = pl.program_id(1), pl.program_id(2)
+    b, h = pl.program_id(0), pl.program_id(1)
+    i, j = pl.program_id(2), pl.program_id(3)
 
     @pl.when(j == 0)
     def _init():
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        m_ref[:] = jnp.full_like(m_ref, M_FLOOR)
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0]
-    k = k_ref[0]
+    # scale folded into q: block_q*D elements instead of block_q*block_k
+    q = (q_ref[0, :, 0, :].astype(jnp.float32) * scale).astype(q_ref.dtype)
+    k = k_ref[0, :, 0, :]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale  # (BQ, BK)
+    )  # (BQ, BK)
 
     cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
-    mask = cols >= kvlen_ref[pl.program_id(0), 0]
+    mask = cols >= kvlen_ref[b, h]
     if causal:
         rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
         mask = jnp.logical_or(mask, cols > rows)
@@ -69,257 +82,252 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref, m_ref, l_ref, ac
 
     m_prev = m_ref[:, :1]
     l_prev = l_ref[:, :1]
+    # M_FLOOR keeps m_new finite even for fully-masked rows, so
+    # exp(NEG_INF - m_new) underflows to exactly 0 — no second where needed
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    # explicit zero at masked slots: rows with no valid keys produce out=0
-    # (not a spurious mean of masked values) and zero backward flow
-    p = jnp.where(mask, 0.0, jnp.exp(s - m_new))
+    p = jnp.exp(s - m_new)
     alpha = jnp.exp(m_prev - m_new)
     l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
     acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+        p.astype(v_ref.dtype), v_ref[0, :, 0, :], (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
     m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
     l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    @pl.when(j == pl.num_programs(2) - 1)
+    @pl.when(j == pl.num_programs(3) - 1)
     def _finalize():
         l = l_ref[:, :1]
         safe_l = jnp.maximum(l, 1e-30)
-        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        o_ref[0, :, 0, :] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
         # lse carried at LANES width (TPU tiling needs a 128-lane last dim);
         # the wrapper slices lane 0
-        lse_ref[0] = jnp.broadcast_to(
-            m_ref[:, :1] + jnp.log(safe_l), (q_ref.shape[1], LANES)
+        lse_ref[0, 0] = jnp.broadcast_to(
+            m_ref[:, :1] + jnp.log(safe_l), (block_q, LANES)
         )
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dq_ref, dq_acc,
                *, scale, causal, block_q, block_k):
-    i, j = pl.program_id(1), pl.program_id(2)
+    b, h = pl.program_id(0), pl.program_id(1)
+    i, j = pl.program_id(2), pl.program_id(3)
 
     @pl.when(j == 0)
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    q = q_ref[0]
-    k = k_ref[0]
+    q = q_ref[0, :, 0, :]
+    k = k_ref[0, :, 0, :]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
     cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
-    mask = cols >= kvlen_ref[pl.program_id(0), 0]
+    mask = cols >= kvlen_ref[b, h]
     if causal:
         rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
         mask = jnp.logical_or(mask, cols > rows)
-    p = jnp.where(mask, 0.0, jnp.exp(s - lse_ref[0][:, :1]))
+    p = jnp.where(mask, 0.0, jnp.exp(s - lse_ref[0, 0][:, :1]))
 
     dp = jax.lax.dot_general(
-        do_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
+        do_ref[0, :, 0, :].astype(jnp.float32), v_ref[0, :, 0, :].astype(jnp.float32),
         (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
     )
-    ds = p * (dp - delta_ref[0][:, :1])
+    ds = p * (dp - delta_ref[0, 0][:, :1])
     dq_acc[:] += jax.lax.dot_general(
         ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * scale
 
-    @pl.when(j == pl.num_programs(2) - 1)
+    @pl.when(j == pl.num_programs(3) - 1)
     def _finalize():
-        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+        dq_ref[0, :, 0, :] = dq_acc[:].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dk_ref, dv_ref,
                 dk_acc, dv_acc, *, scale, causal, block_q, block_k):
-    j, i = pl.program_id(1), pl.program_id(2)  # grid: (BH, nk, nq)
+    b, h = pl.program_id(0), pl.program_id(1)
+    j, i = pl.program_id(2), pl.program_id(3)  # grid: (B, H, nk, nq)
 
     @pl.when(i == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    q = q_ref[0]
-    k = k_ref[0]
+    q = q_ref[0, :, 0, :]
+    k = k_ref[0, :, 0, :]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale  # (BQ, BK)
     cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
-    mask = cols >= kvlen_ref[pl.program_id(0), 0]
+    mask = cols >= kvlen_ref[b, h]
     if causal:
         rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
         mask = jnp.logical_or(mask, cols > rows)
-    p = jnp.where(mask, 0.0, jnp.exp(s - lse_ref[0][:, :1]))  # (BQ, BK)
+    p = jnp.where(mask, 0.0, jnp.exp(s - lse_ref[0, 0][:, :1]))  # (BQ, BK)
 
-    do = do_ref[0].astype(jnp.float32)
+    do = do_ref[0, :, 0, :].astype(jnp.float32)
     dv_acc[:] += jax.lax.dot_general(
         p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )  # (BK, D)
     dp = jax.lax.dot_general(
-        do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+        do, v_ref[0, :, 0, :].astype(jnp.float32), (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )  # (BQ, BK)
-    ds = p * (dp - delta_ref[0][:, :1])
+    ds = p * (dp - delta_ref[0, 0][:, :1])
     dk_acc[:] += jax.lax.dot_general(
         ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * scale  # (BK, D)
 
-    @pl.when(i == pl.num_programs(2) - 1)
+    @pl.when(i == pl.num_programs(3) - 1)
     def _finalize():
-        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
-        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+        dk_ref[0, :, 0, :] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, :, 0, :] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _pad(x: jnp.ndarray, L: int, D: int) -> jnp.ndarray:
-    return jnp.pad(x, ((0, 0), (0, L - x.shape[1]), (0, D - x.shape[2])))
+def _pad_seq(x: jnp.ndarray, L: int) -> jnp.ndarray:
+    """Zero-pad [B, L0, H, D] to length L on axis 1."""
+    if x.shape[1] == L:
+        return x
+    return jnp.pad(x, ((0, 0), (0, L - x.shape[1]), (0, 0), (0, 0)))
 
 
-def _kvlen_array(kv_lens, BH: int, Lk: int) -> jnp.ndarray:
-    """[BH, 1] int32 valid-key counts from a static tuple (None = all valid)."""
-    import numpy as np
-
+def _kvlen_array(kv_lens, B: int, H: int, Lk: int) -> jnp.ndarray:
+    """[B, H] int32 valid-key counts from a static tuple (None = all valid)."""
     if kv_lens is None:
-        arr = np.full((BH, 1), Lk, np.int32)
+        arr = np.full((B, H), Lk, np.int32)
     else:
-        arr = np.asarray(kv_lens, np.int32).reshape(BH, 1)
+        arr = np.asarray(kv_lens, np.int32).reshape(B, H)
     return jnp.asarray(arr)
 
 
-def _fwd_impl(q3, k3, v3, kv_lens, causal, scale, block_q, block_k, interpret):
-    BH, Lq, D = q3.shape
-    Lk = k3.shape[1]
+def _fwd_impl(q, k, v, kv_lens, causal, scale, block_q, block_k, interpret):
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
     block_q = min(block_q, _round_up(Lq, LANES))
     block_k = min(block_k, _round_up(Lk, LANES))
     Lqp, Lkp = _round_up(Lq, block_q), _round_up(Lk, block_k)
-    # block last dim == full array last dim satisfies TPU tiling, so the
-    # head dim is NOT padded to 128 (padding wastes 2.7x MXU work at D=48)
-    Dp = D
-    qp, kp, vp = _pad(q3, Lqp, Dp), _pad(k3, Lkp, Dp), _pad(v3, Lkp, Dp)
+    qp, kp, vp = _pad_seq(q, Lqp), _pad_seq(k, Lkp), _pad_seq(v, Lkp)
     nq, nk = Lqp // block_q, Lkp // block_k
-    kvlen = _kvlen_array(kv_lens, BH, Lk)
+    kvlen = _kvlen_array(kv_lens, B, H, Lk)
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k,
     )
-    kvlen_spec = pl.BlockSpec(memory_space=pltpu.SMEM)  # whole (BH,1) array; indexed by program_id
+    q_spec = pl.BlockSpec((1, block_q, 1, D), lambda b, h, i, j: (b, i, h, 0), memory_space=pltpu.VMEM)
+    k_spec = pl.BlockSpec((1, block_k, 1, D), lambda b, h, i, j: (b, j, h, 0), memory_space=pltpu.VMEM)
+    kvlen_spec = pl.BlockSpec(memory_space=pltpu.SMEM)  # whole (B,H) array; indexed by program_id
     out, lse = pl.pallas_call(
         kernel,
-        grid=(BH, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, Dp), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, Dp), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, Dp), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
-            kvlen_spec,
-        ],
+        grid=(B, H, nq, nk),
+        in_specs=[q_spec, k_spec, k_spec, kvlen_spec],
         out_specs=[
-            pl.BlockSpec((1, block_q, Dp), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
+            q_spec,
+            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, i, j: (b, h, i, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, Lqp, Dp), q3.dtype),
-            jax.ShapeDtypeStruct((BH, Lqp, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((B, Lqp, H, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Lqp, LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, LANES), jnp.float32),
             pltpu.VMEM((block_q, LANES), jnp.float32),
-            pltpu.VMEM((block_q, Dp), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
         ],
         interpret=interpret,
     )(qp, kp, vp, kvlen)
-    return out[:, :Lq, :D], lse[:, :Lq, 0]
+    return out[:, :Lq], lse[:, :, :Lq, 0]
 
 
-def _bwd_impl(q3, k3, v3, lse, delta, do3, kv_lens, causal, scale, block_q, block_k, interpret):
-    BH, Lq, D = q3.shape
-    Lk = k3.shape[1]
+def _bwd_impl(q, k, v, lse, delta, do, kv_lens, causal, scale, block_q, block_k, interpret):
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
     block_q = min(block_q, _round_up(Lq, LANES))
     block_k = min(block_k, _round_up(Lk, LANES))
     Lqp, Lkp = _round_up(Lq, block_q), _round_up(Lk, block_k)
-    # block last dim == full array last dim satisfies TPU tiling, so the
-    # head dim is NOT padded to 128 (padding wastes 2.7x MXU work at D=48)
-    Dp = D
-    qp, kp, vp = _pad(q3, Lqp, Dp), _pad(k3, Lkp, Dp), _pad(v3, Lkp, Dp)
-    dop = _pad(do3, Lqp, Dp)
+    qp, kp, vp = _pad_seq(q, Lqp), _pad_seq(k, Lkp), _pad_seq(v, Lkp)
+    dop = _pad_seq(do, Lqp)
     # lse/delta carried at LANES width for TPU tiling; padded q rows get
     # lse=0, which is harmless (their p rows multiply masked ds/do = 0)
     lsep = jnp.broadcast_to(
-        jnp.pad(lse, ((0, 0), (0, Lqp - Lq)))[..., None], (BH, Lqp, LANES)
+        jnp.pad(lse, ((0, 0), (0, 0), (0, Lqp - Lq)))[..., None], (B, H, Lqp, LANES)
     )
     deltap = jnp.broadcast_to(
-        jnp.pad(delta, ((0, 0), (0, Lqp - Lq)))[..., None], (BH, Lqp, LANES)
+        jnp.pad(delta, ((0, 0), (0, 0), (0, Lqp - Lq)))[..., None], (B, H, Lqp, LANES)
     )
     nq, nk = Lqp // block_q, Lkp // block_k
-    kvlen = _kvlen_array(kv_lens, BH, Lk)
+    kvlen = _kvlen_array(kv_lens, B, H, Lk)
 
-    q_spec_i = pl.BlockSpec((1, block_q, Dp), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM)
-    k_spec_j = pl.BlockSpec((1, block_k, Dp), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM)
-    vec_spec_i = pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM)
-    kvlen_spec = pl.BlockSpec(memory_space=pltpu.SMEM)  # whole (BH,1) array; indexed by program_id
+    q_spec = pl.BlockSpec((1, block_q, 1, D), lambda b, h, i, j: (b, i, h, 0), memory_space=pltpu.VMEM)
+    k_spec = pl.BlockSpec((1, block_k, 1, D), lambda b, h, i, j: (b, j, h, 0), memory_space=pltpu.VMEM)
+    vec_spec = pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, i, j: (b, h, i, 0), memory_space=pltpu.VMEM)
+    kvlen_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
 
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k,
         ),
-        grid=(BH, nq, nk),
-        in_specs=[q_spec_i, k_spec_j, k_spec_j, q_spec_i, vec_spec_i, vec_spec_i, kvlen_spec],
-        out_specs=[q_spec_i],
-        out_shape=[jax.ShapeDtypeStruct((BH, Lqp, Dp), q3.dtype)],
-        scratch_shapes=[pltpu.VMEM((block_q, Dp), jnp.float32)],
+        grid=(B, H, nq, nk),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, vec_spec, vec_spec, kvlen_spec],
+        out_specs=[q_spec],
+        out_shape=[jax.ShapeDtypeStruct((B, Lqp, H, D), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
     )(qp, kp, vp, dop, lsep, deltap, kvlen)[0]
 
-    # grid (BH, nk, nq): index maps see (b, j, i)
-    q_spec_kv = pl.BlockSpec((1, block_q, Dp), lambda b, j, i: (b, i, 0), memory_space=pltpu.VMEM)
-    k_spec_kv = pl.BlockSpec((1, block_k, Dp), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM)
-    vec_spec_kv = pl.BlockSpec((1, block_q, LANES), lambda b, j, i: (b, i, 0), memory_space=pltpu.VMEM)
-    kvlen_spec_kv = pl.BlockSpec(memory_space=pltpu.SMEM)
+    # grid (B, H, nk, nq): index maps see (b, h, j, i)
+    q_spec_kv = pl.BlockSpec((1, block_q, 1, D), lambda b, h, j, i: (b, i, h, 0), memory_space=pltpu.VMEM)
+    k_spec_kv = pl.BlockSpec((1, block_k, 1, D), lambda b, h, j, i: (b, j, h, 0), memory_space=pltpu.VMEM)
+    vec_spec_kv = pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, j, i: (b, h, i, 0), memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k,
         ),
-        grid=(BH, nk, nq),
-        in_specs=[q_spec_kv, k_spec_kv, k_spec_kv, q_spec_kv, vec_spec_kv, vec_spec_kv, kvlen_spec_kv],
+        grid=(B, H, nk, nq),
+        in_specs=[q_spec_kv, k_spec_kv, k_spec_kv, q_spec_kv, vec_spec_kv, vec_spec_kv, kvlen_spec],
         out_specs=[k_spec_kv, k_spec_kv],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, Lkp, Dp), k3.dtype),
-            jax.ShapeDtypeStruct((BH, Lkp, Dp), v3.dtype),
+            jax.ShapeDtypeStruct((B, Lkp, H, D), k.dtype),
+            jax.ShapeDtypeStruct((B, Lkp, H, D), v.dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_k, Dp), jnp.float32),
-            pltpu.VMEM((block_k, Dp), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
         ],
         interpret=interpret,
     )(qp, kp, vp, dop, lsep, deltap, kvlen)
-    return dq[:, :Lq, :D], dk[:, :Lk, :D], dv[:, :Lk, :D]
+    return dq[:, :Lq], dk[:, :Lk], dv[:, :Lk]
 
 
-def _flash_fwd_rule(q3, k3, v3, kv_lens, causal, interpret):
-    scale = q3.shape[-1] ** -0.5
+def _flash_fwd_rule(q, k, v, kv_lens, causal, interpret):
+    scale = q.shape[-1] ** -0.5
     out, lse = _fwd_impl(
-        q3, k3, v3, kv_lens, causal, scale, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, interpret
+        q, k, v, kv_lens, causal, scale, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, interpret
     )
-    return (out, lse), (q3, k3, v3, out, lse)
+    return (out, lse), (q, k, v, out, lse)
 
 
 def _flash_bwd_rule(kv_lens, causal, interpret, res, cotangents):
-    q3, k3, v3, out, lse = res
-    do3, _dlse = cotangents  # no gradient flows through the lse output
-    scale = q3.shape[-1] ** -0.5
-    delta = jnp.sum(do3.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    q, k, v, out, lse = res
+    do, _dlse = cotangents  # no gradient flows through the lse output
+    scale = q.shape[-1] ** -0.5
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    ).transpose(0, 2, 1)  # [B, H, Lq]
     dq, dk, dv = _bwd_impl(
-        q3, k3, v3, lse, delta, do3, kv_lens, causal, scale,
+        q, k, v, lse, delta, do, kv_lens, causal, scale,
         DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, interpret,
     )
     return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_with_lse(q3, k3, v3, kv_lens, causal, interpret):
+def _flash_with_lse(q, k, v, kv_lens, causal, interpret):
     out, lse = _fwd_impl(
-        q3, k3, v3, kv_lens, causal, q3.shape[-1] ** -0.5,
+        q, k, v, kv_lens, causal, q.shape[-1] ** -0.5,
         DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, interpret,
     )
     return out, lse
@@ -344,16 +352,7 @@ def pallas_flash_attention(
     trace-time constants (numpy, not traced arrays).
     """
     B, Lq, H, D = q.shape
-    Lk = k.shape[1]
     kv_lens = None
     if kv_len is not None:
-        import numpy as np
-
         kv_lens = tuple(int(x) for x in np.asarray(kv_len).reshape(B * H))
-    to3 = lambda x, L: x.transpose(0, 2, 1, 3).reshape(B * H, L, D)  # noqa: E731
-    out3, lse3 = _flash_with_lse(
-        to3(q, Lq), to3(k, Lk), to3(v, Lk), kv_lens, is_causal, interpret
-    )
-    out = out3.reshape(B, H, Lq, D).transpose(0, 2, 1, 3)
-    lse = lse3.reshape(B, H, Lq)
-    return out, lse
+    return _flash_with_lse(q, k, v, kv_lens, is_causal, interpret)

@@ -1,0 +1,97 @@
+"""Component-level timing of the slide-encoder hot path on the local chip.
+
+Times (with the chained-fori_loop recipe from utils/timing.py):
+  1. full flagship slide-encoder forward at N tokens
+  2. the 5-branch dilated-attention op alone (x1; the model runs 12)
+  3. each dilated branch alone
+  4. a matmul-only proxy of one encoder layer's GEMMs (qkvo + ffn)
+
+Usage: python scripts/profile_slide.py [N]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gigapath_tpu.utils.timing import chained_seconds_per_iter
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 10240
+D, H, HD, FFN = 768, 12, 64, 3072
+SEGS = [1024, 5792, 32768, 185363, 1048576]
+RATIOS = [1, 2, 4, 8, 16]
+
+
+def timeit(name, step, x0, args=()):
+    sec, _ = chained_seconds_per_iter(step, x0, args=args)
+    print(f"{name:40s} {sec*1e3:9.3f} ms")
+    return sec
+
+
+def main():
+    rng = np.random.default_rng(0)
+
+    # 1. full model
+    from gigapath_tpu.models import slide_encoder
+
+    model, params = slide_encoder.create_model(
+        "", "gigapath_slide_enc12l768d", in_chans=1536, dtype=jnp.bfloat16
+    )
+    x = jnp.asarray(rng.normal(size=(1, N, 1536)), jnp.bfloat16)
+    coords = jnp.asarray(rng.uniform(0, 250000, (1, N, 2)), jnp.float32)
+
+    def full_step(x, params, coords):
+        out = model.apply({"params": params}, x, coords)[0]
+        return x + (out.sum() * 1e-30).astype(x.dtype)
+
+    t_full = timeit(f"full model fwd N={N}", full_step, x, (params, coords))
+
+    # 2. dilated attention alone (per layer; model has 12)
+    from gigapath_tpu.ops.dilated_attention import dilated_attention
+
+    q = jnp.asarray(rng.normal(size=(1, N + 1, H, HD)), jnp.bfloat16)
+
+    def attn_step(q):
+        out = dilated_attention(q, q, q, SEGS, RATIOS)
+        return q + (out.sum() * 1e-30).astype(q.dtype)
+
+    t_attn = timeit("dilated attention (1 layer)", attn_step, q)
+
+    # 3. each branch alone
+    for sl, r in zip(SEGS, RATIOS):
+
+        def branch_step(q, _sl=sl, _r=r):
+            out = dilated_attention(q, q, q, [_sl], [_r])
+            return q + (out.sum() * 1e-30).astype(q.dtype)
+
+        timeit(f"  branch sl={sl} r={r}", branch_step, q)
+
+    # 4. GEMM-only proxy of one layer (qkv, out, fc1, fc2)
+    h = jnp.asarray(rng.normal(size=(N, D)), jnp.bfloat16)
+    w_qkv = jnp.asarray(rng.normal(size=(D, 3 * D)), jnp.bfloat16)
+    w_o = jnp.asarray(rng.normal(size=(D, D)), jnp.bfloat16)
+    w_1 = jnp.asarray(rng.normal(size=(D, FFN)), jnp.bfloat16)
+    w_2 = jnp.asarray(rng.normal(size=(FFN, D)), jnp.bfloat16)
+
+    def gemm_step(h, w_qkv, w_o, w_1, w_2):
+        a = h @ w_qkv
+        b = a[:, :D] @ w_o
+        c = jax.nn.gelu(b @ w_1) @ w_2
+        return h + c * 1e-30
+
+    t_gemm = timeit("GEMM proxy (1 layer)", gemm_step, h, (w_qkv, w_o, w_1, w_2))
+
+    print()
+    print(f"12x attention          : {12*t_attn*1e3:9.3f} ms")
+    print(f"12x GEMM proxy         : {12*t_gemm*1e3:9.3f} ms")
+    print(f"full - 12x(attn+gemm)  : {(t_full-12*(t_attn+t_gemm))*1e3:9.3f} ms (other)")
+    flops = 12 * (2 * D * (3 * D + D) + 2 * D * FFN * 2) * N
+    print(f"GEMM TFLOPS (full time): {flops/t_full/1e12:9.1f}")
+
+
+if __name__ == "__main__":
+    main()
