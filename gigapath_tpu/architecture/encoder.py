@@ -139,8 +139,10 @@ class EncoderLayer(nn.Module):
                 raise NotImplementedError(
                     "MoE layers require gigapath_tpu.ops.moe (not built yet)"
                 ) from e
+            # padding mask forwarded so padded tokens neither claim expert
+            # capacity nor bias the balance loss (the reference drops it here)
             x, l_aux = MOELayer.from_config(args, dtype=self.dtype, name="moe_layer")(
-                x, deterministic=deterministic
+                x, encoder_padding_mask, deterministic=deterministic
             )
         if drop_path is not None:
             x = drop_path(x, deterministic=deterministic)
@@ -236,6 +238,14 @@ class Encoder(nn.Module):
             if return_all_hiddens:
                 encoder_states.append(x)
             l_aux.append(l_aux_i)
+
+        moe_losses = [l for l in l_aux if l is not None]
+        if moe_losses:
+            # surface the balance loss to training loops that only see the
+            # model output (LongNetViT drops the dict): collect with
+            # apply(..., mutable=["intermediates"]) and add
+            # moe_aux_loss_weight * sum to the task loss
+            self.sow("intermediates", "moe_l_aux", sum(moe_losses))
 
         if args.encoder_normalize_before and args.normalize_output:
             x = nn.LayerNorm(epsilon=args.layernorm_eps, dtype=self.dtype, name="layer_norm")(x)

@@ -70,12 +70,15 @@ def make_longnet_from_name(
     seq_axis_name: Optional[str] = None,
     seq_axis_size: int = 1,
     checkpoint_activations: bool = False,
+    **overrides,
 ) -> Tuple[LongNetEncoder, EncoderConfig]:
     """Build a LongNet encoder from a registry name.
 
     Returns ``(module, config)`` — flax modules are constructed lazily, so
     unlike the reference (which prints the param count at build,
     ``LongNet.py:127``) parameters exist only after ``module.init``.
+    ``**overrides`` update any EncoderConfig field (e.g. ``moe_freq=2,
+    moe_expert_count=8`` turns a registry config into its MoE variant).
     """
     cfg_dict = longnet_config.get_config(config_name)
     cfg_dict.update(
@@ -85,6 +88,7 @@ def make_longnet_from_name(
         segment_length=segment_length,
         seq_parallel=seq_parallel,
         checkpoint_activations=checkpoint_activations,
+        **overrides,
     )
     cfg = EncoderConfig.from_dict(cfg_dict)
     cfg.extras["seq_axis_name"] = seq_axis_name

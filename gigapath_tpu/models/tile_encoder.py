@@ -342,8 +342,6 @@ def convert_timm_state_dict(
             continue  # feature mode: no classifier head
         key = re.sub(r"\bblocks\.(\d+)\b", r"blocks_\1", key)
         path, arr = convert_torch_entry(key, value)
-        if path[-1] == "gamma":  # LayerScale keeps its parameter name
-            pass
         if path[0] == "pos_embed" and target_grid is not None:
             arr = interpolate_pos_embed(arr, target_grid)
         out[path] = arr

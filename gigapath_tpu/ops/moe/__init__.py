@@ -1,0 +1,7 @@
+from gigapath_tpu.ops.moe.routing import (  # noqa: F401
+    Top1Gate,
+    Top2Gate,
+    top1_gating,
+    top2_gating,
+)
+from gigapath_tpu.ops.moe.moe_layer import MOELayer  # noqa: F401

@@ -1,0 +1,187 @@
+"""GShard MoE layer: gate -> dispatch einsum -> vmapped experts -> combine.
+
+Parity with reference ``torchscale/component/xmoe/moe_layer.py``: the same
+Algorithm-2 einsum choreography (``sec,sm->ecm`` dispatch, ``sec,ecm->sm``
+combine, ``moe_layer.py:229-262``) and the same (output, l_aux) contract
+(``moe_layer.py:271``). The distributed pieces map to TPU idioms:
+
+- per-rank expert construction with per-rank seeds
+  (``feedforward_network.py:43-91``) -> one vmapped parameter axis of size E
+  with split init RNGs (each expert gets distinct init, all experts live in
+  one array tree, shardable over the mesh ``expert`` axis);
+- ``_AllToAll`` autograd function + NCCL all2all groups
+  (``moe_layer.py:48-63``, ``global_groups.py``) -> GSPMD: a sharding
+  constraint on the ``[E, C, M]`` dispatch tensor makes XLA insert the
+  all-to-all over ICI, differentiable by construction. The explicit
+  shard_map choreography lives in
+  :mod:`gigapath_tpu.ops.moe.expert_parallel` for when manual control or
+  per-shard gating is wanted;
+- a2a CUDA-event timing (``moe_layer.py:276-307``) -> ``jax.profiler`` traces
+  cover collectives natively; gating telemetry is sowed under
+  ``intermediates/moe_metadata``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from gigapath_tpu.ops.feedforward import FeedForwardNetwork
+from gigapath_tpu.ops.moe.routing import Top1Gate, Top2Gate
+
+
+def _maybe_expert_constraint(x: jnp.ndarray, axis: str = "expert") -> jnp.ndarray:
+    """Constrain the leading (expert) dim over the mesh ``expert`` axis when a
+    physical mesh with that axis is active; no-op otherwise."""
+    try:
+        from jax.sharding import PartitionSpec as P
+
+        mesh = jax.sharding.get_abstract_mesh()
+        if (
+            mesh is not None
+            and not mesh.empty
+            and axis in mesh.axis_names
+            and mesh.shape[axis] > 1
+        ):
+            spec = P(axis, *([None] * (x.ndim - 1)))
+            return jax.lax.with_sharding_constraint(x, spec)
+    except Exception:  # pragma: no cover - constraint is best-effort
+        pass
+    return x
+
+
+class MOELayer(nn.Module):
+    """Mixture-of-experts block over ``[B, L, M]`` tokens.
+
+    Returns ``(output [B, L, M], l_aux scalar)``. Gating metadata is sowed to
+    ``intermediates`` as ``moe_metadata`` (collect with
+    ``model.apply(..., mutable=["intermediates"])``).
+    """
+
+    embed_dim: int
+    ffn_dim: int
+    num_experts: int
+    top1: bool = False
+    activation_fn: str = "gelu"
+    dropout: float = 0.0
+    activation_dropout: float = 0.0
+    layernorm_eps: float = 1e-5
+    subln: bool = False
+    gating_use_fp32: bool = True
+    eval_capacity_token_fraction: float = 0.25
+    second_expert_policy: str = "random"
+    normalize_gate_prob_before_dropping: bool = False
+    use_xmoe: bool = False
+    capacity_factor: float = 1.0
+    dtype: Any = None
+
+    @classmethod
+    def from_config(cls, args, *, dtype=None, name: Optional[str] = None) -> "MOELayer":
+        """Build from an Encoder/Decoder config (the EncoderLayer MoE hook)."""
+        embed = getattr(args, "encoder_embed_dim", None) or args.decoder_embed_dim
+        ffn = getattr(args, "encoder_ffn_embed_dim", None) or args.decoder_ffn_embed_dim
+        return cls(
+            embed_dim=embed,
+            ffn_dim=ffn,
+            num_experts=args.moe_expert_count,
+            top1=args.moe_top1_expert,
+            activation_fn=args.activation_fn,
+            dropout=args.dropout,
+            activation_dropout=args.activation_dropout,
+            layernorm_eps=args.layernorm_eps,
+            subln=args.subln,
+            gating_use_fp32=args.moe_gating_use_fp32,
+            eval_capacity_token_fraction=args.moe_eval_capacity_token_fraction,
+            second_expert_policy=args.moe_second_expert_policy,
+            normalize_gate_prob_before_dropping=args.moe_normalize_gate_prob_before_dropping,
+            use_xmoe=args.use_xmoe,
+            dtype=dtype,
+            name=name,
+        )
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        input_padding_mask: Optional[jnp.ndarray] = None,
+        deterministic: bool = True,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        B, L, M = x.shape
+        assert M == self.embed_dim, (M, self.embed_dim)
+        tokens = x.reshape(B * L, M)
+        pad = (
+            input_padding_mask.reshape(B * L)
+            if input_padding_mask is not None
+            else None
+        )
+
+        if self.top1:
+            gate = Top1Gate(
+                model_dim=self.embed_dim,
+                num_experts=self.num_experts,
+                use_xmoe=self.use_xmoe,
+                use_fp32=self.gating_use_fp32,
+                capacity_factor=self.capacity_factor,
+                eval_capacity_token_fraction=self.eval_capacity_token_fraction,
+                dtype=self.dtype,
+                name="gate",
+            )
+            l_aux, combine, dispatch, metadata = gate(
+                tokens, pad, eval_mode=deterministic
+            )
+        else:
+            gate = Top2Gate(
+                model_dim=self.embed_dim,
+                num_experts=self.num_experts,
+                use_xmoe=self.use_xmoe,
+                use_fp32=self.gating_use_fp32,
+                second_expert_policy=self.second_expert_policy,
+                normalize_gate_prob_before_dropping=self.normalize_gate_prob_before_dropping,
+                eval_capacity_token_fraction=self.eval_capacity_token_fraction,
+                dtype=self.dtype,
+                name="gate",
+            )
+            needs_rng = not deterministic and self.second_expert_policy in (
+                "sampling",
+                "random",
+            )
+            rng = self.make_rng("dropout") if needs_rng else None
+            l_aux, combine, dispatch, metadata = gate(
+                tokens, pad, rng=rng, eval_mode=deterministic
+            )
+        self.sow("intermediates", "moe_metadata", metadata)
+
+        # dispatch: [S,E,C] x [S,M] -> [E,C,M]; the expert axis is the mesh
+        # collective boundary (GSPMD inserts the all-to-all here)
+        dispatched = jnp.einsum(
+            "sec,sm->ecm", dispatch.astype(tokens.dtype), tokens
+        )
+        dispatched = _maybe_expert_constraint(dispatched)
+
+        experts = nn.vmap(
+            FeedForwardNetwork,
+            in_axes=(0, None),
+            out_axes=0,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+        )(
+            embed_dim=self.embed_dim,
+            ffn_dim=self.ffn_dim,
+            activation_fn=self.activation_fn,
+            dropout=self.dropout,
+            activation_dropout=self.activation_dropout,
+            layernorm_eps=self.layernorm_eps,
+            subln=self.subln,
+            dtype=self.dtype,
+            name="experts",
+        )
+        expert_output = experts(dispatched, deterministic)
+        expert_output = _maybe_expert_constraint(expert_output)
+
+        combined = jnp.einsum(
+            "sec,ecm->sm", combine.astype(tokens.dtype), expert_output
+        )
+        return combined.reshape(B, L, M), l_aux.astype(jnp.float32)

@@ -13,9 +13,11 @@ replacement for that CUDA dependency:
   rather than storing the attention matrix.
 
 Performance notes (v5e measurements in scripts/profile_slide.py):
-- kernels index the natural ``[B, L, H, D]`` layout directly via BlockSpec
-  (grid dims for batch and head), so no head-transpose passes over HBM are
-  paid on either side of the call;
+- kernels run on ``[B, H, L, D]`` layout with ``(1, 1, block_q, D)`` blocks —
+  the only layout whose trailing block dims satisfy Mosaic's (8, 128)
+  tiling rule for head counts > 1; the public API stays ``[B, L, H, D]``
+  and the wrapper transposes (XLA folds the relayout into the surrounding
+  projection reshapes);
 - the softmax scale is folded into the small q block (``block_q x D``
   elements) instead of the ``block_q x block_k`` logits — the inner loop is
   VPU-bound, so per-logit ops are what matter;
@@ -67,8 +69,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref, m_ref, l_ref, ac
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     # scale folded into q: block_q*D elements instead of block_q*block_k
-    q = (q_ref[0, :, 0, :].astype(jnp.float32) * scale).astype(q_ref.dtype)
-    k = k_ref[0, :, 0, :]
+    q = (q_ref[0, 0].astype(jnp.float32) * scale).astype(q_ref.dtype)
+    k = k_ref[0, 0]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )  # (BQ, BK)
@@ -89,7 +91,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref, m_ref, l_ref, ac
     alpha = jnp.exp(m_prev - m_new)
     l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
     acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-        p.astype(v_ref.dtype), v_ref[0, :, 0, :], (((1,), (0,)), ((), ())),
+        p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
     m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
@@ -99,7 +101,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref, m_ref, l_ref, ac
     def _finalize():
         l = l_ref[:, :1]
         safe_l = jnp.maximum(l, 1e-30)
-        o_ref[0, :, 0, :] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
         # lse carried at LANES width (TPU tiling needs a 128-lane last dim);
         # the wrapper slices lane 0
         lse_ref[0, 0] = jnp.broadcast_to(
@@ -116,8 +118,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dq_re
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    q = q_ref[0, :, 0, :]
-    k = k_ref[0, :, 0, :]
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
@@ -129,7 +131,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dq_re
     p = jnp.where(mask, 0.0, jnp.exp(s - lse_ref[0, 0][:, :1]))
 
     dp = jax.lax.dot_general(
-        do_ref[0, :, 0, :].astype(jnp.float32), v_ref[0, :, 0, :].astype(jnp.float32),
+        do_ref[0, 0].astype(jnp.float32), v_ref[0, 0].astype(jnp.float32),
         (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
     )
     ds = p * (dp - delta_ref[0, 0][:, :1])
@@ -140,7 +142,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dq_re
 
     @pl.when(j == pl.num_programs(3) - 1)
     def _finalize():
-        dq_ref[0, :, 0, :] = dq_acc[:].astype(dq_ref.dtype)
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dk_ref, dv_ref,
@@ -153,8 +155,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dk_r
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    q = q_ref[0, :, 0, :]
-    k = k_ref[0, :, 0, :]
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale  # (BQ, BK)
@@ -165,12 +167,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dk_r
         mask = jnp.logical_or(mask, cols > rows)
     p = jnp.where(mask, 0.0, jnp.exp(s - lse_ref[0, 0][:, :1]))  # (BQ, BK)
 
-    do = do_ref[0, :, 0, :].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
     dv_acc[:] += jax.lax.dot_general(
         p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )  # (BK, D)
     dp = jax.lax.dot_general(
-        do, v_ref[0, :, 0, :].astype(jnp.float32), (((1,), (1,)), ((), ())),
+        do, v_ref[0, 0].astype(jnp.float32), (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )  # (BQ, BK)
     ds = p * (dp - delta_ref[0, 0][:, :1])
@@ -181,15 +183,16 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dk_r
 
     @pl.when(i == pl.num_programs(3) - 1)
     def _finalize():
-        dk_ref[0, :, 0, :] = dk_acc[:].astype(dk_ref.dtype)
-        dv_ref[0, :, 0, :] = dv_acc[:].astype(dv_ref.dtype)
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _pad_seq(x: jnp.ndarray, L: int) -> jnp.ndarray:
-    """Zero-pad [B, L0, H, D] to length L on axis 1."""
-    if x.shape[1] == L:
-        return x
-    return jnp.pad(x, ((0, 0), (0, L - x.shape[1]), (0, 0), (0, 0)))
+def _to_bhld(x: jnp.ndarray, L: int) -> jnp.ndarray:
+    """[B, L0, H, D] -> [B, H, L, D], zero-padded to length L on the seq axis."""
+    x = x.transpose(0, 2, 1, 3)
+    if x.shape[2] != L:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, L - x.shape[2]), (0, 0)))
+    return x
 
 
 def _kvlen_array(kv_lens, B: int, H: int, Lk: int) -> jnp.ndarray:
@@ -207,7 +210,7 @@ def _fwd_impl(q, k, v, kv_lens, causal, scale, block_q, block_k, interpret):
     block_q = min(block_q, _round_up(Lq, LANES))
     block_k = min(block_k, _round_up(Lk, LANES))
     Lqp, Lkp = _round_up(Lq, block_q), _round_up(Lk, block_k)
-    qp, kp, vp = _pad_seq(q, Lqp), _pad_seq(k, Lkp), _pad_seq(v, Lkp)
+    qp, kp, vp = _to_bhld(q, Lqp), _to_bhld(k, Lkp), _to_bhld(v, Lkp)
     nq, nk = Lqp // block_q, Lkp // block_k
     kvlen = _kvlen_array(kv_lens, B, H, Lk)
 
@@ -215,8 +218,8 @@ def _fwd_impl(q, k, v, kv_lens, causal, scale, block_q, block_k, interpret):
         _fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k,
     )
-    q_spec = pl.BlockSpec((1, block_q, 1, D), lambda b, h, i, j: (b, i, h, 0), memory_space=pltpu.VMEM)
-    k_spec = pl.BlockSpec((1, block_k, 1, D), lambda b, h, i, j: (b, j, h, 0), memory_space=pltpu.VMEM)
+    q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0), memory_space=pltpu.VMEM)
+    k_spec = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0), memory_space=pltpu.VMEM)
     kvlen_spec = pl.BlockSpec(memory_space=pltpu.SMEM)  # whole (B,H) array; indexed by program_id
     out, lse = pl.pallas_call(
         kernel,
@@ -227,7 +230,7 @@ def _fwd_impl(q, k, v, kv_lens, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, i, j: (b, h, i, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, Lqp, H, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Lqp, D), q.dtype),
             jax.ShapeDtypeStruct((B, H, Lqp, LANES), jnp.float32),
         ],
         scratch_shapes=[
@@ -237,7 +240,7 @@ def _fwd_impl(q, k, v, kv_lens, causal, scale, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qp, kp, vp, kvlen)
-    return out[:, :Lq], lse[:, :, :Lq, 0]
+    return out[:, :, :Lq].transpose(0, 2, 1, 3), lse[:, :, :Lq, 0]
 
 
 def _bwd_impl(q, k, v, lse, delta, do, kv_lens, causal, scale, block_q, block_k, interpret):
@@ -246,8 +249,8 @@ def _bwd_impl(q, k, v, lse, delta, do, kv_lens, causal, scale, block_q, block_k,
     block_q = min(block_q, _round_up(Lq, LANES))
     block_k = min(block_k, _round_up(Lk, LANES))
     Lqp, Lkp = _round_up(Lq, block_q), _round_up(Lk, block_k)
-    qp, kp, vp = _pad_seq(q, Lqp), _pad_seq(k, Lkp), _pad_seq(v, Lkp)
-    dop = _pad_seq(do, Lqp)
+    qp, kp, vp = _to_bhld(q, Lqp), _to_bhld(k, Lkp), _to_bhld(v, Lkp)
+    dop = _to_bhld(do, Lqp)
     # lse/delta carried at LANES width for TPU tiling; padded q rows get
     # lse=0, which is harmless (their p rows multiply masked ds/do = 0)
     lsep = jnp.broadcast_to(
@@ -259,8 +262,8 @@ def _bwd_impl(q, k, v, lse, delta, do, kv_lens, causal, scale, block_q, block_k,
     nq, nk = Lqp // block_q, Lkp // block_k
     kvlen = _kvlen_array(kv_lens, B, H, Lk)
 
-    q_spec = pl.BlockSpec((1, block_q, 1, D), lambda b, h, i, j: (b, i, h, 0), memory_space=pltpu.VMEM)
-    k_spec = pl.BlockSpec((1, block_k, 1, D), lambda b, h, i, j: (b, j, h, 0), memory_space=pltpu.VMEM)
+    q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0), memory_space=pltpu.VMEM)
+    k_spec = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0), memory_space=pltpu.VMEM)
     vec_spec = pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, i, j: (b, h, i, 0), memory_space=pltpu.VMEM)
     kvlen_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
 
@@ -272,14 +275,14 @@ def _bwd_impl(q, k, v, lse, delta, do, kv_lens, causal, scale, block_q, block_k,
         grid=(B, H, nq, nk),
         in_specs=[q_spec, k_spec, k_spec, q_spec, vec_spec, vec_spec, kvlen_spec],
         out_specs=[q_spec],
-        out_shape=[jax.ShapeDtypeStruct((B, Lqp, H, D), q.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((B, H, Lqp, D), q.dtype)],
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
     )(qp, kp, vp, dop, lsep, deltap, kvlen)[0]
 
     # grid (B, H, nk, nq): index maps see (b, h, j, i)
-    q_spec_kv = pl.BlockSpec((1, block_q, 1, D), lambda b, h, j, i: (b, i, h, 0), memory_space=pltpu.VMEM)
-    k_spec_kv = pl.BlockSpec((1, block_k, 1, D), lambda b, h, j, i: (b, j, h, 0), memory_space=pltpu.VMEM)
+    q_spec_kv = pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0), memory_space=pltpu.VMEM)
+    k_spec_kv = pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0), memory_space=pltpu.VMEM)
     vec_spec_kv = pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, j, i: (b, h, i, 0), memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
         functools.partial(
@@ -290,8 +293,8 @@ def _bwd_impl(q, k, v, lse, delta, do, kv_lens, causal, scale, block_q, block_k,
         in_specs=[q_spec_kv, k_spec_kv, k_spec_kv, q_spec_kv, vec_spec_kv, vec_spec_kv, kvlen_spec],
         out_specs=[k_spec_kv, k_spec_kv],
         out_shape=[
-            jax.ShapeDtypeStruct((B, Lkp, H, D), k.dtype),
-            jax.ShapeDtypeStruct((B, Lkp, H, D), v.dtype),
+            jax.ShapeDtypeStruct((B, H, Lkp, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, Lkp, D), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),
@@ -299,7 +302,11 @@ def _bwd_impl(q, k, v, lse, delta, do, kv_lens, causal, scale, block_q, block_k,
         ],
         interpret=interpret,
     )(qp, kp, vp, dop, lsep, deltap, kvlen)
-    return dq[:, :Lq], dk[:, :Lk], dv[:, :Lk]
+    return (
+        dq[:, :, :Lq].transpose(0, 2, 1, 3),
+        dk[:, :, :Lk].transpose(0, 2, 1, 3),
+        dv[:, :, :Lk].transpose(0, 2, 1, 3),
+    )
 
 
 def _flash_fwd_rule(q, k, v, kv_lens, causal, interpret):

@@ -21,9 +21,26 @@ _COLUMN_PARALLEL = ("q_proj", "k_proj", "v_proj", "fc1", "gate")
 _ROW_PARALLEL = ("out_proj", "fc2")
 
 
-def param_spec(path_names, leaf, *, model_axis: str = "model") -> P:
-    """PartitionSpec for one parameter, by its module path."""
-    if path_names and path_names[-1] == "kernel" and hasattr(leaf, "ndim") and leaf.ndim == 2:
+def param_spec(
+    path_names,
+    leaf,
+    *,
+    model_axis: str | None = "model",
+    expert_axis: str | None = None,
+) -> P:
+    """PartitionSpec for one parameter, by its module path. Either axis may
+    be None, disabling that rule."""
+    if expert_axis and "experts" in path_names and hasattr(leaf, "ndim") and leaf.ndim >= 1:
+        # vmapped MoE expert params carry a leading E axis
+        # (ops/moe/moe_layer.py) — shard it over the mesh ``expert`` axis
+        return P(expert_axis, *([None] * (leaf.ndim - 1)))
+    if (
+        model_axis
+        and path_names
+        and path_names[-1] == "kernel"
+        and hasattr(leaf, "ndim")
+        and leaf.ndim == 2
+    ):
         owner = path_names[-2] if len(path_names) >= 2 else ""
         if owner in _COLUMN_PARALLEL:
             return P(None, model_axis)
@@ -39,10 +56,20 @@ def param_shardings(params: Dict[str, Any], mesh: Mesh) -> Dict[str, Any]:
     the rules degrade gracefully to pure DP/SP meshes.
     """
     has_model = "model" in mesh.axis_names and mesh.shape["model"] > 1
+    expert_axis = (
+        "expert"
+        if "expert" in mesh.axis_names and mesh.shape["expert"] > 1
+        else None
+    )
 
     def one(path, leaf):
         names = [getattr(p, "key", str(p)) for p in path]
-        spec = param_spec(names, leaf) if has_model else P()
+        spec = param_spec(
+            names,
+            leaf,
+            model_axis="model" if has_model else None,
+            expert_axis=expert_axis,
+        )
         return NamedSharding(mesh, spec)
 
     flat = jax.tree_util.tree_flatten_with_path(params)

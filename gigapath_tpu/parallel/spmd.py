@@ -22,29 +22,52 @@ def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray, task: str = "mu
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
 
 
+def collect_moe_l_aux(intermediates: Dict[str, Any]) -> jnp.ndarray:
+    """Sum every ``moe_l_aux`` sown by Encoder/Decoder stacks (see
+    ``architecture/encoder.py``); 0 when the model has no MoE layers."""
+    total = jnp.float32(0.0)
+    flat = jax.tree_util.tree_flatten_with_path(intermediates)[0]
+    for path, leaf in flat:
+        if any(getattr(p, "key", None) == "moe_l_aux" for p in path):
+            total = total + jnp.asarray(leaf, jnp.float32)
+    return total
+
+
 def make_train_step(
     model,
     optimizer: optax.GradientTransformation,
     *,
     task: str = "multi_class",
     loss_fn: Optional[Callable] = None,
+    moe_aux_loss_weight: float = 0.0,
 ) -> Callable:
     """Returns ``train_step(params, opt_state, batch, rng) ->
     (params, opt_state, loss)`` for a classification model taking
     ``(images, coords)``. Pure and jittable; shard by device_put-ing the
-    inputs with NamedShardings and wrapping in ``jax.jit``."""
+    inputs with NamedShardings and wrapping in ``jax.jit``.
+
+    ``moe_aux_loss_weight`` adds the GShard balance loss sown by MoE layers
+    (the reference computes l_aux in the gate and hands it to the criterion
+    wrapper; here it rides the intermediates collection)."""
 
     def _loss(params, batch: Dict[str, Any], rng):
-        logits = model.apply(
+        logits, mutated = model.apply(
             {"params": params},
             batch["images"],
             batch["coords"],
             deterministic=False,
             rngs={"dropout": rng},
+            mutable=["intermediates"],
         )
         if loss_fn is not None:
-            return loss_fn(logits, batch["labels"])
-        return cross_entropy_loss(logits, batch["labels"], task)
+            loss = loss_fn(logits, batch["labels"])
+        else:
+            loss = cross_entropy_loss(logits, batch["labels"], task)
+        if moe_aux_loss_weight:
+            loss = loss + moe_aux_loss_weight * collect_moe_l_aux(
+                mutated.get("intermediates", {})
+            )
+        return loss
 
     def train_step(params, opt_state, batch, rng):
         loss, grads = jax.value_and_grad(_loss)(params, batch, rng)
