@@ -1,0 +1,305 @@
+"""Transformer decoder stack (causal self-attn, cross-attn, MoE, KV cache).
+
+Parity with reference ``torchscale/architecture/decoder.py``: DecoderLayer is
+causal self-attention + optional encoder cross-attention + FFN-or-MoE with
+pre/post-LN, deepnorm residual scaling and per-depth DropPath
+(``decoder.py:23-207``); Decoder assembles embedding scale / positions /
+layernorm-embedding, the layer stack, relative-position biases (self and
+cross), and the output projection with optional input/output embedding
+sharing (``decoder.py:210-481``). TPU mapping:
+
+- the materialized ``-inf`` triangle (``decoder.py:434-441``) never exists:
+  causal masking is a flag on the fused attention op (the reference builds
+  it only when *not* using flash attention — the flag path here is the
+  flash path made default);
+- fairseq-style ``incremental_state`` dicts become the flax ``cache``
+  collection: ``decode=True`` + ``mutable=["cache"]`` runs single-token
+  steps against a static-shape KV cache
+  (:class:`gigapath_tpu.ops.attention.MultiheadAttention`);
+- fairscale checkpoint/FSDP wrapping -> ``nn.remat`` per layer + pjit
+  sharding rules.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from gigapath_tpu.architecture.config import DecoderConfig
+from gigapath_tpu.ops.attention import MultiheadAttention
+from gigapath_tpu.ops.droppath import DropPath
+from gigapath_tpu.ops.feedforward import FeedForwardNetwork
+from gigapath_tpu.ops.relative_position_bias import RelativePositionBias
+
+
+class DecoderLayer(nn.Module):
+    """One decoder block (reference ``DecoderLayer:23``)."""
+
+    args: DecoderConfig
+    depth: int
+    is_moe_layer: bool = False
+    is_encoder_decoder: bool = False
+    dtype: Any = None
+
+    def build_self_attention(self) -> nn.Module:
+        return MultiheadAttention(
+            embed_dim=self.args.decoder_embed_dim,
+            num_heads=self.args.decoder_attention_heads,
+            dropout=self.args.attention_dropout,
+            self_attention=True,
+            subln=self.args.subln,
+            layernorm_eps=self.args.layernorm_eps,
+            xpos_rel_pos=self.args.xpos_rel_pos,
+            xpos_scale_base=self.args.xpos_scale_base,
+            dtype=self.dtype,
+            name="self_attn",
+        )
+
+    def build_encoder_attention(self) -> nn.Module:
+        return MultiheadAttention(
+            embed_dim=self.args.decoder_embed_dim,
+            num_heads=self.args.decoder_attention_heads,
+            dropout=self.args.attention_dropout,
+            self_attention=False,
+            encoder_decoder_attention=True,
+            subln=self.args.subln,
+            layernorm_eps=self.args.layernorm_eps,
+            dtype=self.dtype,
+            name="encoder_attn",
+        )
+
+    @property
+    def alpha(self) -> float:
+        if not self.args.deepnorm:
+            return 1.0
+        if self.is_encoder_decoder:
+            return math.pow(3.0 * self.args.decoder_layers, 0.25)
+        return math.pow(2.0 * self.args.decoder_layers, 0.25)
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        encoder_out: Optional[jnp.ndarray] = None,
+        encoder_padding_mask: Optional[jnp.ndarray] = None,
+        self_attn_padding_mask: Optional[jnp.ndarray] = None,
+        self_attn_rel_pos: Optional[jnp.ndarray] = None,
+        cross_attn_rel_pos: Optional[jnp.ndarray] = None,
+        decode: bool = False,
+        deterministic: bool = True,
+    ):
+        args = self.args
+        ln = lambda name: nn.LayerNorm(  # noqa: E731
+            epsilon=args.layernorm_eps, dtype=self.dtype, name=name
+        )
+        if args.drop_path_rate > 0:
+            prob = float(
+                np.linspace(0, args.drop_path_rate, args.decoder_layers)[self.depth]
+            )
+            drop_path = DropPath(prob)
+        else:
+            drop_path = None
+        dropout = nn.Dropout(args.dropout)
+
+        residual = x
+        if args.decoder_normalize_before:
+            x = ln("self_attn_layer_norm")(x)
+        x = self.build_self_attention()(
+            x,
+            x,
+            x,
+            key_padding_mask=self_attn_padding_mask,
+            rel_pos=self_attn_rel_pos,
+            is_causal=True,
+            decode=decode,
+            deterministic=deterministic,
+        )
+        x = dropout(x, deterministic=deterministic)
+        if drop_path is not None:
+            x = drop_path(x, deterministic=deterministic)
+        x = residual * self.alpha + x
+        if not args.decoder_normalize_before:
+            x = ln("self_attn_layer_norm")(x)
+
+        if self.is_encoder_decoder and encoder_out is not None:
+            residual = x
+            if args.decoder_normalize_before:
+                x = ln("encoder_attn_layer_norm")(x)
+            x = self.build_encoder_attention()(
+                x,
+                encoder_out,
+                encoder_out,
+                key_padding_mask=encoder_padding_mask,
+                rel_pos=cross_attn_rel_pos,
+                deterministic=deterministic,
+            )
+            x = dropout(x, deterministic=deterministic)
+            if drop_path is not None:
+                x = drop_path(x, deterministic=deterministic)
+            x = residual * self.alpha + x
+            if not args.decoder_normalize_before:
+                x = ln("encoder_attn_layer_norm")(x)
+
+        residual = x
+        if args.decoder_normalize_before:
+            x = ln("final_layer_norm")(x)
+        if not self.is_moe_layer:
+            x = FeedForwardNetwork(
+                embed_dim=args.decoder_embed_dim,
+                ffn_dim=args.decoder_ffn_embed_dim,
+                activation_fn=args.activation_fn,
+                dropout=args.dropout,
+                activation_dropout=args.activation_dropout,
+                layernorm_eps=args.layernorm_eps,
+                subln=args.subln,
+                dtype=self.dtype,
+                name="ffn",
+            )(x, deterministic=deterministic)
+            l_aux = None
+        else:
+            from gigapath_tpu.ops.moe.moe_layer import MOELayer
+
+            x, l_aux = MOELayer.from_config(
+                args, prefix="decoder", dtype=self.dtype, name="moe_layer"
+            )(x, self_attn_padding_mask, deterministic=deterministic)
+        if drop_path is not None:
+            x = drop_path(x, deterministic=deterministic)
+        x = residual * self.alpha + x
+        if not args.decoder_normalize_before:
+            x = ln("final_layer_norm")(x)
+        return x, l_aux
+
+
+class Decoder(nn.Module):
+    """Decoder stack returning ``(x, {"inner_states", "l_aux", "attn"})``
+    (reference ``Decoder.forward:388-478``)."""
+
+    args: DecoderConfig
+    is_encoder_decoder: bool = False
+    dtype: Any = None
+
+    layer_cls = DecoderLayer  # subclass hook (LongNetDecoder overrides)
+
+    def build_decoder_layer(self, depth: int, is_moe_layer: bool) -> nn.Module:
+        cls = type(self).layer_cls
+        if self.args.checkpoint_activations:
+            # flax counts the module as arg 0 -> deterministic is arg 8
+            cls = nn.remat(cls, static_argnums=(7, 8))
+        return cls(
+            args=self.args,
+            depth=depth,
+            is_moe_layer=is_moe_layer,
+            is_encoder_decoder=self.is_encoder_decoder,
+            dtype=self.dtype,
+            name=f"layers_{depth}",
+        )
+
+    @nn.compact
+    def __call__(
+        self,
+        prev_output_tokens: Optional[jnp.ndarray] = None,
+        *,
+        self_attn_padding_mask: Optional[jnp.ndarray] = None,
+        encoder_out: Optional[Dict[str, Any]] = None,
+        token_embeddings: Optional[jnp.ndarray] = None,
+        features_only: bool = False,
+        return_all_hiddens: bool = False,
+        decode: bool = False,
+        deterministic: bool = True,
+    ) -> Dict[str, Any]:
+        args = self.args
+        assert prev_output_tokens is not None or token_embeddings is not None
+
+        embed_tokens = None
+        if args.vocab_size > 0:
+            embed_tokens = nn.Embed(
+                args.vocab_size,
+                args.decoder_embed_dim,
+                dtype=self.dtype,
+                name="embed_tokens",
+            )
+        if token_embeddings is None:
+            token_embeddings = embed_tokens(prev_output_tokens)
+
+        embed_scale = (
+            1.0 if args.no_scale_embedding else math.sqrt(args.decoder_embed_dim)
+        )
+        x = embed_scale * token_embeddings
+        if args.layernorm_embedding:
+            x = nn.LayerNorm(
+                epsilon=args.layernorm_eps, dtype=self.dtype, name="layernorm_embedding"
+            )(x)
+        x = nn.Dropout(args.dropout)(x, deterministic=deterministic)
+
+        B, slen = x.shape[:2]
+        self_attn_rel_pos = None
+        cross_attn_rel_pos = None
+        if args.rel_pos_buckets > 0 and args.max_rel_pos > 0:
+            self_attn_rel_pos = RelativePositionBias(
+                num_buckets=args.rel_pos_buckets,
+                max_distance=args.max_rel_pos,
+                n_heads=args.decoder_attention_heads,
+                bidirectional=False,
+                name="self_attn_relative_position",
+            )(B, slen, slen)
+            if self.is_encoder_decoder and encoder_out is not None:
+                klen = encoder_out["encoder_out"].shape[1]
+                cross_attn_rel_pos = RelativePositionBias(
+                    num_buckets=args.rel_pos_buckets,
+                    max_distance=args.max_rel_pos,
+                    n_heads=args.decoder_attention_heads,
+                    bidirectional=False,
+                    name="cross_attn_relative_position",
+                )(B, slen, klen)
+
+        inner_states = [x]
+        l_aux = list(encoder_out.get("l_aux", [])) if encoder_out else []
+        moe_freq = args.moe_freq
+        for i in range(args.decoder_layers):
+            is_moe_layer = moe_freq != 0 and (i + 1) % moe_freq == 0
+            x, l_aux_i = self.build_decoder_layer(i, is_moe_layer)(
+                x,
+                encoder_out["encoder_out"] if encoder_out else None,
+                encoder_out.get("encoder_padding_mask") if encoder_out else None,
+                self_attn_padding_mask,
+                self_attn_rel_pos,
+                cross_attn_rel_pos,
+                decode,
+                deterministic,
+            )
+            l_aux.append(l_aux_i)
+            inner_states.append(x)
+
+        moe_losses = [l for l in l_aux if l is not None]
+        if moe_losses:
+            self.sow("intermediates", "moe_l_aux", sum(moe_losses))
+
+        if args.decoder_normalize_before:
+            x = nn.LayerNorm(
+                epsilon=args.layernorm_eps, dtype=self.dtype, name="layer_norm"
+            )(x)
+
+        if not features_only and not args.no_output_layer and args.vocab_size > 0:
+            if args.share_decoder_input_output_embed:
+                x = embed_tokens.attend(x)
+            else:
+                x = nn.Dense(
+                    args.vocab_size,
+                    use_bias=False,
+                    dtype=self.dtype,
+                    kernel_init=nn.initializers.normal(
+                        args.decoder_embed_dim**-0.5
+                    ),
+                    name="output_projection",
+                )(x)
+
+        return {
+            "decoder_out": x,
+            "inner_states": inner_states if return_all_hiddens else [x],
+            "l_aux": l_aux,
+            "attn": None,
+        }

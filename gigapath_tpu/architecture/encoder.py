@@ -141,9 +141,9 @@ class EncoderLayer(nn.Module):
                 ) from e
             # padding mask forwarded so padded tokens neither claim expert
             # capacity nor bias the balance loss (the reference drops it here)
-            x, l_aux = MOELayer.from_config(args, dtype=self.dtype, name="moe_layer")(
-                x, encoder_padding_mask, deterministic=deterministic
-            )
+            x, l_aux = MOELayer.from_config(
+                args, prefix="encoder", dtype=self.dtype, name="moe_layer"
+            )(x, encoder_padding_mask, deterministic=deterministic)
         if drop_path is not None:
             x = drop_path(x, deterministic=deterministic)
         x = residual * self.alpha + x

@@ -1,0 +1,57 @@
+"""Seq2seq wrapper: Encoder + Decoder under one module.
+
+Parity with reference ``torchscale/architecture/encoder_decoder.py:10-61``.
+``share_all_embeddings`` maps both vocab embeddings onto one table by tying
+the decoder's embed/output to the encoder's ``embed_tokens`` (flax shares by
+passing the same module instance).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from gigapath_tpu.architecture.config import EncoderDecoderConfig
+from gigapath_tpu.architecture.decoder import Decoder
+from gigapath_tpu.architecture.encoder import Encoder
+
+
+class EncoderDecoder(nn.Module):
+    args: EncoderDecoderConfig
+    dtype: Any = None
+
+    def setup(self):
+        args = self.args
+        if args.share_all_embeddings:
+            args.share_decoder_input_output_embed = True
+        self.encoder = Encoder(args=args, is_encoder_decoder=True, dtype=self.dtype)
+        self.decoder = Decoder(args=args, is_encoder_decoder=True, dtype=self.dtype)
+
+    def __call__(
+        self,
+        src_tokens: Optional[jnp.ndarray] = None,
+        prev_output_tokens: Optional[jnp.ndarray] = None,
+        *,
+        encoder_token_embeddings: Optional[jnp.ndarray] = None,
+        decoder_token_embeddings: Optional[jnp.ndarray] = None,
+        return_all_hiddens: bool = False,
+        features_only: bool = False,
+        deterministic: bool = True,
+    ) -> Dict[str, Any]:
+        encoder_out = self.encoder(
+            src_tokens,
+            token_embeddings=encoder_token_embeddings,
+            return_all_hiddens=return_all_hiddens,
+            features_only=True,
+            deterministic=deterministic,
+        )
+        return self.decoder(
+            prev_output_tokens,
+            token_embeddings=decoder_token_embeddings,
+            encoder_out=encoder_out,
+            features_only=features_only,
+            return_all_hiddens=return_all_hiddens,
+            deterministic=deterministic,
+        )

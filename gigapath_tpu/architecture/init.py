@@ -46,6 +46,35 @@ def deepnorm_init_scale(num_layers: int, is_encoder_decoder: bool = False, decod
     return math.pow(8.0 * num_layers, 0.25)
 
 
+def init_bert_params(
+    params: Dict[str, Any], rng: "jax.Array", std: float = 0.02
+) -> Dict[str, Any]:
+    """BERT-style re-init on a flax param tree (reference
+    ``architecture/utils.py:10-33``): every Dense/Embed kernel is redrawn
+    from a truncated normal (std 0.02, +-2 std), biases keep zeros.
+    Attention q/k/v kernels get the reference's extra ``1/sqrt(2)`` scale."""
+    import jax.numpy as jnp
+    from jax import random
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    keys = random.split(rng, len(flat))
+
+    def redraw(path, leaf, key):
+        names = [getattr(p, "key", str(p)) for p in path]
+        if names[-1] in ("kernel", "embedding") and getattr(leaf, "ndim", 0) >= 2:
+            scale = std
+            if any(n in ("q_proj", "k_proj", "v_proj") for n in names):
+                scale = std / math.sqrt(2)
+            draw = random.truncated_normal(key, -2.0, 2.0, leaf.shape, jnp.float32)
+            # the +-2-truncated unit normal has std 0.87962566; divide it out
+            # so the delivered std is exactly `scale`
+            return (draw * (scale / 0.87962566103423978)).astype(leaf.dtype)
+        return leaf
+
+    leaves = [redraw(path, leaf, k) for (path, leaf), k in zip(flat, keys)]
+    return jax.tree_util.tree_unflatten(jax.tree_util.tree_structure(params), leaves)
+
+
 def apply_init_scaling(
     params: Dict[str, Any],
     *,

@@ -1,0 +1,227 @@
+"""RetNet decoder (retention network).
+
+Parity with reference ``torchscale/architecture/retnet.py``: RMS-normed
+decoder blocks of MultiScaleRetention + GLU feed-forward (``DecoderLayer:71``),
+embedding scale, chunk padding for chunkwise-recurrent mode
+(``RetNetDecoder.forward:344-349``), final RMSNorm and output projection with
+optional embedding sharing. Relative-position constants come from
+:func:`gigapath_tpu.ops.multiscale_retention.retnet_rel_pos` — computed from
+static sequence lengths, so jit folds them.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from gigapath_tpu.architecture.config import RetNetConfig
+from gigapath_tpu.ops.droppath import DropPath
+from gigapath_tpu.ops.feedforward import GLU
+from gigapath_tpu.ops.multiscale_retention import MultiScaleRetention, retnet_rel_pos
+from gigapath_tpu.ops.norms import RMSNorm
+
+
+class RetNetDecoderLayer(nn.Module):
+    """Retention + GLU block (reference ``retnet.py:71-196``)."""
+
+    args: RetNetConfig
+    depth: int
+    is_moe_layer: bool = False
+    dtype: Any = None
+
+    @property
+    def alpha(self) -> float:
+        if self.args.deepnorm:
+            return math.pow(2.0 * self.args.decoder_layers, 0.25)
+        return 1.0
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        rel_pos,
+        chunkwise_recurrent: bool = False,
+        decode: bool = False,
+        deterministic: bool = True,
+    ):
+        args = self.args
+        norm = lambda name: RMSNorm(  # noqa: E731
+            args.decoder_embed_dim, eps=args.layernorm_eps, dtype=self.dtype, name=name
+        )
+        if args.drop_path_rate > 0:
+            prob = float(
+                np.linspace(0, args.drop_path_rate, args.decoder_layers)[self.depth]
+            )
+            drop_path = DropPath(prob)
+        else:
+            drop_path = None
+        dropout = nn.Dropout(args.dropout)
+
+        residual = x
+        if args.decoder_normalize_before:
+            x = norm("retention_layer_norm")(x)
+        x = MultiScaleRetention(
+            embed_dim=args.decoder_embed_dim,
+            value_dim=args.decoder_value_embed_dim,
+            num_heads=args.decoder_retention_heads,
+            layernorm_eps=args.layernorm_eps,
+            dtype=self.dtype,
+            name="retention",
+        )(x, rel_pos, chunkwise_recurrent=chunkwise_recurrent, decode=decode)
+        x = dropout(x, deterministic=deterministic)
+        if drop_path is not None:
+            x = drop_path(x, deterministic=deterministic)
+        x = residual * self.alpha + x
+        if not args.decoder_normalize_before:
+            x = norm("retention_layer_norm")(x)
+
+        residual = x
+        if args.decoder_normalize_before:
+            x = norm("final_layer_norm")(x)
+        if not self.is_moe_layer:
+            x = GLU(
+                embed_dim=args.decoder_embed_dim,
+                ffn_dim=args.decoder_ffn_embed_dim,
+                activation_fn=args.activation_fn,
+                dropout=args.dropout,
+                activation_dropout=args.activation_dropout,
+                dtype=self.dtype,
+                name="ffn",
+            )(x, deterministic=deterministic)
+            l_aux = None
+        else:
+            from gigapath_tpu.ops.moe.moe_layer import MOELayer
+
+            x, l_aux = MOELayer.from_config(
+                args, prefix="decoder", dtype=self.dtype, name="moe_layer"
+            )(x, deterministic=deterministic)
+        if drop_path is not None:
+            x = drop_path(x, deterministic=deterministic)
+        x = residual * self.alpha + x
+        if not args.decoder_normalize_before:
+            x = norm("final_layer_norm")(x)
+        return x, l_aux
+
+
+class RetNetDecoder(nn.Module):
+    """RetNet stack returning ``(x, {"inner_states", "l_aux", "attn"})``
+    (reference ``RetNetDecoder:199-391``).
+
+    Modes: default parallel; ``chunkwise_recurrent`` from the config (input
+    padded to a chunk multiple and sliced back); ``decode=True`` +
+    ``mutable=["cache"]`` for O(1)-state stepwise generation.
+    """
+
+    args: RetNetConfig
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(
+        self,
+        prev_output_tokens: Optional[jnp.ndarray] = None,
+        *,
+        token_embeddings: Optional[jnp.ndarray] = None,
+        features_only: bool = False,
+        return_all_hiddens: bool = False,
+        decode: bool = False,
+        decode_position: int = 0,
+        deterministic: bool = True,
+    ) -> Dict[str, Any]:
+        args = self.args
+        assert prev_output_tokens is not None or token_embeddings is not None
+
+        embed_tokens = None
+        if args.vocab_size > 0:
+            embed_tokens = nn.Embed(
+                args.vocab_size,
+                args.decoder_embed_dim,
+                dtype=self.dtype,
+                name="embed_tokens",
+            )
+        if token_embeddings is None:
+            token_embeddings = embed_tokens(prev_output_tokens)
+
+        embed_scale = (
+            1.0 if args.no_scale_embedding else math.sqrt(args.decoder_embed_dim)
+        )
+        x = embed_scale * token_embeddings
+        if args.layernorm_embedding:
+            x = RMSNorm(
+                args.decoder_embed_dim,
+                eps=args.layernorm_eps,
+                dtype=self.dtype,
+                name="layernorm_embedding",
+            )(x)
+        x = nn.Dropout(args.dropout)(x, deterministic=deterministic)
+
+        T = x.shape[1]
+        chunkwise = args.chunkwise_recurrent and not decode
+        if chunkwise and T % args.recurrent_chunk_size != 0:
+            pad = args.recurrent_chunk_size - T % args.recurrent_chunk_size
+            x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        slen = x.shape[1]
+
+        rel_pos = retnet_rel_pos(
+            # recurrent mode positions at decode_position (1-indexed length)
+            decode_position + 1 if decode else slen,
+            args.decoder_embed_dim,
+            args.decoder_retention_heads,
+            activate_recurrent=decode,
+            chunkwise_recurrent=chunkwise,
+            recurrent_chunk_size=args.recurrent_chunk_size,
+        )
+
+        inner_states = [x]
+        l_aux = []
+        moe_freq = args.moe_freq
+        for i in range(args.decoder_layers):
+            is_moe_layer = moe_freq != 0 and (i + 1) % moe_freq == 0
+            x, l_aux_i = RetNetDecoderLayer(
+                args=args,
+                depth=i,
+                is_moe_layer=is_moe_layer,
+                dtype=self.dtype,
+                name=f"layers_{i}",
+            )(
+                x,
+                rel_pos,
+                chunkwise_recurrent=chunkwise,
+                decode=decode,
+                deterministic=deterministic,
+            )
+            l_aux.append(l_aux_i)
+            inner_states.append(x)
+
+        if chunkwise and slen != T:
+            x = x[:, :T]
+
+        if args.decoder_normalize_before:
+            x = RMSNorm(
+                args.decoder_embed_dim,
+                eps=args.layernorm_eps,
+                dtype=self.dtype,
+                name="layer_norm",
+            )(x)
+
+        if not features_only and not args.no_output_layer and args.vocab_size > 0:
+            if args.share_decoder_input_output_embed:
+                x = embed_tokens.attend(x)
+            else:
+                x = nn.Dense(
+                    args.vocab_size,
+                    use_bias=False,
+                    dtype=self.dtype,
+                    kernel_init=nn.initializers.normal(args.decoder_embed_dim**-0.5),
+                    name="output_projection",
+                )(x)
+
+        return {
+            "decoder_out": x,
+            "inner_states": inner_states if return_all_hiddens else [x],
+            "l_aux": l_aux,
+            "attn": None,
+        }

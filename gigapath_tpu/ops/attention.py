@@ -172,6 +172,7 @@ class MultiheadAttention(nn.Module):
         attn_mask: Optional[jnp.ndarray] = None,
         rel_pos: Optional[jnp.ndarray] = None,
         is_causal: bool = False,
+        decode: bool = False,
         deterministic: bool = True,
     ) -> jnp.ndarray:
         assert self.self_attention ^ self.encoder_decoder_attention
@@ -192,8 +193,39 @@ class MultiheadAttention(nn.Module):
         if self.xpos_rel_pos and self.self_attention:
             from gigapath_tpu.ops.xpos import apply_xpos
 
+            assert not decode, "xPos + incremental decode not supported"
             k = apply_xpos(k, scale_base=self.xpos_scale_base, downscale=True)
             q = apply_xpos(q, scale_base=self.xpos_scale_base, downscale=False)
+
+        if decode and self.self_attention:
+            # flax-style KV cache: the incremental-state counterpart of the
+            # reference (multihead_attention.py:129-144 stores prev_key/
+            # prev_value dicts). Cache shape is fixed by the first (init)
+            # call; subsequent calls write the new rows at cache_index and
+            # attend the whole buffer with future rows masked.
+            is_initialized = self.has_variable("cache", "cached_key")
+            cached_key = self.variable("cache", "cached_key", jnp.zeros, k.shape, k.dtype)
+            cached_value = self.variable("cache", "cached_value", jnp.zeros, v.shape, v.dtype)
+            cache_index = self.variable(
+                "cache", "cache_index", lambda: jnp.array(0, jnp.int32)
+            )
+            if is_initialized:
+                cur = cache_index.value
+                k = jax.lax.dynamic_update_slice(cached_key.value, k, (0, cur, 0, 0))
+                v = jax.lax.dynamic_update_slice(cached_value.value, v, (0, cur, 0, 0))
+                cached_key.value, cached_value.value = k, v
+                cache_index.value = cur + Lq
+                max_len = k.shape[1]
+                # per-query causal cache mask: query row i (absolute position
+                # cur+i) may attend keys <= cur+i — correct for single-token
+                # steps AND multi-token chunked prefill
+                qi = jnp.arange(Lq)[:, None]
+                ki = jnp.arange(max_len)[None, :]
+                cache_bias = jnp.where(ki <= (cur + qi), 0.0, NEG_INF)[None, None]
+                attn_mask = (
+                    cache_bias if attn_mask is None else attn_mask + cache_bias
+                )
+                is_causal = False  # the cache bias supersedes the triangle
 
         attn = self._attend(
             q,

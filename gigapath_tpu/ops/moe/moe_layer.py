@@ -79,10 +79,29 @@ class MOELayer(nn.Module):
     dtype: Any = None
 
     @classmethod
-    def from_config(cls, args, *, dtype=None, name: Optional[str] = None) -> "MOELayer":
-        """Build from an Encoder/Decoder config (the EncoderLayer MoE hook)."""
-        embed = getattr(args, "encoder_embed_dim", None) or args.decoder_embed_dim
-        ffn = getattr(args, "encoder_ffn_embed_dim", None) or args.decoder_ffn_embed_dim
+    def from_config(
+        cls,
+        args,
+        *,
+        prefix: Optional[str] = None,
+        dtype=None,
+        name: Optional[str] = None,
+    ) -> "MOELayer":
+        """Build from an Encoder/Decoder config (the EncoderLayer MoE hook).
+
+        ``prefix`` ("encoder" / "decoder") selects which dim fields to read —
+        required for EncoderDecoderConfig, which defines both; when omitted
+        it is inferred from whichever single prefix the config carries."""
+        if prefix is None:
+            has_enc = hasattr(args, "encoder_embed_dim")
+            has_dec = hasattr(args, "decoder_embed_dim")
+            assert has_enc ^ has_dec, (
+                "config defines both encoder_* and decoder_* dims; pass "
+                "prefix='encoder' or 'decoder'"
+            )
+            prefix = "encoder" if has_enc else "decoder"
+        embed = getattr(args, f"{prefix}_embed_dim")
+        ffn = getattr(args, f"{prefix}_ffn_embed_dim")
         return cls(
             embed_dim=embed,
             ffn_dim=ffn,

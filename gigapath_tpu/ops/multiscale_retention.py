@@ -1,0 +1,265 @@
+"""Multi-scale retention (RetNet) with parallel / recurrent / chunkwise modes.
+
+Parity with reference ``torchscale/component/multiscale_retention.py`` and
+the relative-position machinery in ``architecture/retnet.py:22-69``: xPos-like
+theta rotation of q/k, per-head exponential decay mask, the three
+mathematically-equivalent execution modes (O(T^2) parallel, O(1)-state
+recurrent, chunked recurrent), head-wise RMS group norm (no affine), swish
+output gate, and the stability normalizations (row abs-sum clamps with
+detached denominators).
+
+TPU mapping: the recurrent state rides the flax ``cache`` collection
+(``prev_key_value [B,H,Dk,Dv]`` + ``scale [H]``) instead of fairseq
+incremental dicts; the chunkwise cross-chunk accumulation is a
+``jax.lax.scan`` instead of a Python loop (``multiscale_retention.py:147-151``)
+so long sequences compile to one fused loop.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from gigapath_tpu.ops.norms import RMSNorm
+
+
+def rotate_every_two(x: jnp.ndarray) -> jnp.ndarray:
+    x1 = x[..., ::2]
+    x2 = x[..., 1::2]
+    return jnp.stack((-x2, x1), axis=-1).reshape(x.shape)
+
+
+def theta_shift(x: jnp.ndarray, sin: jnp.ndarray, cos: jnp.ndarray) -> jnp.ndarray:
+    return x * cos + rotate_every_two(x) * sin
+
+
+def retnet_angle_decay(embed_dim: int, num_heads: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(angle [Dk], decay [H]) constants (reference ``RetNetRelPos:22-30``)."""
+    key_dim = embed_dim // num_heads
+    angle = 1.0 / (10000 ** np.linspace(0, 1, key_dim // 2))
+    angle = np.repeat(angle, 2)
+    decay = np.log(1 - 2.0 ** (-5 - np.arange(num_heads, dtype=np.float64)))
+    return angle.astype(np.float32), decay.astype(np.float32)
+
+
+def retnet_rel_pos(
+    slen: int,
+    embed_dim: int,
+    num_heads: int,
+    *,
+    activate_recurrent: bool = False,
+    chunkwise_recurrent: bool = False,
+    recurrent_chunk_size: int = 512,
+):
+    """((sin, cos), inner_mask) for one mode (reference ``RetNetRelPos.forward``).
+
+    All outputs are trace-time numpy constants (static ``slen``), so under
+    ``jit`` they fold into the compiled program.
+    """
+    angle, decay = retnet_angle_decay(embed_dim, num_heads)
+    if activate_recurrent:
+        sin = np.sin(angle * (slen - 1))
+        cos = np.cos(angle * (slen - 1))
+        return (jnp.asarray(sin), jnp.asarray(cos)), jnp.asarray(np.exp(decay))
+
+    index = np.arange(slen, dtype=np.float64)
+    sin = np.sin(index[:, None] * angle[None, :]).astype(np.float32)
+    cos = np.cos(index[:, None] * angle[None, :]).astype(np.float32)
+
+    if chunkwise_recurrent:
+        C = recurrent_chunk_size
+        block = np.arange(C, dtype=np.float64)
+        tri = block[:, None] >= block[None, :]
+        diff = np.where(tri, block[:, None] - block[None, :], np.inf)
+        mask = np.exp(diff[None] * decay[:, None, None])  # [H, C, C]
+        mask = np.nan_to_num(mask)
+        value_inner_decay = mask[:, -1] / mask[:, -1].sum(axis=-1, keepdims=True)
+        value_inner_decay = value_inner_decay[:, :, None]
+        scale = np.sqrt(mask.sum(axis=-1, keepdims=True))
+        inner_mask = mask / scale
+        cross_decay = np.exp(decay * C)[:, None, None]
+        query_inner_decay = np.exp(decay[:, None] * (block + 1))
+        query_inner_decay = query_inner_decay[:, :, None] / (
+            scale / mask[:, -1].sum(axis=-1)[:, None, None]
+        )
+        return (
+            (jnp.asarray(sin), jnp.asarray(cos)),
+            (
+                jnp.asarray(inner_mask.astype(np.float32)),
+                jnp.asarray(cross_decay.astype(np.float32)),
+                jnp.asarray(query_inner_decay.astype(np.float32)),
+                jnp.asarray(value_inner_decay.astype(np.float32)),
+            ),
+        )
+
+    tri = index[:, None] >= index[None, :]
+    diff = np.where(tri, index[:, None] - index[None, :], np.inf)
+    mask = np.exp(diff[None] * decay[:, None, None])  # [H, T, T]
+    mask = np.nan_to_num(mask)
+    mask = mask / np.sqrt(mask.sum(axis=-1, keepdims=True))
+    return (jnp.asarray(sin), jnp.asarray(cos)), jnp.asarray(mask.astype(np.float32))
+
+
+class MultiScaleRetention(nn.Module):
+    """Retention op over ``[B, T, E]`` (reference ``MultiScaleRetention:39``).
+
+    Call with the matching ``rel_pos`` structure from :func:`retnet_rel_pos`;
+    ``decode=True`` (+ ``mutable=["cache"]``) runs the O(1)-state recurrent
+    step.
+    """
+
+    embed_dim: int
+    value_dim: int
+    num_heads: int
+    gate_fn: str = "swish"
+    layernorm_eps: float = 1e-6
+    dtype: Any = None
+
+    @property
+    def key_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.value_dim // self.num_heads
+
+    def _parallel(self, qr, kr, v, mask):
+        B, T, _ = v.shape
+        vr = v.reshape(B, T, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+        qk = jnp.einsum("bhtd,bhsd->bhts", qr, kr) * mask
+        denom = jnp.clip(
+            jnp.abs(jax.lax.stop_gradient(qk)).sum(-1, keepdims=True), 1.0, 5e4
+        )
+        out = jnp.einsum("bhts,bhsd->bhtd", qk / denom, vr)
+        return out.transpose(0, 2, 1, 3)  # [B, T, H, Dv]
+
+    def _chunkwise(self, qr, kr, v, inner):
+        mask, cross_decay, query_inner_decay, value_inner_decay = inner
+        B, T, _ = v.shape
+        H, Dk, Dv = self.num_heads, self.key_dim, self.head_dim
+        C = mask.shape[1]
+        N = T // C
+        assert T % C == 0, (T, C)
+        qr = qr.reshape(B, H, N, C, Dk).transpose(0, 2, 1, 3, 4)  # [B,N,H,C,Dk]
+        kr = kr.reshape(B, H, N, C, Dk).transpose(0, 2, 1, 3, 4)
+        vr = v.reshape(B, N, C, H, Dv).transpose(0, 1, 3, 2, 4)  # [B,N,H,C,Dv]
+
+        qk = jnp.einsum("bnhtd,bnhsd->bnhts", qr, kr) * mask
+        inner_scale = jnp.clip(
+            jnp.abs(jax.lax.stop_gradient(qk)).sum(-1, keepdims=True), 1.0
+        )
+        inner_output = jnp.einsum("bnhts,bnhsd->bnhtd", qk / inner_scale, vr)
+
+        # per-chunk kv summaries, then a scan threading (kv_state, kv_scale)
+        kv = jnp.einsum("bnhsd,bnhsv->bnhdv", kr, vr * value_inner_decay[None, None])
+
+        kv0 = jnp.zeros((B, H, Dk, Dv), v.dtype)
+        s0 = jnp.ones((B, H, 1, 1), v.dtype)
+
+        def step(carry, kv_i):
+            kv_state, kv_scale = carry
+            out = (kv_state / kv_scale, kv_scale)
+            kv_state = kv_state * cross_decay + kv_i
+            kv_scale = jnp.clip(
+                jnp.abs(jax.lax.stop_gradient(kv_state))
+                .sum(-2, keepdims=True)
+                .max(-1, keepdims=True),
+                1.0,
+            )
+            return (kv_state, kv_scale), out
+
+        _, (kv_recurrent, cross_scale) = jax.lax.scan(
+            step, (kv0, s0), kv.transpose(1, 0, 2, 3, 4)
+        )
+        kv_recurrent = kv_recurrent.transpose(1, 0, 2, 3, 4)  # [B,N,H,Dk,Dv]
+        cross_scale = cross_scale.transpose(1, 0, 2, 3, 4)  # [B,N,H,1,1]
+
+        all_scale = jnp.maximum(inner_scale, cross_scale)
+        cross_output = jnp.einsum(
+            "bnhtd,bnhdv->bnhtv", qr * query_inner_decay[None, None], kv_recurrent
+        )
+        output = inner_output / (all_scale / inner_scale) + cross_output / (
+            all_scale / cross_scale
+        )
+        return output.transpose(0, 1, 3, 2, 4).reshape(B, T, H, Dv)
+
+    def _recurrent(self, qr, kr, v, decay):
+        """One-token step against the flax cache (reference
+        ``recurrent_forward:89-112``)."""
+        B = v.shape[0]
+        H, Dk, Dv = self.num_heads, self.key_dim, self.head_dim
+        vr = v.reshape(B, H, Dv)
+        kv = jnp.einsum("bhd,bhv->bhdv", kr[:, :, 0, :], vr)
+
+        # cache starts at zeros; the first real step then computes
+        # scale = 0*decay + 1 = 1 and kv = kv/sqrt(1), matching the
+        # reference's explicit first-step branch (``recurrent_forward:105-106``).
+        # Writes happen only on real (post-init) steps so the init trace
+        # cannot seed the cache with the dummy input.
+        has_cache = self.has_variable("cache", "prev_key_value")
+        prev_kv = self.variable(
+            "cache", "prev_key_value", jnp.zeros, (B, H, Dk, Dv), v.dtype
+        )
+        prev_scale = self.variable("cache", "scale", jnp.zeros, (H,), jnp.float32)
+        if has_cache:
+            scale = prev_scale.value * decay + 1
+            kv = prev_kv.value * (
+                jnp.sqrt(prev_scale.value) * decay / jnp.sqrt(scale)
+            ).reshape(1, H, 1, 1) + kv / jnp.sqrt(scale).reshape(1, H, 1, 1)
+            prev_kv.value = kv
+            prev_scale.value = scale
+        out = jnp.einsum("bhd,bhdv->bhv", qr[:, :, 0, :], kv)
+        return out.reshape(B, 1, H, Dv)
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        rel_pos,
+        chunkwise_recurrent: bool = False,
+        decode: bool = False,
+    ) -> jnp.ndarray:
+        B, T, _ = x.shape
+        (sin, cos), inner_mask = rel_pos
+        gain = 2.0**-2.5
+
+        proj = lambda dim, name, g=gain: nn.Dense(  # noqa: E731
+            dim,
+            use_bias=False,
+            dtype=self.dtype,
+            # torch xavier_uniform(gain=g) == variance_scaling(g^2, fan_avg,
+            # uniform): both give Var = g^2 / fan_avg
+            kernel_init=nn.initializers.variance_scaling(g * g, "fan_avg", "uniform"),
+            name=name,
+        )
+        q = proj(self.embed_dim, "q_proj")(x)
+        k = proj(self.embed_dim, "k_proj")(x) * (self.key_dim**-0.5)
+        v = proj(self.value_dim, "v_proj")(x)
+        g = proj(self.value_dim, "g_proj")(x)
+
+        q = q.reshape(B, T, self.num_heads, self.key_dim).transpose(0, 2, 1, 3)
+        k = k.reshape(B, T, self.num_heads, self.key_dim).transpose(0, 2, 1, 3)
+        qr = theta_shift(q, sin, cos)
+        kr = theta_shift(k, sin, cos)
+
+        if decode:
+            output = self._recurrent(qr, kr, v, inner_mask)
+        elif chunkwise_recurrent:
+            output = self._chunkwise(qr, kr, v, inner_mask)
+        else:
+            output = self._parallel(qr, kr, v, inner_mask)
+
+        output = RMSNorm(
+            self.head_dim,
+            eps=self.layernorm_eps,
+            elementwise_affine=False,
+            name="group_norm",
+        )(output)
+        output = output.reshape(B, T, self.value_dim)
+        output = nn.silu(g) * output if self.gate_fn == "swish" else nn.gelu(g) * output
+        return proj(self.embed_dim, "out_proj", 2.0**-1)(output)
