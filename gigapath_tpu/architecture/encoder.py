@@ -52,6 +52,7 @@ class EncoderLayer(nn.Module):
             layernorm_eps=self.args.layernorm_eps,
             xpos_rel_pos=self.args.xpos_rel_pos,
             xpos_scale_base=self.args.xpos_scale_base,
+            multiway=self.args.multiway,
             dtype=self.dtype,
             name="self_attn",
         )
@@ -77,16 +78,19 @@ class EncoderLayer(nn.Module):
         encoder_padding_mask: Optional[jnp.ndarray] = None,
         attn_mask: Optional[jnp.ndarray] = None,
         rel_pos: Optional[jnp.ndarray] = None,
+        multiway_split_position: int = -1,
         deterministic: bool = True,
     ):
         args = self.args
-        if args.multiway:
-            raise NotImplementedError(
-                "multiway encoder layers land with the BEiT-3 model family"
+        split = multiway_split_position
+        from gigapath_tpu.ops.multiway import maybe_multiway
+
+        def ln(name):
+            make = lambda name: nn.LayerNorm(  # noqa: E731
+                epsilon=args.layernorm_eps, dtype=self.dtype, name=name
             )
-        ln = lambda name: nn.LayerNorm(  # noqa: E731
-            epsilon=args.layernorm_eps, dtype=self.dtype, name=name
-        )
+            fn = maybe_multiway(args.multiway, make, name)
+            return lambda x: fn(x, split_position=split)
         if args.drop_path_rate > 0:
             prob = float(np.linspace(0, args.drop_path_rate, args.encoder_layers)[self.depth])
             drop_path = DropPath(prob)
@@ -107,6 +111,7 @@ class EncoderLayer(nn.Module):
             key_padding_mask=encoder_padding_mask,
             attn_mask=attn_mask,
             rel_pos=rel_pos,
+            multiway_split_position=split,
             deterministic=deterministic,
         )
         x = dropout(x, deterministic=deterministic)
@@ -120,7 +125,7 @@ class EncoderLayer(nn.Module):
         if args.encoder_normalize_before:
             x = ln("final_layer_norm")(x)
         if not self.is_moe_layer:
-            x = FeedForwardNetwork(
+            make_ffn = lambda name: FeedForwardNetwork(  # noqa: E731
                 embed_dim=args.encoder_embed_dim,
                 ffn_dim=args.encoder_ffn_embed_dim,
                 activation_fn=args.activation_fn,
@@ -129,8 +134,11 @@ class EncoderLayer(nn.Module):
                 layernorm_eps=args.layernorm_eps,
                 subln=args.subln,
                 dtype=self.dtype,
-                name="ffn",
-            )(x, deterministic=deterministic)
+                name=name,
+            )
+            x = maybe_multiway(args.multiway, make_ffn, "ffn")(
+                x, deterministic, split_position=split
+            )
             l_aux = None
         else:
             try:
@@ -166,9 +174,9 @@ class Encoder(nn.Module):
     def build_encoder_layer(self, depth: int, is_moe_layer: bool) -> nn.Module:
         cls = type(self).layer_cls
         if self.args.checkpoint_activations:
-            # flax counts the module itself as arg 0, so `deterministic`
-            # (5th call arg) is static_argnums=5
-            cls = nn.remat(cls, static_argnums=(5,))
+            # flax counts the module itself as arg 0; multiway_split_position
+            # (arg 5) and deterministic (arg 6) are both static
+            cls = nn.remat(cls, static_argnums=(5, 6))
         return cls(
             args=self.args,
             depth=depth,
@@ -188,6 +196,9 @@ class Encoder(nn.Module):
         attn_mask: Optional[jnp.ndarray] = None,
         return_all_hiddens: bool = False,
         features_only: bool = False,
+        multiway_split_position: int = -1,
+        positions: Optional[jnp.ndarray] = None,
+        embed_positions: Optional[Any] = None,
         deterministic: bool = True,
     ) -> Dict[str, Any]:
         args = self.args
@@ -206,8 +217,19 @@ class Encoder(nn.Module):
 
         embed_scale = 1.0 if args.no_scale_embedding else math.sqrt(args.encoder_embed_dim)
         x = embed = embed_scale * token_embeddings
+        if embed_positions is not None:
+            # positional module injected by the model layer (BEiT3 passes a
+            # multiway pair of learned tables; reference encoder.py:347-349)
+            x = x + embed_positions(x, positions, multiway_split_position)
         if args.layernorm_embedding:
-            x = nn.LayerNorm(epsilon=args.layernorm_eps, dtype=self.dtype, name="layernorm_embedding")(x)
+            from gigapath_tpu.ops.multiway import maybe_multiway
+
+            make = lambda name: nn.LayerNorm(  # noqa: E731
+                epsilon=args.layernorm_eps, dtype=self.dtype, name=name
+            )
+            x = maybe_multiway(args.multiway, make, "layernorm_embedding")(
+                x, split_position=multiway_split_position
+            )
         x = nn.Dropout(args.dropout)(x, deterministic=deterministic)
         x = x * (1 - encoder_padding_mask[..., None].astype(x.dtype))
 
@@ -233,6 +255,7 @@ class Encoder(nn.Module):
                 encoder_padding_mask,
                 attn_mask,
                 rel_pos_bias,
+                multiway_split_position,
                 deterministic,
             )
             if return_all_hiddens:
@@ -248,7 +271,14 @@ class Encoder(nn.Module):
             self.sow("intermediates", "moe_l_aux", sum(moe_losses))
 
         if args.encoder_normalize_before and args.normalize_output:
-            x = nn.LayerNorm(epsilon=args.layernorm_eps, dtype=self.dtype, name="layer_norm")(x)
+            from gigapath_tpu.ops.multiway import maybe_multiway
+
+            make = lambda name: nn.LayerNorm(  # noqa: E731
+                epsilon=args.layernorm_eps, dtype=self.dtype, name=name
+            )
+            x = maybe_multiway(args.multiway, make, "layer_norm")(
+                x, split_position=multiway_split_position
+            )
 
         if not features_only and not args.no_output_layer and args.vocab_size > 0:
             x = nn.Dense(

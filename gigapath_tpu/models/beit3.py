@@ -1,0 +1,154 @@
+"""BEiT-3: multiway vision-language encoder.
+
+Parity with reference ``torchscale/model/BEiT3.py``: text embedding + conv
+vision embedding (mask token, cls prepend), a multiway pair of learned
+positional tables (vision positions / text positions, both fairseq-offset by
+2), and the multiway Encoder. The ``multiway_split_position`` is the static
+vision token count (cls + patches), so the two-branch split is free under
+``jit``. Unused by the gigapath pipeline (the reference ships it dormant);
+implemented for component parity.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from gigapath_tpu.architecture.config import EncoderConfig
+from gigapath_tpu.architecture.encoder import Encoder
+from gigapath_tpu.ops.embedding import (
+    PositionalEmbedding,
+    TextEmbedding,
+    VisionEmbedding,
+)
+
+
+class MultiwayPositionalEmbedding(nn.Module):
+    """A/B positional tables split at ``split_position`` (reference
+    ``MutliwayEmbedding``, multiway_network.py:47-55): branch A embeds the
+    vision span with positions 2..n_vis+1, branch B the text span with
+    positions 2..n_text+1."""
+
+    num_a: int
+    num_b: int
+    embed_dim: int
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        positions: Optional[jnp.ndarray] = None,
+        split_position: int = -1,
+    ) -> jnp.ndarray:
+        a = PositionalEmbedding(self.num_a, self.embed_dim, dtype=self.dtype, name="A")
+        b = PositionalEmbedding(self.num_b, self.embed_dim, dtype=self.dtype, name="B")
+        if self.is_initializing():
+            a(x, positions)
+            b(x, positions)
+        if split_position == -1:
+            return a(x, positions)
+        if split_position == 0:
+            return b(x, positions)
+        x1, x2 = jnp.split(x, [split_position], axis=1)
+        p1 = p2 = None
+        if positions is not None:
+            p1, p2 = positions[:, :split_position], positions[:, split_position:]
+        return jnp.concatenate([a(x1, p1), b(x2, p2)], axis=1)
+
+
+class BEiT3(nn.Module):
+    args: EncoderConfig
+    dtype: Any = None
+
+    def setup(self):
+        args = self.args
+        assert args.multiway
+        assert args.vocab_size > 0
+        assert not args.share_encoder_input_output_embed
+        # positions are added pre-scale; hold the reference's default
+        # no_scale_embedding=True so the addition orders agree
+        assert args.no_scale_embedding
+        self.text_embed = TextEmbedding(
+            args.vocab_size, args.encoder_embed_dim, dtype=self.dtype
+        )
+        self.vision_embed = VisionEmbedding(
+            args.img_size,
+            args.patch_size,
+            args.in_chans,
+            args.encoder_embed_dim,
+            contain_mask_token=True,
+            prepend_cls_token=True,
+            dtype=self.dtype,
+        )
+        self.embed_positions = MultiwayPositionalEmbedding(
+            num_a=self.vision_embed.num_position_embeddings() + 2,
+            num_b=args.max_source_positions,
+            embed_dim=args.encoder_embed_dim,
+            dtype=self.dtype,
+        )
+        self.encoder = Encoder(args=self.args, dtype=self.dtype)
+
+    def __call__(
+        self,
+        textual_tokens: Optional[jnp.ndarray] = None,
+        visual_tokens: Optional[jnp.ndarray] = None,
+        text_padding_position: Optional[jnp.ndarray] = None,
+        attn_mask: Optional[jnp.ndarray] = None,
+        vision_masked_position: Optional[jnp.ndarray] = None,
+        positions: Optional[jnp.ndarray] = None,
+        deterministic: bool = True,
+    ) -> Dict[str, Any]:
+        assert textual_tokens is not None or visual_tokens is not None
+
+        if self.is_initializing():
+            # materialize every branch (both embedders, both multiway sides)
+            # regardless of which modality the init inputs carry, so any
+            # later call pattern finds a complete parameter tree
+            args = self.args
+            B = (textual_tokens if visual_tokens is None else visual_tokens).shape[0]
+            if textual_tokens is None:
+                textual_tokens = jnp.zeros((B, 1), jnp.int32)
+            if visual_tokens is None:
+                visual_tokens = jnp.zeros(
+                    (B, args.img_size, args.img_size, args.in_chans), jnp.float32
+                )
+
+        if textual_tokens is None:
+            x = self.vision_embed(visual_tokens, vision_masked_position)
+            encoder_padding_mask = None
+            multiway_split_position = -1
+        elif visual_tokens is None:
+            x = self.text_embed(textual_tokens)
+            encoder_padding_mask = text_padding_position
+            multiway_split_position = 0
+        else:
+            x1 = self.vision_embed(visual_tokens, vision_masked_position)
+            multiway_split_position = x1.shape[1]
+            x2 = self.text_embed(textual_tokens)
+            x = jnp.concatenate([x1, x2], axis=1)
+            if text_padding_position is not None:
+                encoder_padding_mask = jnp.concatenate(
+                    [
+                        jnp.zeros(x1.shape[:-1], bool),
+                        text_padding_position,
+                    ],
+                    axis=1,
+                )
+            else:
+                encoder_padding_mask = None
+
+        encoder_out = self.encoder(
+            token_embeddings=x,
+            encoder_padding_mask=encoder_padding_mask,
+            attn_mask=attn_mask,
+            multiway_split_position=multiway_split_position,
+            positions=positions,
+            embed_positions=self.embed_positions,
+            features_only=True,
+            deterministic=deterministic,
+        )
+        encoder_out["multiway_split_position"] = multiway_split_position
+        return encoder_out

@@ -33,6 +33,7 @@ class LongNetEncoderLayer(EncoderLayer):
             layernorm_eps=args.layernorm_eps,
             xpos_rel_pos=args.xpos_rel_pos,
             xpos_scale_base=args.xpos_scale_base,
+            multiway=args.multiway,
             segment_length=tuple(args.segment_length),
             dilated_ratio=tuple(args.dilated_ratio),
             seq_parallel=args.seq_parallel,

@@ -117,6 +117,7 @@ class MultiheadAttention(nn.Module):
     layernorm_eps: float = 1e-5
     xpos_rel_pos: bool = False
     xpos_scale_base: int = 512
+    multiway: bool = False
     dtype: Any = None
 
     @property
@@ -173,22 +174,30 @@ class MultiheadAttention(nn.Module):
         rel_pos: Optional[jnp.ndarray] = None,
         is_causal: bool = False,
         decode: bool = False,
+        multiway_split_position: int = -1,
         deterministic: bool = True,
     ) -> jnp.ndarray:
         assert self.self_attention ^ self.encoder_decoder_attention
         B, Lq, _ = query.shape
         H, Dh = self.num_heads, self.head_dim
 
-        proj = lambda name: nn.Dense(  # noqa: E731
-            self.embed_dim,
-            use_bias=True,
-            dtype=self.dtype,
-            kernel_init=nn.initializers.xavier_uniform(),
-            name=name,
-        )
-        q = proj("q_proj")(query).reshape(B, Lq, H, Dh)
-        k = proj("k_proj")(key).reshape(B, key.shape[1], H, Dh)
-        v = proj("v_proj")(value).reshape(B, value.shape[1], H, Dh)
+        from gigapath_tpu.ops.multiway import maybe_multiway
+
+        def proj(name: str, x: jnp.ndarray) -> jnp.ndarray:
+            make = lambda name: nn.Dense(  # noqa: E731
+                self.embed_dim,
+                use_bias=True,
+                dtype=self.dtype,
+                kernel_init=nn.initializers.xavier_uniform(),
+                name=name,
+            )
+            return maybe_multiway(self.multiway, make, name)(
+                x, split_position=multiway_split_position
+            )
+
+        q = proj("q_proj", query).reshape(B, Lq, H, Dh)
+        k = proj("k_proj", key).reshape(B, key.shape[1], H, Dh)
+        v = proj("v_proj", value).reshape(B, value.shape[1], H, Dh)
 
         if self.xpos_rel_pos and self.self_attention:
             from gigapath_tpu.ops.xpos import apply_xpos
@@ -239,8 +248,11 @@ class MultiheadAttention(nn.Module):
         )
 
         if self.subln and self.self_attention:
-            attn = nn.LayerNorm(
-                epsilon=self.layernorm_eps, dtype=self.dtype, name="inner_attn_ln"
-            )(attn)
+            make_ln = lambda name: nn.LayerNorm(  # noqa: E731
+                epsilon=self.layernorm_eps, dtype=self.dtype, name=name
+            )
+            attn = maybe_multiway(self.multiway, make_ln, "inner_attn_ln")(
+                attn, split_position=multiway_split_position
+            )
 
-        return proj("out_proj")(attn)
+        return proj("out_proj", attn)

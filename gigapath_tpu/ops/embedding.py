@@ -1,0 +1,131 @@
+"""Embedding components (vision patch, text, learned positional, fused VL).
+
+Parity with reference ``torchscale/component/embedding.py``: conv patch
+embedding with optional mask token substitution and cls prepend
+(``VisionEmbedding:28``), text embedding with ``D**-0.5`` init
+(``TextEmbedding:93``), fairseq-convention learned positional embedding
+(positions start at 2, ``PositionalEmbedding:99``), and the concat
+vision+language embedding (``VisionLanguageEmbedding:9``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class VisionEmbedding(nn.Module):
+    """Image [B, H, W, C] -> patch tokens [B, (1+)N, D] (NHWC, TPU-native)."""
+
+    img_size: int = 224
+    patch_size: int = 16
+    in_chans: int = 3
+    embed_dim: int = 768
+    contain_mask_token: bool = False
+    prepend_cls_token: bool = False
+    dtype: Any = None
+
+    @property
+    def num_patches(self) -> int:
+        return (self.img_size // self.patch_size) ** 2
+
+    def num_position_embeddings(self) -> int:
+        return self.num_patches + (1 if self.prepend_cls_token else 0)
+
+    @nn.compact
+    def __call__(
+        self, x: jnp.ndarray, masked_position: Optional[jnp.ndarray] = None
+    ) -> jnp.ndarray:
+        B, H, W, C = x.shape
+        assert H == self.img_size and W == self.img_size, (
+            f"Input image size ({H}*{W}) doesn't match model "
+            f"({self.img_size}*{self.img_size})."
+        )
+        x = nn.Conv(
+            self.embed_dim,
+            kernel_size=(self.patch_size, self.patch_size),
+            strides=(self.patch_size, self.patch_size),
+            padding="VALID",
+            dtype=self.dtype,
+            name="proj",
+        )(x)
+        x = x.reshape(B, -1, self.embed_dim)
+
+        if masked_position is not None:
+            assert self.contain_mask_token
+            mask_token = self.param(
+                "mask_token", nn.initializers.zeros, (1, 1, self.embed_dim)
+            )
+            w = masked_position[..., None].astype(x.dtype)
+            x = x * (1 - w) + mask_token.astype(x.dtype) * w
+        elif self.contain_mask_token:
+            # keep the parameter in the tree even when unused this call
+            self.param("mask_token", nn.initializers.zeros, (1, 1, self.embed_dim))
+
+        if self.prepend_cls_token:
+            cls_token = self.param(
+                "cls_token", nn.initializers.zeros, (1, 1, self.embed_dim)
+            )
+            cls = jnp.broadcast_to(cls_token.astype(x.dtype), (B, 1, self.embed_dim))
+            x = jnp.concatenate([cls, x], axis=1)
+        return x
+
+
+class TextEmbedding(nn.Module):
+    """Token embedding with normal(std=D**-0.5) init."""
+
+    vocab_size: int
+    embed_dim: int
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
+        return nn.Embed(
+            self.vocab_size,
+            self.embed_dim,
+            embedding_init=nn.initializers.normal(self.embed_dim**-0.5),
+            dtype=self.dtype,
+            name="weight",
+        )(tokens)
+
+
+class PositionalEmbedding(nn.Module):
+    """Learned positional table; default positions are ``2..L+1`` (fairseq
+    convention, reference ``embedding.py:104-109``)."""
+
+    num_embeddings: int
+    embed_dim: int
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(
+        self, x: jnp.ndarray, positions: Optional[jnp.ndarray] = None
+    ) -> jnp.ndarray:
+        if positions is None:
+            positions = jnp.arange(2, x.shape[1] + 2)[None, :]
+        table = nn.Embed(
+            self.num_embeddings, self.embed_dim, dtype=self.dtype, name="weight"
+        )
+        return table(positions)
+
+
+class VisionLanguageEmbedding(nn.Module):
+    """Concat of vision tokens then text tokens (reference ``:9-26``)."""
+
+    text_embed: nn.Module
+    vision_embed: nn.Module
+
+    def __call__(
+        self,
+        textual_tokens: Optional[jnp.ndarray],
+        visual_tokens: Optional[jnp.ndarray],
+    ) -> jnp.ndarray:
+        if textual_tokens is None:
+            return self.vision_embed(visual_tokens)
+        if visual_tokens is None:
+            return self.text_embed(textual_tokens)
+        x1 = self.vision_embed(visual_tokens)
+        x2 = self.text_embed(textual_tokens)
+        return jnp.concatenate([x1, x2], axis=1)

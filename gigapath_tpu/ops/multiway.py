@@ -17,7 +17,13 @@ from flax import linen as nn
 
 
 class MultiwayNetwork(nn.Module):
-    """Wraps ``module_fn`` twice (branches A and B), splitting on an axis."""
+    """Wraps ``module_fn`` twice (branches A and B), splitting on an axis.
+
+    During ``init`` both branches are always traced (whatever the split), so
+    the parameter tree is complete no matter which modality the init inputs
+    exercise — the functional analogue of the reference eagerly deep-copying
+    module B in ``MultiwayNetwork.__init__``.
+    """
 
     module_fn: Callable[..., nn.Module]
     dim: int = 1
@@ -26,6 +32,9 @@ class MultiwayNetwork(nn.Module):
     def __call__(self, x: jnp.ndarray, *args, split_position: int = -1, **kwargs):
         a = self.module_fn(name="A")
         b = self.module_fn(name="B")
+        if self.is_initializing():
+            a(x, *args, **kwargs)
+            b(x, *args, **kwargs)
         if split_position == -1:
             return a(x, *args, **kwargs)
         if split_position == 0:
@@ -34,8 +43,18 @@ class MultiwayNetwork(nn.Module):
         return jnp.concatenate([a(x1, *args, **kwargs), b(x2, *args, **kwargs)], axis=self.dim)
 
 
-def multiway_wrapper(multiway: bool, module_fn: Callable[..., nn.Module], dim: int = 1):
-    """Factory parity with ``MultiwayWrapper`` — identity unless multiway."""
+def maybe_multiway(
+    multiway: bool, module_fn: Callable[..., nn.Module], name: str, dim: int = 1
+) -> Callable:
+    """One call surface for both paths (parity with ``MultiwayWrapper``):
+    returns ``fn(x, *args, split_position=-1, **kwargs)`` that routes through
+    a two-branch :class:`MultiwayNetwork` when ``multiway`` and through a
+    single ``module_fn(name=name)`` (ignoring the split) otherwise. Must be
+    called from inside the parent module's compact scope."""
     if multiway:
-        return MultiwayNetwork(module_fn=module_fn, dim=dim)
-    return module_fn()
+        mod = MultiwayNetwork(module_fn=module_fn, dim=dim, name=name)
+        return lambda x, *a, split_position=-1, **kw: mod(
+            x, *a, split_position=split_position, **kw
+        )
+    mod = module_fn(name=name)
+    return lambda x, *a, split_position=-1, **kw: mod(x, *a, **kw)

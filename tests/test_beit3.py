@@ -1,0 +1,193 @@
+"""Embedding components, multiway encoder integration, BEiT-3.
+
+Pins: conv patch embedding shapes and mask-token substitution, fairseq
+position offset, the multiway A/B split actually routing tokens through
+different parameters, and BEiT-3 end-to-end over text / vision / fused
+inputs (reference ``torchscale/model/BEiT3.py``, ``component/embedding.py``,
+``component/multiway_network.py``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gigapath_tpu.architecture.config import EncoderConfig
+from gigapath_tpu.models.beit3 import BEiT3
+from gigapath_tpu.ops.embedding import (
+    PositionalEmbedding,
+    TextEmbedding,
+    VisionEmbedding,
+)
+from gigapath_tpu.ops.multiway import MultiwayNetwork
+from flax import linen as nn
+
+
+class TestVisionEmbedding:
+    def test_patch_count_and_cls(self, rng):
+        ve = VisionEmbedding(
+            img_size=32, patch_size=16, embed_dim=24, prepend_cls_token=True,
+            contain_mask_token=True,
+        )
+        x = jnp.asarray(rng.normal(size=(2, 32, 32, 3)), jnp.float32)
+        params = ve.init(jax.random.PRNGKey(0), x)["params"]
+        out = ve.apply({"params": params}, x)
+        assert out.shape == (2, 5, 24)  # 4 patches + cls
+        assert ve.num_position_embeddings() == 5
+
+    def test_mask_token_substitution(self, rng):
+        ve = VisionEmbedding(
+            img_size=32, patch_size=16, embed_dim=24, contain_mask_token=True
+        )
+        x = jnp.asarray(rng.normal(size=(1, 32, 32, 3)), jnp.float32)
+        params = ve.init(jax.random.PRNGKey(0), x)["params"]
+        params = jax.tree.map(lambda v: v, params)
+        params["mask_token"] = params["mask_token"] + 7.0
+        masked = jnp.asarray([[1, 0, 0, 0]], jnp.int32)
+        out = ve.apply({"params": params}, x, masked)
+        ref = ve.apply({"params": params}, x)
+        np.testing.assert_allclose(np.asarray(out[0, 0]), 7.0, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(out[0, 1:]), np.asarray(ref[0, 1:]), atol=1e-6
+        )
+
+
+def test_positional_embedding_fairseq_offset(rng):
+    pe = PositionalEmbedding(10, 8)
+    x = jnp.zeros((1, 3, 8))
+    params = pe.init(jax.random.PRNGKey(0), x)["params"]
+    out = pe.apply({"params": params}, x)
+    table = np.asarray(params["weight"]["embedding"])
+    np.testing.assert_allclose(np.asarray(out[0]), table[2:5], atol=1e-6)
+
+
+def test_text_embedding_init_std(rng):
+    te = TextEmbedding(1000, 64)
+    params = te.init(jax.random.PRNGKey(0), jnp.zeros((1, 2), jnp.int32))["params"]
+    w = np.asarray(params["weight"]["embedding"])
+    assert abs(w.std() - 64**-0.5) / 64**-0.5 < 0.1
+
+
+class TestMultiwayEncoder:
+    def _cfg(self):
+        return EncoderConfig(
+            encoder_embed_dim=32,
+            encoder_attention_heads=4,
+            encoder_ffn_embed_dim=64,
+            encoder_layers=2,
+            multiway=True,
+            vocab_size=-1,
+            no_output_layer=True,
+            dropout=0.0,
+            drop_path_rate=0.0,
+        )
+
+    def test_split_routes_through_distinct_params(self, rng):
+        from gigapath_tpu.architecture.encoder import Encoder
+
+        enc = Encoder(self._cfg())
+        x = jnp.asarray(rng.normal(size=(1, 8, 32)), jnp.float32)
+        params = enc.init(
+            jax.random.PRNGKey(0), token_embeddings=x, multiway_split_position=4
+        )["params"]
+        # A and B branches exist for ffn and projections
+        ffn = params["layers_0"]["ffn"]
+        assert "A" in ffn and "B" in ffn
+        out_full_a = enc.apply(
+            {"params": params}, token_embeddings=x, multiway_split_position=-1
+        )["encoder_out"]
+        out_split = enc.apply(
+            {"params": params}, token_embeddings=x, multiway_split_position=4
+        )["encoder_out"]
+        # branch B differs from branch A -> the text half changes
+        assert not np.allclose(np.asarray(out_full_a[:, 4:]), np.asarray(out_split[:, 4:]))
+
+    def test_split_zero_uses_branch_b_everywhere(self, rng):
+        from gigapath_tpu.architecture.encoder import Encoder
+
+        enc = Encoder(self._cfg())
+        x = jnp.asarray(rng.normal(size=(1, 6, 32)), jnp.float32)
+        params = enc.init(
+            jax.random.PRNGKey(0), token_embeddings=x, multiway_split_position=3
+        )["params"]
+        out0 = enc.apply(
+            {"params": params}, token_embeddings=x, multiway_split_position=0
+        )["encoder_out"]
+        assert np.isfinite(np.asarray(out0)).all()
+
+
+class TestBEiT3:
+    def _model(self):
+        cfg = EncoderConfig(
+            encoder_embed_dim=32,
+            encoder_attention_heads=4,
+            encoder_ffn_embed_dim=64,
+            encoder_layers=2,
+            multiway=True,
+            vocab_size=100,
+            img_size=32,
+            patch_size=16,
+            dropout=0.0,
+            drop_path_rate=0.0,
+        )
+        return BEiT3(cfg)
+
+    def test_fused_vision_language(self, rng):
+        model = self._model()
+        text = jnp.asarray(rng.integers(0, 100, (2, 6)), jnp.int32)
+        image = jnp.asarray(rng.normal(size=(2, 32, 32, 3)), jnp.float32)
+        params = model.init(jax.random.PRNGKey(0), text, image)["params"]
+        out = model.apply({"params": params}, text, image)
+        assert out["encoder_out"].shape == (2, 5 + 6, 32)
+        assert out["multiway_split_position"] == 5
+
+    def test_single_modality(self, rng):
+        model = self._model()
+        text = jnp.asarray(rng.integers(0, 100, (2, 6)), jnp.int32)
+        image = jnp.asarray(rng.normal(size=(2, 32, 32, 3)), jnp.float32)
+        params = model.init(jax.random.PRNGKey(0), text, image)["params"]
+        out_t = model.apply({"params": params}, text, None)
+        assert out_t["encoder_out"].shape == (2, 6, 32)
+        out_v = model.apply({"params": params}, None, image)
+        assert out_v["encoder_out"].shape == (2, 5, 32)
+
+    def test_single_modality_init_builds_full_tree(self, rng):
+        """init with text only must still create vision + both multiway
+        branches, so later fused calls work."""
+        model = self._model()
+        text = jnp.asarray(rng.integers(0, 100, (2, 6)), jnp.int32)
+        image = jnp.asarray(rng.normal(size=(2, 32, 32, 3)), jnp.float32)
+        params = model.init(jax.random.PRNGKey(0), text, None)["params"]
+        assert "vision_embed" in params
+        assert set(params["encoder"]["layers_0"]["ffn"]) >= {"A", "B"}
+        out = model.apply({"params": params}, text, image)
+        assert np.isfinite(np.asarray(out["encoder_out"])).all()
+
+    def test_explicit_positions_with_fused_input(self, rng):
+        model = self._model()
+        text = jnp.asarray(rng.integers(0, 100, (1, 6)), jnp.int32)
+        image = jnp.asarray(rng.normal(size=(1, 32, 32, 3)), jnp.float32)
+        params = model.init(jax.random.PRNGKey(0), text, image)["params"]
+        L = 5 + 6
+        positions = jnp.arange(2, L + 2)[None, :]
+        out = model.apply({"params": params}, text, image, positions=positions)
+        assert out["encoder_out"].shape == (1, L, 32)
+
+    def test_text_padding_mask(self, rng):
+        model = self._model()
+        text = jnp.asarray(rng.integers(0, 100, (1, 6)), jnp.int32)
+        image = jnp.asarray(rng.normal(size=(1, 32, 32, 3)), jnp.float32)
+        params = model.init(jax.random.PRNGKey(0), text, image)["params"]
+        pad = jnp.zeros((1, 6), bool).at[0, 4:].set(True)
+        out = model.apply({"params": params}, text, image, text_padding_position=pad)
+        assert np.isfinite(np.asarray(out["encoder_out"])).all()
+
+
+def test_multiway_network_concat_identity(rng):
+    """split at L -> all tokens through A; at 0 -> all through B."""
+    make = lambda name: nn.Dense(8, name=name)  # noqa: E731
+    mw = MultiwayNetwork(module_fn=make)
+    x = jnp.asarray(rng.normal(size=(2, 6, 8)), jnp.float32)
+    params = mw.init(jax.random.PRNGKey(0), x, split_position=3)["params"]
+    full_a = mw.apply({"params": params}, x, split_position=-1)
+    split_end = mw.apply({"params": params}, x, split_position=6)
+    np.testing.assert_allclose(np.asarray(full_a), np.asarray(split_end), atol=1e-6)
