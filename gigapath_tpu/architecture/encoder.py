@@ -83,13 +83,12 @@ class EncoderLayer(nn.Module):
     ):
         args = self.args
         split = multiway_split_position
-        from gigapath_tpu.ops.multiway import maybe_multiway
+        from gigapath_tpu.ops.multiway import maybe_multiway, multiway_layernorm
 
         def ln(name):
-            make = lambda name: nn.LayerNorm(  # noqa: E731
-                epsilon=args.layernorm_eps, dtype=self.dtype, name=name
+            fn = multiway_layernorm(
+                args.multiway, name, epsilon=args.layernorm_eps, dtype=self.dtype
             )
-            fn = maybe_multiway(args.multiway, make, name)
             return lambda x: fn(x, split_position=split)
         if args.drop_path_rate > 0:
             prob = float(np.linspace(0, args.drop_path_rate, args.encoder_layers)[self.depth])
@@ -222,14 +221,14 @@ class Encoder(nn.Module):
             # multiway pair of learned tables; reference encoder.py:347-349)
             x = x + embed_positions(x, positions, multiway_split_position)
         if args.layernorm_embedding:
-            from gigapath_tpu.ops.multiway import maybe_multiway
+            from gigapath_tpu.ops.multiway import multiway_layernorm
 
-            make = lambda name: nn.LayerNorm(  # noqa: E731
-                epsilon=args.layernorm_eps, dtype=self.dtype, name=name
-            )
-            x = maybe_multiway(args.multiway, make, "layernorm_embedding")(
-                x, split_position=multiway_split_position
-            )
+            x = multiway_layernorm(
+                args.multiway,
+                "layernorm_embedding",
+                epsilon=args.layernorm_eps,
+                dtype=self.dtype,
+            )(x, split_position=multiway_split_position)
         x = nn.Dropout(args.dropout)(x, deterministic=deterministic)
         x = x * (1 - encoder_padding_mask[..., None].astype(x.dtype))
 
@@ -271,14 +270,14 @@ class Encoder(nn.Module):
             self.sow("intermediates", "moe_l_aux", sum(moe_losses))
 
         if args.encoder_normalize_before and args.normalize_output:
-            from gigapath_tpu.ops.multiway import maybe_multiway
+            from gigapath_tpu.ops.multiway import multiway_layernorm
 
-            make = lambda name: nn.LayerNorm(  # noqa: E731
-                epsilon=args.layernorm_eps, dtype=self.dtype, name=name
-            )
-            x = maybe_multiway(args.multiway, make, "layer_norm")(
-                x, split_position=multiway_split_position
-            )
+            x = multiway_layernorm(
+                args.multiway,
+                "layer_norm",
+                epsilon=args.layernorm_eps,
+                dtype=self.dtype,
+            )(x, split_position=multiway_split_position)
 
         if not features_only and not args.no_output_layer and args.vocab_size > 0:
             x = nn.Dense(

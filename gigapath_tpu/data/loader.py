@@ -1,0 +1,120 @@
+"""Host-side data loaders feeding the jax training loop.
+
+Counterpart of reference ``finetune/utils.py:162-206`` (``get_loader``):
+class-weighted random sampling for imbalanced multi-class training, seeded
+shuffling, sequential eval loaders, slide collate.
+
+TPU design: a plain, dependency-free Python iterator instead of
+``torch.utils.data.DataLoader`` worker pools — slide *embeddings* are small
+(the heavy tile encoding already happened on-device), so host IO is not the
+bottleneck; determinism comes from one ``np.random.Generator`` seeded per
+loader rather than per-worker seed plumbing (``utils.py:182-187``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, Optional, Sequence
+
+import numpy as np
+
+from gigapath_tpu.data.collate import slide_collate_fn
+
+
+def class_balance_weights(labels: np.ndarray) -> np.ndarray:
+    """Per-sample inverse-frequency weights from integer labels [N, 1]
+    (reference ``utils.py:168-176``)."""
+    labels = np.asarray(labels)[:, 0].astype(int)
+    n = len(labels)
+    counts = {}
+    for label in labels:
+        counts[label] = counts.get(label, 0) + 1.0 / n
+    return np.asarray([1.0 / counts[label] for label in labels])
+
+
+class DataLoader:
+    """Minimal seeded loader: sampler + batcher + collate.
+
+    ``shuffle``: uniform random sampling without replacement per epoch;
+    ``weights``: sample WITH replacement proportional to weights (the
+    WeightedRandomSampler path). Iterating yields collated batch dicts.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int = 1,
+        shuffle: bool = False,
+        weights: Optional[Sequence[float]] = None,
+        collate_fn: Callable = slide_collate_fn,
+        seed: int = 0,
+        drop_last: bool = False,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.weights = None if weights is None else np.asarray(weights, np.float64)
+        self.collate_fn = collate_fn
+        self.rng = np.random.default_rng(seed)
+        self.drop_last = drop_last
+
+    def __len__(self) -> int:
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def _indices(self) -> np.ndarray:
+        n = len(self.dataset)
+        if self.weights is not None:
+            p = self.weights / self.weights.sum()
+            return self.rng.choice(n, size=n, replace=True, p=p)
+        if self.shuffle:
+            return self.rng.permutation(n)
+        return np.arange(n)
+
+    def __iter__(self) -> Iterator[dict]:
+        indices = self._indices()
+        for start in range(0, len(indices), self.batch_size):
+            chunk = indices[start : start + self.batch_size]
+            if self.drop_last and len(chunk) < self.batch_size:
+                return
+            batch = self.collate_fn([self.dataset[int(i)] for i in chunk])
+            if batch is not None:
+                yield batch
+
+
+def get_loader(
+    train_dataset,
+    val_dataset,
+    test_dataset,
+    task_config: dict,
+    weighted_sample: bool = False,
+    batch_size: int = 1,
+    seed: int = 0,
+    **kwargs,
+):
+    """(train, val, test) loaders (reference ``get_loader:162``): weighted
+    sampling only for non-multi-label tasks; eval loaders batch_size 1,
+    sequential."""
+    weights = None
+    if weighted_sample and task_config.get("setting", "multi_class") != "multi_label":
+        weights = class_balance_weights(train_dataset.labels)
+
+    train_loader = DataLoader(
+        train_dataset,
+        batch_size=batch_size,
+        shuffle=weights is None,
+        weights=weights,
+        seed=seed,
+    )
+    val_loader = (
+        DataLoader(val_dataset, batch_size=1, seed=seed)
+        if val_dataset is not None
+        else None
+    )
+    test_loader = (
+        DataLoader(test_dataset, batch_size=1, seed=seed)
+        if test_dataset is not None
+        else None
+    )
+    return train_loader, val_loader, test_loader

@@ -1,0 +1,175 @@
+"""Slide-level tile-embedding dataset (h5 / pt).
+
+Parity with reference ``finetune/datasets/slide_datatset.py``: validates
+which slides have stored tile encodings, maps labels per task setting
+(multi_class / binary / multi_label via the task-config ``label_dict``),
+reads ``features``/``coords`` from h5 (or a bare tensor from ``.pt``),
+optionally shuffles tiles, truncates to ``max_tiles``, and retries a sample
+3x with a random re-draw before skipping (``get_sample_with_try:219``).
+
+TPU deltas: samples are numpy arrays (the host side of a jax pipeline);
+torch is only touched to deserialize ``.pt`` payloads.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def read_assets_from_h5(h5_path: str) -> Tuple[Dict[str, np.ndarray], Dict]:
+    """Read every dataset (and its attrs) from an h5 file."""
+    import h5py
+
+    assets, attrs = {}, {}
+    with h5py.File(h5_path, "r") as f:
+        for key in f.keys():
+            assets[key] = f[key][:]
+            if f[key].attrs is not None:
+                attrs[key] = dict(f[key].attrs)
+    return assets, attrs
+
+
+def _load_pt(path: str) -> np.ndarray:
+    import torch
+
+    t = torch.load(path, map_location="cpu", weights_only=False)
+    return np.asarray(t.detach().cpu().numpy() if hasattr(t, "detach") else t)
+
+
+class SlideDatasetForTasks:
+    """Task setup: label mapping + split filtering (reference ``:10-115``)."""
+
+    def __init__(
+        self,
+        data_df,
+        root_path: str,
+        splits: List[str],
+        task_config: dict,
+        slide_key: str = "slide_id",
+        split_key: str = "pat_id",
+        **kwargs,
+    ):
+        self.root_path = root_path
+        self.split_key = split_key
+        self.slide_key = slide_key
+        self.task_cfg = task_config
+
+        valid_slides = self.get_valid_slides(root_path, data_df[slide_key].values)
+        data_df = data_df[data_df[slide_key].isin(valid_slides)]
+        self.setup_data(data_df, splits, task_config.get("setting", "multi_class"))
+        self.max_tiles = task_config.get("max_tiles", 1000)
+        self.shuffle_tiles = task_config.get("shuffle_tiles", False)
+        print("Dataset has been initialized!")
+
+    def _slide_filename(self, slide_id: str) -> str:
+        ext = ".pt" if "pt_files" in self.root_path.split("/")[-1] else ".h5"
+        return slide_id.replace(".svs", "") + ext
+
+    def get_valid_slides(self, root_path: str, slides) -> List[str]:
+        valid = []
+        for slide_id in slides:
+            ext = ".pt" if "pt_files" in root_path.split("/")[-1] else ".h5"
+            path = os.path.join(root_path, slide_id.replace(".svs", "") + ext)
+            if not os.path.exists(path):
+                print("Missing: ", path)
+            else:
+                valid.append(slide_id)
+        return valid
+
+    def setup_data(self, df, splits: List[str], task: str = "multi_class"):
+        if task in ("multi_class", "binary"):
+            prepare = self.prepare_multi_class_or_binary_data
+        elif task == "multi_label":
+            prepare = self.prepare_multi_label_data
+        else:
+            raise ValueError(f"Invalid task: {task}")
+        self.slide_data, self.images, self.labels, self.n_classes = prepare(df, splits)
+
+    def prepare_multi_class_or_binary_data(self, df, splits: List[str]):
+        label_dict = self.task_cfg.get("label_dict", {})
+        assert label_dict, "No label_dict found in the task configuration"
+        assert "label" in df.columns, "No label column found in the dataframe"
+        df = df.copy()
+        df["label"] = df["label"].map(label_dict)
+        n_classes = len(label_dict)
+        assert self.split_key in df.columns, f"No {self.split_key} column found"
+        df = df[df[self.split_key].isin(splits)]
+        images = df[self.slide_key].to_list()
+        labels = df[["label"]].to_numpy().astype(int)
+        return df, images, labels, n_classes
+
+    def prepare_multi_label_data(self, df, splits: List[str]):
+        label_dict = self.task_cfg.get("label_dict", {})
+        assert label_dict, "No label_dict found in the task configuration"
+        label_keys = sorted(label_dict.keys(), key=lambda x: label_dict[x])
+        n_classes = len(label_dict)
+        assert self.split_key in df.columns, f"No {self.split_key} column found"
+        df = df[df[self.split_key].isin(splits)]
+        images = df[self.slide_key].to_list()
+        labels = df[label_keys].to_numpy().astype(int)
+        return df, images, labels, n_classes
+
+
+class SlideDataset(SlideDatasetForTasks):
+    """Sample access with shuffle/truncate/retry (reference ``:118-237``)."""
+
+    def __init__(self, *args, seed: int = 0, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._rng = np.random.default_rng(seed)
+
+    def shuffle_data(self, images: np.ndarray, coords: np.ndarray):
+        indices = self._rng.permutation(len(images))
+        return images[indices], coords[indices]
+
+    def get_images_from_path(self, img_path: str) -> dict:
+        if img_path.endswith(".pt"):
+            images = _load_pt(img_path)
+            coords = np.zeros((len(images), 2), np.float32)
+        else:
+            assets, _ = read_assets_from_h5(img_path)
+            images = np.asarray(assets["features"])
+            coords = np.asarray(assets["coords"])
+            if self.shuffle_tiles:
+                images, coords = self.shuffle_data(images, coords)
+            if images.shape[0] > self.max_tiles:
+                images = images[: self.max_tiles]
+            if coords.shape[0] > self.max_tiles:
+                coords = coords[: self.max_tiles]
+        return {
+            "imgs": images,
+            "img_lens": images.shape[0],
+            "pad_mask": 0,
+            "coords": coords,
+        }
+
+    def get_one_sample(self, idx: int) -> dict:
+        slide_id = self.images[idx]
+        slide_path = os.path.join(self.root_path, self._slide_filename(slide_id))
+        data = self.get_images_from_path(slide_path)
+        return {
+            "imgs": data["imgs"],
+            "img_lens": data["img_lens"],
+            "pad_mask": data["pad_mask"],
+            "coords": data["coords"],
+            "slide_id": slide_id,
+            "labels": np.asarray(self.labels[idx]),
+        }
+
+    def get_sample_with_try(self, idx: int, n_try: int = 3) -> Optional[dict]:
+        for _ in range(n_try):
+            try:
+                return self.get_one_sample(idx)
+            except Exception:
+                print("Error in getting the sample, try another index")
+                idx = int(self._rng.integers(0, len(self.slide_data)))
+        print("Error in getting the sample, skip the sample")
+        return None
+
+    def __len__(self) -> int:
+        return len(self.slide_data)
+
+    def __getitem__(self, idx: int) -> Optional[dict]:
+        return self.get_sample_with_try(idx)

@@ -1,0 +1,68 @@
+"""Train/val/test split management.
+
+Parity with reference ``finetune/utils.py:121-159``: per-fold
+``{train,val,test}_{fold}.csv`` files are fetched from ``split_dir`` when
+present, otherwise created with sklearn ``train_test_split`` keyed on
+``split_key`` (slide_id or pat_id for patient-stratified splits) with
+``random_state=fold``, optional training-subset sampling, then read back.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Tuple
+
+
+def get_splits(
+    df,
+    val_r: float = 0.1,
+    test_r: float = 0.2,
+    fold: int = 0,
+    split_dir: str = "",
+    fetch_splits: bool = True,
+    prop: float = 1,
+    split_key: str = "slide_id",
+    **kwargs,
+) -> Tuple[List[str], List[str], List[str]]:
+    """70/10/20 default split; returns lists of ``split_key`` values."""
+    import pandas as pd
+    from sklearn.model_selection import train_test_split
+
+    os.makedirs(split_dir, exist_ok=True)
+    files = os.listdir(split_dir)
+    train_name, val_name, test_name = (
+        f"train_{fold}.csv",
+        f"val_{fold}.csv",
+        f"test_{fold}.csv",
+    )
+    assert split_key in df.columns, f"{split_key} not in the columns of the dataframe"
+
+    missing = (
+        train_name not in files or val_name not in files or test_name not in files
+    )
+    if missing or not fetch_splits:
+        samples = df.drop_duplicates(split_key)[split_key].to_list()
+        train_samples, temp_samples = train_test_split(
+            samples, test_size=(val_r + test_r), random_state=fold
+        )
+        if val_r > 0:
+            val_samples, test_samples = train_test_split(
+                temp_samples, test_size=(test_r / (val_r + test_r)), random_state=fold
+            )
+        else:
+            val_samples, test_samples = [], temp_samples
+        train_data = df[df[split_key].isin(train_samples)]
+        val_data = df[df[split_key].isin(val_samples)]
+        test_data = df[df[split_key].isin(test_samples)]
+        if prop > 0:
+            train_data = train_data.sample(frac=prop, random_state=fold).reset_index(
+                drop=True
+            )
+        train_data.to_csv(os.path.join(split_dir, train_name))
+        val_data.to_csv(os.path.join(split_dir, val_name))
+        test_data.to_csv(os.path.join(split_dir, test_name))
+
+    train_splits = pd.read_csv(os.path.join(split_dir, train_name))[split_key].to_list()
+    val_splits = pd.read_csv(os.path.join(split_dir, val_name))[split_key].to_list()
+    test_splits = pd.read_csv(os.path.join(split_dir, test_name))[split_key].to_list()
+    return train_splits, val_splits, test_splits

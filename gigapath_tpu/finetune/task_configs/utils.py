@@ -1,0 +1,10 @@
+"""YAML task-config loader (reference ``finetune/task_configs/utils.py``)."""
+
+from __future__ import annotations
+
+
+def load_task_config(config_path: str) -> dict:
+    import yaml
+
+    with open(config_path, "r") as f:
+        return yaml.safe_load(f)

@@ -92,6 +92,7 @@ def get_model(
     model_arch: str = "gigapath_slide_enc12l768d",
     pretrained: str = "",
     freeze: bool = False,
+    global_pool: bool = False,
     rng=None,
     dtype: Any = None,
     **kwargs,
@@ -112,6 +113,7 @@ def get_model(
         feat_layer=feat_layer,
         n_classes=n_classes,
         model_arch=model_arch,
+        global_pool=global_pool,
         dtype=dtype,
         slide_kwargs=kwargs or None,
     )

@@ -174,6 +174,23 @@ def gigapath_slide_enc12l1536d(**kwargs):
     return _arch(dict(embed_dim=1536, depth=12, mlp_ratio=4.0, norm_eps=1e-6), kwargs)
 
 
+@register_model
+def gigapath_slide_enc_tiny(**kwargs):
+    """2-layer/32-dim smoke-test arch (parallel of ``LongNet_test``,
+    reference LongNetConfig.py:321-334)."""
+    return _arch(
+        dict(
+            embed_dim=32,
+            depth=2,
+            mlp_ratio=2.0,
+            norm_eps=1e-6,
+            segment_length=[16, 32],
+            dilated_ratio="[1, 2]",
+        ),
+        kwargs,
+    )
+
+
 def init_params(model: LongNetViT, rng: Optional[jax.Array] = None, seq_len: int = 4):
     """Initialize a param tree (tiny dummy inputs; shapes are L-independent)."""
     rng = rng if rng is not None else jax.random.PRNGKey(0)

@@ -103,9 +103,10 @@ class MultiheadAttention(nn.Module):
 
     Parity with reference ``multihead_attention.py:20-171``: optional xPos
     rotary position, optional sub-LayerNorm on the attention output
-    (``subln``), and an inner attention op returning ``(out, lse)``. The
-    Multiway (BEiT-3) wrapping of the projections is composed at the
-    architecture layer rather than baked in here.
+    (``subln``), an inner attention op returning ``(out, lse)``, and
+    Multiway (BEiT-3) two-branch projections/inner-LN when ``multiway`` is
+    set — the split index is passed per call as ``multiway_split_position``,
+    mirroring the reference's ``MultiwayWrapper``-wrapped projections.
     """
 
     embed_dim: int
@@ -248,11 +249,13 @@ class MultiheadAttention(nn.Module):
         )
 
         if self.subln and self.self_attention:
-            make_ln = lambda name: nn.LayerNorm(  # noqa: E731
-                epsilon=self.layernorm_eps, dtype=self.dtype, name=name
-            )
-            attn = maybe_multiway(self.multiway, make_ln, "inner_attn_ln")(
-                attn, split_position=multiway_split_position
-            )
+            from gigapath_tpu.ops.multiway import multiway_layernorm
+
+            attn = multiway_layernorm(
+                self.multiway,
+                "inner_attn_ln",
+                epsilon=self.layernorm_eps,
+                dtype=self.dtype,
+            )(attn, split_position=multiway_split_position)
 
         return proj("out_proj", attn)

@@ -43,6 +43,19 @@ class MultiwayNetwork(nn.Module):
         return jnp.concatenate([a(x1, *args, **kwargs), b(x2, *args, **kwargs)], axis=self.dim)
 
 
+def multiway_layernorm(
+    multiway: bool, name: str, *, epsilon: float, dtype=None
+) -> Callable:
+    """LayerNorm that may be multiway-split: the one construction used by
+    every norm site in the encoder/attention stack. Returns
+    ``fn(x, split_position=-1)``. Must be called in the parent's compact
+    scope."""
+    from flax import linen as nn
+
+    make = lambda name: nn.LayerNorm(epsilon=epsilon, dtype=dtype, name=name)  # noqa: E731
+    return maybe_multiway(multiway, make, name)
+
+
 def maybe_multiway(
     multiway: bool, module_fn: Callable[..., nn.Module], name: str, dim: int = 1
 ) -> Callable:

@@ -1,0 +1,61 @@
+"""Checkpoint save/restore (Orbax).
+
+Counterpart of the reference's ``torch.save(model.state_dict())`` checkpoints
+(``finetune/training.py:207-214``, ``finetune/utils.py:348-350``) plus what
+the reference lacks (VERDICT r1 #55): optimizer-state checkpoints and
+kill-and-resume. Sharded arrays are handled natively by Orbax — on a mesh the
+save/restore round-trips the sharding layout.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_checkpoint(path: str, state: Dict[str, Any]) -> None:
+    """Save a pytree state dict (e.g. {"params", "opt_state", "epoch"})."""
+    path = os.path.abspath(path)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    _checkpointer().save(path, state, force=True)
+
+
+def restore_checkpoint(path: str, template: Optional[Dict[str, Any]] = None):
+    """Restore a state dict; with ``template``, restores into its
+    structure/dtypes (required for opt_state namedtuples)."""
+    path = os.path.abspath(path)
+    if template is not None:
+        import orbax.checkpoint as ocp
+
+        return _checkpointer().restore(
+            path, restore_args=ocp.checkpoint_utils.construct_restore_args(template),
+            item=template,
+        )
+    return _checkpointer().restore(path)
+
+
+def checkpoint_exists(path: str) -> bool:
+    return os.path.isdir(os.path.abspath(path))
+
+
+class MonitorScore:
+    """Best-score checkpoint monitor (reference ``Monitor_Score``,
+    ``finetune/utils.py:327-350``): saves when the score improves."""
+
+    def __init__(self):
+        self.best_score = None
+
+    def __call__(self, val_score: float, state: Dict[str, Any], ckpt_name: str) -> bool:
+        if self.best_score is None or val_score > self.best_score:
+            self.best_score = val_score
+            save_checkpoint(ckpt_name, jax.device_get(state))
+            return True
+        return False

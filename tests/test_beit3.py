@@ -102,6 +102,8 @@ class TestMultiwayEncoder:
         assert not np.allclose(np.asarray(out_full_a[:, 4:]), np.asarray(out_split[:, 4:]))
 
     def test_split_zero_uses_branch_b_everywhere(self, rng):
+        """split=0 output == output of a param tree whose A branches were
+        overwritten with B (i.e. genuinely routed through B)."""
         from gigapath_tpu.architecture.encoder import Encoder
 
         enc = Encoder(self._cfg())
@@ -112,7 +114,18 @@ class TestMultiwayEncoder:
         out0 = enc.apply(
             {"params": params}, token_embeddings=x, multiway_split_position=0
         )["encoder_out"]
-        assert np.isfinite(np.asarray(out0)).all()
+
+        def b_into_a(tree):
+            if isinstance(tree, dict):
+                if set(tree.keys()) >= {"A", "B"}:
+                    tree = dict(tree, A=tree["B"])
+                return {k: b_into_a(v) for k, v in tree.items()}
+            return tree
+
+        out_a = enc.apply(
+            {"params": b_into_a(params)}, token_embeddings=x, multiway_split_position=-1
+        )["encoder_out"]
+        np.testing.assert_allclose(np.asarray(out0), np.asarray(out_a), atol=1e-5)
 
 
 class TestBEiT3:
@@ -180,6 +193,31 @@ class TestBEiT3:
         pad = jnp.zeros((1, 6), bool).at[0, 4:].set(True)
         out = model.apply({"params": params}, text, image, text_padding_position=pad)
         assert np.isfinite(np.asarray(out["encoder_out"])).all()
+
+
+def test_vision_language_embedding_concat(rng):
+    """Fused VL embedding == vision tokens then text tokens."""
+    from gigapath_tpu.ops.embedding import VisionLanguageEmbedding
+
+    class VL(nn.Module):
+        @nn.compact
+        def __call__(self, text, image):
+            vle = VisionLanguageEmbedding(
+                TextEmbedding(50, 24, name="t"),
+                VisionEmbedding(32, 16, embed_dim=24, name="v"),
+            )
+            return vle(text, image)
+
+    m = VL()
+    text = jnp.asarray(rng.integers(0, 50, (2, 6)), jnp.int32)
+    image = jnp.asarray(rng.normal(size=(2, 32, 32, 3)), jnp.float32)
+    params = m.init(jax.random.PRNGKey(0), text, image)["params"]
+    fused = m.apply({"params": params}, text, image)
+    assert fused.shape == (2, 4 + 6, 24)
+    v_only = m.apply({"params": params}, None, image)
+    t_only = m.apply({"params": params}, text, None)
+    np.testing.assert_allclose(np.asarray(fused[:, :4]), np.asarray(v_only), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(fused[:, 4:]), np.asarray(t_only), atol=1e-6)
 
 
 def test_multiway_network_concat_identity(rng):

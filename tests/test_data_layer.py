@@ -1,0 +1,274 @@
+"""Data layer: slide dataset (h5/pt), collate, splits, loaders, PCam, tiles.
+
+Synthetic-fixture tests for the host-side pipeline the reference exercises
+only through real PANDA/PCam downloads (``finetune/datasets/slide_datatset.py``,
+``finetune/utils.py:63-206``, ``linear_probe/main.py:287-347``,
+``gigapath/pipeline.py:21-52``).
+"""
+
+import io
+import os
+import zipfile
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from gigapath_tpu.data.collate import next_power_of_two, pad_tensors, slide_collate_fn
+from gigapath_tpu.data.loader import DataLoader, class_balance_weights, get_loader
+from gigapath_tpu.data.pcam import EmbeddingDataset, Processor
+from gigapath_tpu.data.slide_dataset import SlideDataset
+from gigapath_tpu.data.splits import get_splits
+from gigapath_tpu.data.tile_dataset import TileEncodingDataset, parse_tile_coords
+
+D = 16
+
+
+@pytest.fixture
+def slide_fixture(tmp_path, rng):
+    """5 slides as h5 (features+coords), a csv dataframe, a task config."""
+    import h5py
+
+    root = tmp_path / "h5_files"
+    root.mkdir()
+    rows = []
+    for i in range(5):
+        slide_id = f"slide_{i}.svs"
+        n_tiles = 8 + 4 * i
+        with h5py.File(root / f"slide_{i}.h5", "w") as f:
+            f.create_dataset("features", data=rng.normal(size=(n_tiles, D)).astype(np.float32))
+            f.create_dataset("coords", data=rng.integers(0, 5000, (n_tiles, 2)).astype(np.float32))
+        rows.append(
+            {"slide_id": slide_id, "pat_id": f"pat_{i % 3}", "label": ["neg", "pos"][i % 2]}
+        )
+    df = pd.DataFrame(rows)
+    task_cfg = {
+        "setting": "multi_class",
+        "label_dict": {"neg": 0, "pos": 1},
+        "max_tiles": 10,
+        "shuffle_tiles": False,
+    }
+    return str(root), df, task_cfg
+
+
+class TestSlideDataset:
+    def test_h5_read_and_labels(self, slide_fixture):
+        root, df, cfg = slide_fixture
+        ds = SlideDataset(df, root, splits=df["pat_id"].tolist(), task_config=cfg)
+        assert len(ds) == 5 and ds.n_classes == 2
+        s = ds[0]
+        assert s["imgs"].shape == (8, D)
+        assert s["coords"].shape == (8, 2)
+        assert s["labels"].shape == (1,)
+        assert s["slide_id"] == "slide_0.svs"
+
+    def test_max_tiles_truncation(self, slide_fixture):
+        root, df, cfg = slide_fixture
+        ds = SlideDataset(df, root, splits=df["pat_id"].tolist(), task_config=cfg)
+        s = ds[4]  # 24 tiles > max 10
+        assert s["imgs"].shape == (10, D)
+
+    def test_missing_slides_filtered(self, slide_fixture):
+        root, df, cfg = slide_fixture
+        df2 = pd.concat(
+            [df, pd.DataFrame([{"slide_id": "ghost.svs", "pat_id": "pat_0", "label": "neg"}])]
+        )
+        ds = SlideDataset(df2, root, splits=df2["pat_id"].tolist(), task_config=cfg)
+        assert len(ds) == 5  # ghost dropped
+
+    def test_split_filter(self, slide_fixture):
+        root, df, cfg = slide_fixture
+        ds = SlideDataset(df, root, splits=["pat_0"], task_config=cfg)
+        assert len(ds) == 2  # slides 0 and 3
+
+    def test_multi_label(self, slide_fixture, rng):
+        root, df, cfg = slide_fixture
+        df = df.copy()
+        df["gene_a"] = [0, 1, 0, 1, 1]
+        df["gene_b"] = [1, 1, 0, 0, 1]
+        cfg = {
+            "setting": "multi_label",
+            "label_dict": {"gene_a": 0, "gene_b": 1},
+            "max_tiles": 100,
+        }
+        ds = SlideDataset(df, root, splits=df["pat_id"].tolist(), task_config=cfg)
+        s = ds[1]
+        np.testing.assert_array_equal(s["labels"], [1, 1])
+
+    def test_shuffle_tiles_seeded(self, slide_fixture):
+        root, df, cfg = slide_fixture
+        cfg = dict(cfg, shuffle_tiles=True)
+        ds1 = SlideDataset(df, root, splits=df["pat_id"].tolist(), task_config=cfg, seed=1)
+        ds2 = SlideDataset(df, root, splits=df["pat_id"].tolist(), task_config=cfg, seed=1)
+        np.testing.assert_array_equal(ds1[0]["imgs"], ds2[0]["imgs"])
+
+    def test_retry_skip_returns_none(self, slide_fixture, monkeypatch):
+        root, df, cfg = slide_fixture
+        ds = SlideDataset(df, root, splits=df["pat_id"].tolist(), task_config=cfg)
+        monkeypatch.setattr(
+            ds, "get_one_sample", lambda idx: (_ for _ in ()).throw(IOError("boom"))
+        )
+        assert ds[0] is None
+
+
+class TestCollate:
+    def test_pad_and_mask(self, rng):
+        imgs = [rng.normal(size=(5, D)).astype(np.float32), rng.normal(size=(9, D)).astype(np.float32)]
+        coords = [rng.normal(size=(5, 2)).astype(np.float32), rng.normal(size=(9, 2)).astype(np.float32)]
+        p, c, m = pad_tensors(imgs, coords)
+        assert p.shape == (2, 9, D) and c.shape == (2, 9, 2)
+        assert m[0].sum() == 5 and m[1].sum() == 9
+        np.testing.assert_array_equal(p[0, 5:], 0)
+
+    def test_bucketed_padding(self, rng):
+        imgs = [rng.normal(size=(21, D)).astype(np.float32)]
+        coords = [rng.normal(size=(21, 2)).astype(np.float32)]
+        p, _, m = pad_tensors(imgs, coords, bucket_fn=next_power_of_two)
+        assert p.shape[1] == 32  # 21 -> 32
+        assert m.sum() == 21
+
+    def test_collate_drops_none(self, rng):
+        sample = {
+            "imgs": rng.normal(size=(4, D)).astype(np.float32),
+            "coords": rng.normal(size=(4, 2)).astype(np.float32),
+            "labels": np.asarray([1]),
+            "slide_id": "s",
+        }
+        batch = slide_collate_fn([None, sample])
+        assert batch["imgs"].shape[0] == 1
+        assert slide_collate_fn([None, None]) is None
+
+    def test_power_of_two(self):
+        assert next_power_of_two(1) == 16  # floor
+        assert next_power_of_two(16) == 16
+        assert next_power_of_two(17) == 32
+        assert next_power_of_two(1000) == 1024
+
+
+class TestSplits:
+    def test_create_and_fetch(self, tmp_path):
+        df = pd.DataFrame(
+            {"slide_id": [f"s{i}" for i in range(20)], "label": [i % 2 for i in range(20)]}
+        )
+        split_dir = str(tmp_path / "splits")
+        tr, va, te = get_splits(df, split_dir=split_dir, fold=0)
+        assert len(tr) + len(va) + len(te) == 20
+        assert set(tr).isdisjoint(va) and set(tr).isdisjoint(te)
+        # second call fetches the persisted files identically
+        tr2, va2, te2 = get_splits(df, split_dir=split_dir, fold=0)
+        assert tr == tr2 and va == va2 and te == te2
+
+    def test_no_val_split(self, tmp_path):
+        df = pd.DataFrame({"slide_id": [f"s{i}" for i in range(10)]})
+        tr, va, te = get_splits(
+            df, val_r=0.0, test_r=0.3, split_dir=str(tmp_path / "sp"), fold=1
+        )
+        assert va == [] and len(te) == 3
+
+
+class TestLoader:
+    def _dataset(self, rng, n=10):
+        class DS:
+            labels = np.asarray([[i % 2] for i in range(n)])
+
+            def __len__(self):
+                return n
+
+            def __getitem__(self, i):
+                return {
+                    "imgs": rng.normal(size=(4 + i, D)).astype(np.float32),
+                    "coords": np.zeros((4 + i, 2), np.float32),
+                    "labels": self.labels[i],
+                    "slide_id": f"s{i}",
+                }
+
+        return DS()
+
+    def test_seeded_iteration_deterministic(self, rng):
+        ds = self._dataset(rng)
+        ids1 = [b["slide_id"][0] for b in DataLoader(ds, shuffle=True, seed=3)]
+        ids2 = [b["slide_id"][0] for b in DataLoader(ds, shuffle=True, seed=3)]
+        assert ids1 == ids2
+
+    def test_weighted_sampling_balances(self, rng):
+        # 9:1 imbalance; weighted sampler should draw the rare class often
+        n = 100
+
+        class DS:
+            labels = np.asarray([[0]] * 90 + [[1]] * 10)
+
+            def __len__(self):
+                return n
+
+            def __getitem__(self, i):
+                return {
+                    "imgs": np.zeros((2, D), np.float32),
+                    "coords": np.zeros((2, 2), np.float32),
+                    "labels": self.labels[i],
+                    "slide_id": str(i),
+                }
+
+        ds = DS()
+        weights = class_balance_weights(ds.labels)
+        loader = DataLoader(ds, batch_size=1, weights=weights, seed=0)
+        drawn = [int(b["labels"][0, 0]) for b in loader]
+        rare = sum(drawn) / len(drawn)
+        assert 0.3 < rare < 0.7  # ~0.5 expected vs 0.1 unweighted
+
+    def test_get_loader_shapes(self, rng):
+        ds = self._dataset(rng)
+        tr, va, te = get_loader(ds, ds, ds, {"setting": "multi_class"}, batch_size=2)
+        batch = next(iter(tr))
+        assert batch["imgs"].ndim == 3 and batch["imgs"].shape[0] == 2
+        assert next(iter(va))["imgs"].shape[0] == 1
+
+
+class TestPCam:
+    def test_zip_roundtrip(self, tmp_path, rng):
+        import torch
+
+        zpath = tmp_path / "embeds.zip"
+        names, labels = [], []
+        with zipfile.ZipFile(zpath, "w") as z:
+            for split in ("train", "test"):
+                for i in range(3):
+                    name = f"{split}_{i}"
+                    buf = io.BytesIO()
+                    torch.save(torch.randn(8), buf)
+                    z.writestr(f"embeds/{name}.pt", buf.getvalue())
+                    names.append(name)
+                    labels.append(["neg", "pos"][i % 2])
+        csv = tmp_path / "ds.csv"
+        pd.DataFrame(
+            {
+                "input": names,
+                "label": labels,
+                "split": ["train"] * 3 + ["test"] * 3,
+            }
+        ).to_csv(csv)
+
+        ds = EmbeddingDataset(str(csv), str(zpath), split="train")
+        assert len(ds) == 3
+        embed, target = ds[0]
+        assert embed.shape == (8,) and target in (0, 1)
+        ds_z = EmbeddingDataset(str(csv), str(zpath), split="train", z_score=True)
+        e, _ = ds_z[0]
+        assert abs(e.mean()) < 1e-5 and abs(e.std() - 1) < 1e-4
+
+
+class TestTileDataset:
+    def test_coord_parse_and_load(self, tmp_path, rng):
+        from PIL import Image
+
+        p = tmp_path / "00123x_00456y.png"
+        Image.fromarray(
+            rng.integers(0, 255, (64, 64, 3)).astype(np.uint8)
+        ).save(p)
+        np.testing.assert_array_equal(parse_tile_coords(str(p)), [123, 456])
+
+        from gigapath_tpu.data.transforms import preprocess_tile
+
+        ds = TileEncodingDataset([str(p)], transform=preprocess_tile)
+        sample = ds[0]
+        assert sample["img"].shape == (224, 224, 3)
+        np.testing.assert_array_equal(sample["coords"], [123, 456])
