@@ -1,0 +1,19 @@
+"""Demo: resolve the pyramid level matching the 0.5 MPP training resolution.
+
+Counterpart of reference ``demo/1_slide_mpp_check.py`` (minus the HF-hub
+sample download — pass a local slide path; zero-egress build).
+"""
+
+import sys
+
+from gigapath_tpu.data.slide_utils import find_level_for_target_mpp
+
+if __name__ == "__main__":
+    slide_path = sys.argv[1] if len(sys.argv) > 1 else "sample_data/slide.ndpi"
+    print("NOTE: Prov-GigaPath is trained with 0.5 mpp preprocessed slides")
+    target_mpp = 0.5
+    level = find_level_for_target_mpp(slide_path, target_mpp)
+    if level is not None:
+        print(f"Found level: {level}")
+    else:
+        print("No suitable level found.")

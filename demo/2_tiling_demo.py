@@ -1,0 +1,14 @@
+"""Demo: tile one slide (counterpart of reference ``demo/2_tiling_demo.py``)."""
+
+import os
+import sys
+
+from gigapath_tpu.pipeline import tile_one_slide
+
+if __name__ == "__main__":
+    slide_path = sys.argv[1] if len(sys.argv) > 1 else "sample_data/slide.png"
+    save_dir = sys.argv[2] if len(sys.argv) > 2 else os.path.join("outputs", "preprocessing")
+    # The reference tiles at level 1 for its 0.5 MPP slide; plain images have
+    # a single level
+    tile_one_slide(slide_path, save_dir=save_dir, level=0)
+    print("NOTE: tiling dependency libs can be tricky; the tiles are saved under", save_dir)

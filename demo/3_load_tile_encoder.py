@@ -1,0 +1,49 @@
+"""Demo: load the ViT-G/14 tile encoder and check golden-output parity.
+
+Counterpart of reference ``demo/3_load_tile_encoder.py:28-34`` — the repo's
+only numerical-parity anchor: the tile embedding of
+``images/prov_normal_000_1.png`` must match the stored golden ``.pt`` within
+atol 1e-2. Requires local checkpoint + golden files (zero-egress build):
+
+    python demo/3_load_tile_encoder.py <tile_encoder.pth> <img.png> <golden.pt>
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gigapath_tpu.data.transforms import preprocess_tile
+from gigapath_tpu.models.tile_encoder import count_params, create_tile_encoder
+
+if __name__ == "__main__":
+    ckpt = sys.argv[1] if len(sys.argv) > 1 else ""
+    img_path = sys.argv[2] if len(sys.argv) > 2 else "images/prov_normal_000_1.png"
+    golden_path = sys.argv[3] if len(sys.argv) > 3 else "images/prov_normal_000_1.pt"
+
+    model, params = create_tile_encoder(pretrained=ckpt)
+    print("param #", count_params(model))
+
+    from PIL import Image
+
+    sample_input = preprocess_tile(Image.open(img_path))[None]
+    output = jax.jit(lambda p, x: model.apply({"params": p}, x))(
+        params, jnp.asarray(sample_input)
+    )[0]
+    print("Model output:", output.shape)
+    print(np.asarray(output))
+
+    import os
+
+    if os.path.exists(golden_path):
+        import torch
+
+        expected = torch.load(golden_path, map_location="cpu").numpy()
+        print("Expected output:", expected.shape)
+        assert np.allclose(np.asarray(output, np.float32), expected, atol=1e-2), (
+            "golden-output parity FAILED"
+        )
+        print("Golden-output parity PASSED (atol 1e-2)")
+    else:
+        print(f"(golden file {golden_path} not present; skipping parity assert)")

@@ -1,0 +1,28 @@
+"""Demo: slide encoder forward on synthetic tile embeddings.
+
+Counterpart of reference ``demo/4_load_slide_encoder.py`` (BASELINE
+config 3): N=512 synthetic 1536-d embeddings + coords through
+gigapath_slide_enc12l768d.
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gigapath_tpu.models import slide_encoder
+
+if __name__ == "__main__":
+    ckpt = sys.argv[1] if len(sys.argv) > 1 else ""
+    model, params = slide_encoder.create_model(
+        ckpt, "gigapath_slide_enc12l768d", 1536, dtype=jnp.bfloat16
+    )
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    print("param #", n_params)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(1, 512, 1536)), jnp.bfloat16)
+    coords = jnp.asarray(rng.uniform(0, 250000, (1, 512, 2)), jnp.float32)
+    out = jax.jit(lambda p, x, c: model.apply({"params": p}, x, c))(params, x, coords)
+    print("slide embedding:", out[0].shape, out[0].dtype)

@@ -1,0 +1,45 @@
+"""Demo: toy slide classification — mean-pooled tile embeddings + sklearn
+logistic regression (reference ``demo/fenlei.py``: encode tiles, mean-pool,
+LogisticRegression over a handful of slides).
+
+    python demo/fenlei.py <slides_dir_with_pngs> [tile_ckpt]
+"""
+
+import glob
+import os
+import sys
+
+import numpy as np
+
+from gigapath_tpu.pipeline import (
+    load_tile_slide_encoder,
+    run_inference_with_tile_encoder,
+    tile_one_slide,
+)
+
+if __name__ == "__main__":
+    slides_dir = sys.argv[1] if len(sys.argv) > 1 else "sample_data"
+    tile_ckpt = sys.argv[2] if len(sys.argv) > 2 else ""
+
+    slide_files = sorted(
+        glob.glob(os.path.join(slides_dir, "*.png"))
+        + glob.glob(os.path.join(slides_dir, "*.svs"))
+    )
+    assert len(slide_files) >= 2, "need at least two slides for the toy classifier"
+
+    (tile_model, tile_params), _ = load_tile_slide_encoder(
+        local_tile_encoder_path=tile_ckpt
+    )
+
+    feats, labels = [], []
+    for i, slide in enumerate(slide_files):
+        slide_dir = tile_one_slide(slide, save_dir="outputs/fenlei", level=0)
+        tiles = sorted(glob.glob(os.path.join(slide_dir, "*.png")))
+        out = run_inference_with_tile_encoder(tiles, tile_model, tile_params)
+        feats.append(out["tile_embeds"].mean(axis=0))
+        labels.append(i % 2)  # toy labels, as in the reference demo
+
+    from sklearn.linear_model import LogisticRegression
+
+    clf = LogisticRegression(max_iter=1000).fit(np.stack(feats), labels)
+    print("train accuracy:", clf.score(np.stack(feats), labels))

@@ -1,0 +1,62 @@
+"""Demo: PCA RGB visualization of tile-encoder patch tokens.
+
+Counterpart of reference ``demo/gigapath_pca_visualization_timm-Copy1.py``:
+run the tile encoder in feature mode, project patch tokens to 3 principal
+components, render as an RGB overlay per tile.
+
+    python demo/gigapath_pca_visualization.py <tiles_dir> [tile_ckpt] [out.png]
+"""
+
+import glob
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gigapath_tpu.data.transforms import preprocess_tile
+from gigapath_tpu.models.tile_encoder import create_tile_encoder
+
+if __name__ == "__main__":
+    tiles_dir = sys.argv[1] if len(sys.argv) > 1 else "outputs/preprocessing"
+    tile_ckpt = sys.argv[2] if len(sys.argv) > 2 else ""
+    out_path = sys.argv[3] if len(sys.argv) > 3 else "outputs/pca_overlay.png"
+
+    model, params = create_tile_encoder(pretrained=tile_ckpt, dtype=jnp.bfloat16)
+    paths = sorted(glob.glob(os.path.join(tiles_dir, "**/*.png"), recursive=True))[:16]
+    assert paths, f"no tiles under {tiles_dir}"
+
+    from PIL import Image
+
+    imgs = np.stack([preprocess_tile(Image.open(p)) for p in paths])
+    tokens = jax.jit(
+        lambda p, x: model.apply({"params": p}, x, method=model.forward_features)
+    )(params, jnp.asarray(imgs, jnp.bfloat16))
+    patch_tokens = np.asarray(tokens[:, 1:], np.float32)  # drop cls
+
+    # PCA to 3 components over all patches
+    flat = patch_tokens.reshape(-1, patch_tokens.shape[-1])
+    flat = flat - flat.mean(axis=0)
+    _, _, vt = np.linalg.svd(flat, full_matrices=False)
+    rgb = flat @ vt[:3].T
+    rgb = (rgb - rgb.min(0)) / np.ptp(rgb, 0).clip(1e-8)
+    grid = model.grid_size
+    rgb = rgb.reshape(len(paths), grid, grid, 3)
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    n = int(np.ceil(np.sqrt(len(paths))))
+    fig, axes = plt.subplots(n, 2 * n, figsize=(4 * n, 2 * n))
+    for i, p in enumerate(paths):
+        r, c = divmod(i, n)
+        axes[r][2 * c].imshow(Image.open(p))
+        axes[r][2 * c].axis("off")
+        axes[r][2 * c + 1].imshow(rgb[i])
+        axes[r][2 * c + 1].axis("off")
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    fig.savefig(out_path)
+    print("saved", out_path)

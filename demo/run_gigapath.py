@@ -1,0 +1,37 @@
+"""Demo: the full WSI inference journey (reference ``demo/run_gigapath.py``):
+tile a slide -> encode tiles -> encode the slide.
+
+    python demo/run_gigapath.py <slide> [tile_ckpt] [slide_ckpt]
+"""
+
+import glob
+import os
+import sys
+
+from gigapath_tpu.pipeline import (
+    load_tile_slide_encoder,
+    run_inference_with_slide_encoder,
+    run_inference_with_tile_encoder,
+    tile_one_slide,
+)
+
+if __name__ == "__main__":
+    slide_path = sys.argv[1] if len(sys.argv) > 1 else "sample_data/slide.png"
+    tile_ckpt = sys.argv[2] if len(sys.argv) > 2 else ""
+    slide_ckpt = sys.argv[3] if len(sys.argv) > 3 else ""
+
+    save_dir = os.path.join("outputs", "preprocessing")
+    print("NOTE: Prov-GigaPath is trained with 0.5 mpp preprocessed slides")
+    slide_dir = tile_one_slide(slide_path, save_dir=save_dir, level=0)
+    image_paths = sorted(glob.glob(os.path.join(slide_dir, "*.png")))
+    print(f"Found {len(image_paths)} image tiles")
+
+    (tile_model, tile_params), (slide_model, slide_params) = load_tile_slide_encoder(
+        local_tile_encoder_path=tile_ckpt, local_slide_encoder_path=slide_ckpt
+    )
+    tile_outputs = run_inference_with_tile_encoder(image_paths, tile_model, tile_params)
+    print("tile_embeds:", tile_outputs["tile_embeds"].shape)
+    slide_embeds = run_inference_with_slide_encoder(
+        tile_outputs["tile_embeds"], tile_outputs["coords"], slide_model, slide_params
+    )
+    print("last_layer_embed:", slide_embeds["last_layer_embed"].shape)

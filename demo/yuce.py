@@ -1,0 +1,46 @@
+"""Demo: end-to-end WSI inference + toy biomarker prediction head.
+
+Counterpart of reference ``demo/yuce.py``: the run_gigapath.py journey plus
+a randomly-initialized 19-biomarker linear head over the slide embedding
+(``yuce.py:64-75``) with wall-clock timing (``yuce.py:15,155-158``).
+"""
+
+import glob
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gigapath_tpu.pipeline import (
+    load_tile_slide_encoder,
+    run_inference_with_slide_encoder,
+    run_inference_with_tile_encoder,
+    tile_one_slide,
+)
+
+BIOMARKERS = [f"biomarker_{i}" for i in range(19)]
+
+if __name__ == "__main__":
+    start_time = time.time()
+    slide_path = sys.argv[1] if len(sys.argv) > 1 else "sample_data/slide.png"
+
+    slide_dir = tile_one_slide(slide_path, save_dir="outputs/yuce", level=0)
+    image_paths = sorted(glob.glob(os.path.join(slide_dir, "*.png")))
+
+    (tile_model, tile_params), (slide_model, slide_params) = load_tile_slide_encoder()
+    tile_outputs = run_inference_with_tile_encoder(image_paths, tile_model, tile_params)
+    slide_embeds = run_inference_with_slide_encoder(
+        tile_outputs["tile_embeds"], tile_outputs["coords"], slide_model, slide_params
+    )
+    embed = jnp.asarray(slide_embeds["last_layer_embed"])
+
+    # toy randomly-initialized biomarker head, as in the reference demo
+    key = jax.random.PRNGKey(0)
+    w = jax.random.normal(key, (embed.shape[-1], len(BIOMARKERS))) * 0.02
+    probs = np.asarray(jax.nn.sigmoid(embed @ w))[0]
+    for name, p in zip(BIOMARKERS, probs):
+        print(f"{name}: {p:.3f}")
+    print(f"Elapsed: {time.time() - start_time:.2f} s")

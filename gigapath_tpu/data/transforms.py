@@ -44,16 +44,17 @@ def normalize(
     return (arr - np.asarray(mean, np.float32)) / np.asarray(std, np.float32)
 
 
-def preprocess_tile(img) -> np.ndarray:
-    """PIL image (or uint8 [H, W, 3] array) -> float32 [224, 224, 3], the
+def preprocess_tile(img, crop_size: int = 224) -> np.ndarray:
+    """PIL image (or uint8 [H, W, 3] array) -> float32 [crop, crop, 3], the
     tile encoder's expected NHWC input (channels-last; the reference feeds
-    torch NCHW, same values)."""
+    torch NCHW, same values). The resize keeps the reference's 256/224
+    ratio for non-default crop sizes (small test encoders)."""
     from PIL import Image
 
     if isinstance(img, np.ndarray):
         img = Image.fromarray(img)
     img = img.convert("RGB")
-    img = resize_shorter_side(img, 256)
+    img = resize_shorter_side(img, round(crop_size * 256 / 224))
     arr = np.asarray(img, np.float32) / 255.0
-    arr = center_crop(arr, 224)
+    arr = center_crop(arr, crop_size)
     return normalize(arr).astype(np.float32)

@@ -1,0 +1,174 @@
+"""Prediction CLI: run a fine-tuned checkpoint over a dataset.
+
+Parity with reference ``finetune/predict.py:15-181``: loads a fine-tuned
+checkpoint (orbax state or a torch ``.pt`` whose ``slide_encoder.*`` /
+``classifier.*`` keys are remapped non-strictly, ``predict.py:91-114``),
+predicts probabilities per slide, and writes ``predictions.csv`` with
+``slide_id`` / ``label`` / ``probabilities`` columns plus the wall-clock
+timing printout. The reference's 1-batch hard cap (``predict.py:126-128``)
+becomes an optional ``max_batches`` argument (None = all).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _load_params_into_model(checkpoint_path: str, params):
+    """Orbax dir or torch .pt -> params (non-strict, with key remap)."""
+    from gigapath_tpu.utils.checkpoint import checkpoint_exists, restore_checkpoint
+
+    if checkpoint_exists(checkpoint_path):
+        state = restore_checkpoint(checkpoint_path)
+        return state.get("params", state)
+
+    from gigapath_tpu.utils.torch_convert import (
+        convert_state_dict,
+        load_torch_state_dict,
+        merge_into_params,
+    )
+
+    state_dict = load_torch_state_dict(checkpoint_path)
+    enc_state = {
+        k[len("slide_encoder."):]: v
+        for k, v in state_dict.items()
+        if k.startswith("slide_encoder.")
+    }
+    params = dict(params)
+    if enc_state:
+        params["slide_encoder"], missing, unexpected = merge_into_params(
+            params["slide_encoder"], convert_state_dict(enc_state)
+        )
+        print(f"slide_encoder loaded ({len(missing)} missing, {len(unexpected)} unexpected)")
+    cls_state = {
+        k[len("classifier."):]: v
+        for k, v in state_dict.items()
+        if k.startswith("classifier.")
+    }
+    if cls_state:
+        from gigapath_tpu.utils.torch_convert import convert_torch_entry
+
+        converted = dict(convert_torch_entry(k, v) for k, v in cls_state.items())
+        params["classifier"], missing, unexpected = merge_into_params(
+            params["classifier"], converted
+        )
+        print(f"classifier loaded ({len(missing)} missing, {len(unexpected)} unexpected)")
+    return params
+
+
+def predict(
+    checkpoint_path: str,
+    dataset_csv: str,
+    root_path: str,
+    task_cfg_path: str,
+    save_dir: str,
+    exp_name: str,
+    max_batches: Optional[int] = None,
+    argv: Optional[list] = None,
+):
+    """Predict on every slide in ``dataset_csv``; writes predictions.csv."""
+    import pandas as pd
+
+    from gigapath_tpu.data.loader import get_loader
+    from gigapath_tpu.data.slide_dataset import SlideDataset
+    from gigapath_tpu.finetune.params import get_finetune_params
+    from gigapath_tpu.finetune.task_configs.utils import load_task_config
+    from gigapath_tpu.finetune.utils import seed_everything
+    from gigapath_tpu.models.classification_head import get_model
+
+    start_time = time.time()
+    args = get_finetune_params(argv or [])
+    args.checkpoint_path = checkpoint_path
+    args.dataset_csv = dataset_csv
+    args.root_path = root_path
+    args.task_cfg_path = task_cfg_path
+    args.save_dir = save_dir
+    args.exp_name = exp_name
+    print("Prediction arguments:")
+    print(args)
+
+    seed_everything(args.seed)
+    print("Loading task configuration from: {}".format(args.task_cfg_path))
+    args.task_config = load_task_config(args.task_cfg_path)
+    args.task = args.task_config.get("name", "task")
+    args.model_arch = args.task_config.get("model_arch", args.model_arch)
+
+    args.save_dir = os.path.join(args.save_dir, args.task, args.exp_name, "predictions")
+    os.makedirs(args.save_dir, exist_ok=True)
+    print("Setting save directory for predictions: {}".format(args.save_dir))
+
+    dataset = pd.read_csv(args.dataset_csv)
+    predict_data = SlideDataset(
+        dataset,
+        args.root_path,
+        dataset["slide_id"].tolist(),
+        args.task_config,
+        split_key="slide_id",
+    )
+    args.n_classes = predict_data.n_classes
+    print(f"Number of classes: {args.n_classes}")
+    # sequential order (the train slot of get_loader shuffles)
+    from gigapath_tpu.data.loader import DataLoader
+
+    predict_loader = DataLoader(predict_data, batch_size=args.batch_size)
+
+    model, params = get_model(
+        input_dim=args.input_dim,
+        latent_dim=args.latent_dim,
+        feat_layer=args.feat_layer,
+        n_classes=args.n_classes,
+        model_arch=args.model_arch,
+        global_pool=args.global_pool,
+        dtype=jnp.bfloat16,
+        dropout=args.dropout,
+        drop_path_rate=args.drop_path_rate,
+    )
+    print("Loading checkpoint from: {}".format(checkpoint_path))
+    params = _load_params_into_model(checkpoint_path, params)
+
+    @jax.jit
+    def forward(params, images, coords, pad_mask):
+        return model.apply(
+            {"params": params}, images, coords, pad_mask=pad_mask, deterministic=True
+        )
+
+    multi_label = args.task_config.get("setting", "multi_class") == "multi_label"
+    results = []
+    for batch_idx, batch in enumerate(predict_loader):
+        if max_batches is not None and batch_idx >= max_batches:
+            print(f"Stopping after {max_batches} batches as requested")
+            break
+        logits = forward(
+            params,
+            jnp.asarray(batch["imgs"]),
+            jnp.asarray(batch["coords"]),
+            jnp.asarray(batch["pad_mask"]),
+        )
+        logits = jnp.asarray(logits, jnp.float32)
+        probs = np.asarray(
+            jax.nn.sigmoid(logits) if multi_label else jax.nn.softmax(logits, axis=-1)
+        )
+        labels = np.asarray(batch["labels"])
+        for i, slide_id in enumerate(batch["slide_id"]):
+            results.append(
+                {
+                    "slide_id": slide_id,
+                    "label": labels[i].tolist() if labels.ndim > 1 else labels[i],
+                    "probabilities": probs[i].tolist(),
+                }
+            )
+        print(f"Batch {batch_idx + 1}/{len(predict_loader)} processed.")
+
+    results_df = pd.DataFrame(results)
+    output_csv_path = os.path.join(args.save_dir, "predictions.csv")
+    results_df.to_csv(output_csv_path, index=False)
+    print("Predictions saved in: {}".format(output_csv_path))
+    print("Done with prediction!")
+    print(f"Elapsed: {time.time() - start_time:.4f} s")
+    return results_df

@@ -59,11 +59,12 @@ def compiled_flops(fn, *args) -> Optional[float]:
         return None
 
 
-def _batch_to_device(batch) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+def _batch_to_device(batch):
     images = jnp.asarray(batch["imgs"])
     coords = jnp.asarray(batch["coords"])
     labels = jnp.asarray(np.asarray(batch["labels"]))
-    return images, coords, labels
+    pad_mask = jnp.asarray(batch["pad_mask"]) if "pad_mask" in batch else None
+    return images, coords, labels, pad_mask
 
 
 def train(dataloader, fold: int, args):
@@ -122,11 +123,12 @@ def train(dataloader, fold: int, args):
 
     multi_label = args.task_config.get("setting", "multi_class") == "multi_label"
 
-    def _loss(params, images, coords, labels, rng):
+    def _loss(params, images, coords, labels, pad_mask, rng):
         logits = model.apply(
             {"params": params},
             images,
             coords,
+            pad_mask=pad_mask,
             deterministic=False,
             rngs={"dropout": rng},
         )
@@ -134,15 +136,19 @@ def train(dataloader, fold: int, args):
         return loss_fn(logits, labels)
 
     @jax.jit
-    def train_step(params, opt_state, images, coords, labels, rng):
-        loss, grads = jax.value_and_grad(_loss)(params, images, coords, labels, rng)
+    def train_step(params, opt_state, images, coords, labels, pad_mask, rng):
+        loss, grads = jax.value_and_grad(_loss)(
+            params, images, coords, labels, pad_mask, rng
+        )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = jax.tree.map(lambda p, u: p + u, params, updates)
         return params, opt_state, loss
 
     @jax.jit
-    def eval_step(params, images, coords):
-        return model.apply({"params": params}, images, coords, deterministic=True)
+    def eval_step(params, images, coords, pad_mask):
+        return model.apply(
+            {"params": params}, images, coords, pad_mask=pad_mask, deterministic=True
+        )
 
     print(f"Training on {len(train_loader.dataset)} samples")
     if val_loader is not None:
@@ -208,11 +214,11 @@ def train_one_epoch(train_loader, train_step, params, opt_state, epoch, rng, arg
     n_batches = 0
 
     for batch_idx, batch in enumerate(train_loader):
-        images, coords, labels = _batch_to_device(batch)
+        images, coords, labels, pad_mask = _batch_to_device(batch)
         seq_len += images.shape[1]
         rng, step_rng = jax.random.split(rng)
         params, opt_state, loss = train_step(
-            params, opt_state, images, coords, labels, step_rng
+            params, opt_state, images, coords, labels, pad_mask, step_rng
         )
         records["loss"] += float(loss)
         n_batches += 1
@@ -224,7 +230,7 @@ def train_one_epoch(train_loader, train_step, params, opt_state, epoch, rng, arg
                 "Seq len: {:.1f}, Slide ID: {}".format(
                     epoch,
                     batch_idx,
-                    records["loss"] / max(batch_idx, 1),
+                    records["loss"] / (batch_idx + 1),
                     time_per_it,
                     seq_len / (batch_idx + 1),
                     batch["slide_id"][-1] if "slide_id" in batch else "None",
@@ -238,35 +244,38 @@ def train_one_epoch(train_loader, train_step, params, opt_state, epoch, rng, arg
 
 def evaluate(loader, eval_step, params, loss_fn, epoch, args):
     """Eval pass collecting probs/one-hot labels + metrics
-    (reference ``evaluate:289``)."""
-    records = get_records_array(len(loader), args.n_classes)
+    (reference ``evaluate:289``). Records are accumulated as lists so
+    retry-exhausted (skipped) samples never leave all-zero rows in the
+    metric inputs."""
+    probs, onehots = [], []
+    total_loss, n = 0.0, 0
     task_setting = args.task_config.get("setting", "multi_class")
-    n = 0
-    for batch_idx, batch in enumerate(loader):
-        images, coords, labels = _batch_to_device(batch)
-        logits = eval_step(params, images, coords)
+    for batch in loader:
+        images, coords, labels, pad_mask = _batch_to_device(batch)
+        logits = eval_step(params, images, coords, pad_mask)
         logits = jnp.asarray(logits, jnp.float32)
         if task_setting == "multi_label":
             loss = loss_fn(logits, labels)
-            prob = jax.nn.sigmoid(logits)
-            records["prob"][batch_idx] = np.asarray(prob)[0]
-            records["label"][batch_idx] = np.asarray(labels)[0]
+            probs.append(np.asarray(jax.nn.sigmoid(logits))[0])
+            onehots.append(np.asarray(labels, np.float32)[0])
         else:
             loss = loss_fn(logits, labels[:, 0])
-            prob = jax.nn.softmax(logits, axis=-1)
-            records["prob"][batch_idx] = np.asarray(prob)[0]
+            probs.append(np.asarray(jax.nn.softmax(logits, axis=-1))[0])
             one_hot = np.zeros(args.n_classes, np.float32)
             one_hot[int(labels[0, 0])] = 1.0
-            records["label"][batch_idx] = one_hot
-        records["loss"] += float(loss)
+            onehots.append(one_hot)
+        total_loss += float(loss)
         n += 1
 
+    records = get_records_array(n, args.n_classes)
+    records["prob"] = np.stack(probs) if probs else records["prob"]
+    records["label"] = np.stack(onehots) if onehots else records["label"]
     records.update(
         calculate_metrics_with_task_cfg(
             records["prob"], records["label"], args.task_config
         )
     )
-    records["loss"] = records["loss"] / max(n, 1)
+    records["loss"] = total_loss / max(n, 1)
 
     if task_setting == "multi_label":
         print(

@@ -1,0 +1,126 @@
+"""Batch inference driver over cached slide-feature files.
+
+Parity with reference ``docker/workspace/prov-gigapath/inference.py``: load a
+trained classification checkpoint, iterate ``*_features.pt`` files (or orbax
+feature dirs), softmax-classify, write a csv of ``slide_id`` /
+``predicted_label`` / ``confidence`` and print the label distribution +
+mean-confidence stats (``run_inference:37-79``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def load_model(
+    model_path: str,
+    input_dim: int = 1536,
+    latent_dim: int = 768,
+    feat_layer: str = "11",
+    n_classes: int = 2,
+    model_arch: str = "gigapath_slide_enc12l768d",
+    **kwargs,
+):
+    """Build the classification head and load a checkpoint
+    (reference ``load_model:18-34``)."""
+    from gigapath_tpu.finetune.predict import _load_params_into_model
+    from gigapath_tpu.models.classification_head import get_model
+
+    model, params = get_model(
+        input_dim=input_dim,
+        latent_dim=latent_dim,
+        feat_layer=feat_layer,
+        n_classes=n_classes,
+        model_arch=model_arch,
+        dtype=jnp.bfloat16,
+        **kwargs,
+    )
+    if model_path:
+        params = _load_params_into_model(model_path, params)
+    return model, params
+
+
+def _load_features(path: str) -> np.ndarray:
+    if path.endswith(".pt"):
+        import torch
+
+        t = torch.load(path, map_location="cpu", weights_only=False)
+        if isinstance(t, dict):
+            t = t.get("features", t.get("tile_embeds"))
+            assert t is not None, f"{path}: no 'features'/'tile_embeds' key"
+        return np.asarray(t.detach().cpu().numpy() if hasattr(t, "detach") else t)
+    from gigapath_tpu.utils.checkpoint import restore_checkpoint
+
+    state = restore_checkpoint(path)
+    return np.asarray(state["features"] if isinstance(state, dict) else state)
+
+
+def run_inference(
+    model,
+    params,
+    feature_dir: str,
+    output_file: str,
+):
+    """Classify every ``*_features.pt`` in ``feature_dir``
+    (reference ``run_inference:37-79``)."""
+    import pandas as pd
+
+    feature_files = sorted(glob.glob(os.path.join(feature_dir, "*_features.pt")))
+    if not feature_files:
+        print(f"No feature files found in {feature_dir}")
+        return None
+
+    @jax.jit
+    def forward(params, embeds, coords):
+        return model.apply({"params": params}, embeds, coords, deterministic=True)
+
+    results = []
+    for path in feature_files:
+        feats = _load_features(path)[None]  # [1, N, D]
+        coords = np.zeros((1, feats.shape[1], 2), np.float32)
+        logits = np.asarray(forward(params, jnp.asarray(feats), jnp.asarray(coords)), np.float32)
+        probs = np.asarray(jax.nn.softmax(logits, axis=-1))[0]
+        pred = int(probs.argmax())
+        results.append(
+            {
+                "slide_id": os.path.basename(path).replace("_features.pt", ""),
+                "predicted_label": pred,
+                "confidence": float(probs[pred]),
+            }
+        )
+
+    results_df = pd.DataFrame(results)
+    results_df.to_csv(output_file, index=False)
+    print(f"Inference results saved to {output_file}")
+    print(f"Label distribution: {results_df['predicted_label'].value_counts().to_dict()}")
+    print(f"Mean confidence: {results_df['confidence'].mean():.4f}")
+    return results_df
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="GigaPath model inference")
+    parser.add_argument("--model_path", type=str, required=True)
+    parser.add_argument("--feature_dir", type=str, required=True)
+    parser.add_argument("--output_file", type=str, default="predictions.csv")
+    parser.add_argument(
+        "--batch_size", type=int, default=16,
+        help="Accepted for reference-CLI compatibility (slides are "
+        "variable-length; processed one at a time)",
+    )
+    parser.add_argument("--num_classes", type=int, default=2)
+    parser.add_argument("--model_arch", type=str, default="gigapath_slide_enc12l768d")
+    args = parser.parse_args(argv)
+    model, params = load_model(
+        args.model_path, n_classes=args.num_classes, model_arch=args.model_arch
+    )
+    return run_inference(model, params, args.feature_dir, args.output_file)
+
+
+if __name__ == "__main__":
+    main()

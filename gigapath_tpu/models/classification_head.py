@@ -41,6 +41,7 @@ class ClassificationHead(nn.Module):
         self,
         images: jnp.ndarray,
         coords: jnp.ndarray,
+        pad_mask: Optional[jnp.ndarray] = None,
         deterministic: bool = True,
     ) -> jnp.ndarray:
         if images.ndim == 2:
@@ -56,7 +57,13 @@ class ClassificationHead(nn.Module):
             name="slide_encoder",
             **(self.slide_kwargs or {}),
         )
-        embeds = slide_encoder(images, coords, all_layer_embed=True, deterministic=deterministic)
+        embeds = slide_encoder(
+            images,
+            coords,
+            all_layer_embed=True,
+            pad_mask=pad_mask,
+            deterministic=deterministic,
+        )
         h = jnp.concatenate([embeds[i] for i in layers], axis=-1)
         assert h.shape[-1] == len(layers) * self.latent_dim, (
             f"feat dim {h.shape[-1]} != {len(layers)} layers x latent_dim "

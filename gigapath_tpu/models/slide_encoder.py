@@ -105,8 +105,13 @@ class LongNetViT(nn.Module):
         x: jnp.ndarray,
         coords: jnp.ndarray,
         all_layer_embed: bool = False,
+        pad_mask: Optional[jnp.ndarray] = None,
         deterministic: bool = True,
     ) -> List[jnp.ndarray]:
+        """``pad_mask``: optional [B, L] bool, True = VALID tile (the
+        collate convention, data/collate.py). Padded suffix tokens are
+        zeroed, excluded from every attention branch's keys, and excluded
+        from the global-pool mean."""
         B, L, _ = x.shape
         x = PatchEmbed(self.in_chans, self.embed_dim, dtype=self.dtype, name="patch_embed")(x)
 
@@ -138,8 +143,16 @@ class LongNetViT(nn.Module):
         )
         encoder = type(encoder)(args=encoder.args, dtype=self.dtype, name="encoder")
 
+        encoder_padding_mask = None
+        if pad_mask is not None:
+            # cls (position 0) is always valid; model convention is True=pad
+            encoder_padding_mask = jnp.concatenate(
+                [jnp.zeros((B, 1), bool), ~pad_mask.astype(bool)], axis=1
+            )
+
         out = encoder(
             token_embeddings=x,
+            encoder_padding_mask=encoder_padding_mask,
             return_all_hiddens=all_layer_embed,
             deterministic=deterministic,
         )
@@ -149,7 +162,14 @@ class LongNetViT(nn.Module):
         outcomes = []
         for h in x_list:
             if self.global_pool:
-                outcomes.append(norm(h[:, 1:, :].mean(axis=1)))
+                if pad_mask is not None:
+                    valid = pad_mask.astype(h.dtype)[..., None]
+                    pooled = (h[:, 1:, :] * valid).sum(axis=1) / jnp.clip(
+                        valid.sum(axis=1), 1.0
+                    )
+                else:
+                    pooled = h[:, 1:, :].mean(axis=1)
+                outcomes.append(norm(pooled))
             else:
                 outcomes.append(norm(h)[:, 0])
         return outcomes

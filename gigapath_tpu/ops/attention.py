@@ -65,9 +65,9 @@ def attention_with_lse(
         logits = logits + bias.astype(jnp.float32)
     kv_mask = None
     if kv_valid_len is not None:
-        import numpy as np
-
-        lens = jnp.asarray(np.asarray(kv_valid_len, np.int32))[:, :, None, None]
+        # accepts trace-time constants (numpy/tuple) or traced int arrays
+        # (dynamic suffix-pad masking)
+        lens = jnp.asarray(kv_valid_len, jnp.int32).reshape(B, H)[:, :, None, None]
         kv_mask = jnp.arange(Lk)[None, None, None, :] >= lens
         logits = jnp.where(kv_mask, NEG_INF, logits)
     pad_mask = None

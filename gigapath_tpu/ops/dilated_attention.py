@@ -166,6 +166,7 @@ def dilated_attention(
     seq_axis_size: int = 1,
     dropout_rate: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
+    valid_len: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Multi-branch dilated attention on [B, L, H, D] tensors -> [B, L, H, D].
 
@@ -175,6 +176,12 @@ def dilated_attention(
     length and branches whose segment exceeds it gather K/V across the axis.
     ``dropout_rate`` is attention-probability dropout inside each branch
     (parity with the reference forwarding dropout to flash-attn).
+
+    ``valid_len``: optional traced [B] int — each batch row's tokens at
+    positions ``>= valid_len[b]`` are *suffix padding* and are excluded from
+    every branch's keys (the masked-batching extension the reference only
+    sketches in its dead ``custom_*`` files). Forces the jnp attention path
+    for the masked branches (dynamic counts can't bake into the Pallas grid).
     """
     attn_fn_was_default = attn_fn is None
     if attn_fn_was_default:
@@ -217,6 +224,7 @@ def dilated_attention(
             q, k, v, int(sl), int(r),
             is_causal=is_causal, offset=offset, attn_fn=branch_fn,
             seq_axis_name=seq_axis_name, seq_axis_size=seq_axis_size,
+            valid_len=valid_len,
         )
         outs.append(o)
         lses.append(l)
@@ -247,6 +255,7 @@ def _dilated_branch(
     attn_fn: AttnFn,
     seq_axis_name: Optional[str],
     seq_axis_size: int,
+    valid_len: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """One (segment_length, ratio) branch -> (out [B,L,H,D], lse [B,H,L])."""
     B, L, H, Dh = q.shape
@@ -278,12 +287,40 @@ def _dilated_branch(
 
     kv_valid_len = None
     if gather_kv:
+        if valid_len is not None:
+            raise NotImplementedError(
+                "dynamic padding masks + sequence parallelism are not "
+                "supported together yet"
+            )
         ks = _gather_kv_seq_parallel(ks, sl, k.shape[1], seq_axis_name)
         vs = _gather_kv_seq_parallel(vs, sl, k.shape[1], seq_axis_name)
     else:
         kv_valid_len = _kv_valid_lengths(
             B, kp.shape[0] // B, g_k, r, ks.shape[1], H, k.shape[1]
         )
+        if valid_len is not None:
+            # dynamic per-batch suffix padding: same segment/dilation count
+            # formula as _kv_valid_lengths, with the traced valid length in
+            # place of the static real length; combined by min
+            n_seg_k = kp.shape[0] // B
+            m = ks.shape[1]
+            heads_per_group = -(-H // r)
+            phases = jnp.arange(H) // heads_per_group  # [H]
+            seg = jnp.arange(n_seg_k)  # [n_seg]
+            counts = jnp.ceil(
+                (
+                    valid_len[:, None, None]
+                    - seg[None, :, None] * g_k
+                    - phases[None, None, :]
+                )
+                / r
+            )
+            counts = jnp.clip(counts, 0, m).astype(jnp.int32).reshape(B * n_seg_k, H)
+            kv_valid_len = (
+                counts
+                if kv_valid_len is None
+                else jnp.minimum(counts, jnp.asarray(kv_valid_len, jnp.int32))
+            )
 
     out_s, lse_s = attn_fn(qs, ks, vs, is_causal=is_causal, kv_valid_len=kv_valid_len)
 
@@ -324,9 +361,14 @@ class DilatedAttention(MultiheadAttention):
     ):
         assert rel_pos is None, "dilated attention does not support rel_pos bias"
         assert attn_mask is None, "dilated attention does not support attn_mask"
-        # The reference's live path ignores key_padding_mask inside dilated
-        # attention (SURVEY §2.7: the collate returns a pad mask the model
-        # never consumes); zero-padding keys contribute like zero-logit keys.
+        # key_padding_mask (True = pad) is consumed as a *suffix* valid
+        # length: batches are collated with trailing padding (data/collate.py),
+        # so per-row valid counts capture the mask exactly. (The reference's
+        # live path drops the mask entirely, SURVEY §2.7; its dead custom_*
+        # files sketch the same per-branch masking implemented here.)
+        valid_len = None
+        if key_padding_mask is not None:
+            valid_len = (~key_padding_mask).sum(axis=-1).astype(jnp.int32)
         rng = None
         if self.dropout > 0.0 and not deterministic:
             rng = self.make_rng("dropout")
@@ -342,5 +384,6 @@ class DilatedAttention(MultiheadAttention):
             seq_axis_size=self.seq_axis_size if self.seq_parallel else 1,
             dropout_rate=0.0 if deterministic else self.dropout,
             dropout_rng=rng,
+            valid_len=valid_len,
         )
         return out.reshape(out.shape[0], out.shape[1], self.embed_dim)

@@ -40,15 +40,26 @@ def flash_attention(
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Attention on [B, L, H, D] returning ``(out [B,L,H,D], lse [B,H,L])``.
 
-    ``kv_valid_len``: static [B, H] valid-key counts (ragged tail masking);
-    supported by both the Pallas kernel and the jnp fallback.
+    ``kv_valid_len``: [B, H] valid-key counts (ragged tail masking). Static
+    (numpy/tuple) counts ride both backends; *traced* counts (dynamic
+    per-batch padding) are only supported by the jnp path — the Pallas
+    wrapper bakes them into the compiled grid.
     """
+    kvlen_is_dynamic = isinstance(kv_valid_len, jax.Array) or isinstance(
+        kv_valid_len, jax.core.Tracer
+    )
     if use_pallas is None:
         use_pallas = (
             _on_tpu()
             and bias is None
+            and not kvlen_is_dynamic
             and q.shape[1] >= PALLAS_MIN_SEQ
             and _pallas_available()
+        )
+    elif use_pallas and kvlen_is_dynamic:
+        raise ValueError(
+            "use_pallas=True requires static kv_valid_len; traced counts "
+            "(dynamic padding masks) need the jnp path"
         )
     elif use_pallas and bias is not None:
         # the Pallas kernel takes no bias; silently dropping it would produce

@@ -1,0 +1,174 @@
+"""User-facing inference pipeline: tile a slide, encode tiles, encode slide.
+
+Parity with reference ``gigapath/pipeline.py``: the same five entry points —
+``tile_one_slide`` (L55), ``load_tile_encoder_transforms`` (L106),
+``load_tile_slide_encoder`` (L118), ``run_inference_with_tile_encoder``
+(L140), ``run_inference_with_slide_encoder`` (L165) — with the same
+invariants (dataset.csv non-empty, failed_tiles.csv empty after tiling;
+batch-128 bf16 tile encoding; all-layer slide embeddings keyed
+``layer_{i}_embed`` + ``last_layer_embed``).
+
+TPU shape: the tile encoder runs as one jitted bf16 forward over fixed
+[128, 224, 224, 3] batches (the last partial batch is padded then sliced,
+so a slide triggers exactly one compile); transfers are one
+``device_put`` per batch. Checkpoints load from local paths (zero-egress
+build; HF-hub names fall back to random init with a warning).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gigapath_tpu.data.tile_dataset import TileEncodingDataset
+from gigapath_tpu.data.transforms import preprocess_tile
+from gigapath_tpu.models import slide_encoder as slide_encoder_lib
+from gigapath_tpu.models import tile_encoder as tile_encoder_lib
+from gigapath_tpu.preprocessing.create_tiles_dataset import process_slide
+
+
+def tile_one_slide(
+    slide_file: str = "",
+    save_dir: str = "",
+    level: int = 0,
+    tile_size: int = 256,
+):
+    """Tile a single slide to ``save_dir/output/<slide_id>/`` and assert the
+    reference's ledger invariants (``pipeline.py:55-103``)."""
+    import pandas as pd
+
+    slide_id = os.path.basename(slide_file)
+    slide_sample = {"image": slide_file, "slide_id": slide_id, "metadata": {}}
+
+    save_dir = Path(save_dir)
+    if save_dir.exists():
+        print(f"Warning: Directory {save_dir} already exists. ")
+    print(
+        f"Processing slide {slide_file} at level {level} with tile size "
+        f"{tile_size}. Saving to {save_dir}."
+    )
+    slide_dir = process_slide(
+        slide_sample,
+        level=level,
+        margin=0,
+        tile_size=tile_size,
+        foreground_threshold=None,
+        occupancy_threshold=0.1,
+        output_dir=save_dir / "output",
+        thumbnail_dir=save_dir / "thumbnails",
+        tile_progress=True,
+    )
+    dataset_df = pd.read_csv(slide_dir / "dataset.csv")
+    assert len(dataset_df) > 0
+    failed_df = pd.read_csv(slide_dir / "failed_tiles.csv")
+    assert len(failed_df) == 0
+    print(
+        f"Slide {slide_file} has been tiled. {len(dataset_df)} tiles saved to {slide_dir}."
+    )
+    return slide_dir
+
+
+def load_tile_encoder_transforms(crop_size: int = 224):
+    """The tile transform (resize-256 bicubic / center-crop-224 / ImageNet
+    normalize), as a plain callable on PIL images or uint8 arrays."""
+    return lambda img: preprocess_tile(img, crop_size=crop_size)
+
+
+def load_tile_slide_encoder(
+    local_tile_encoder_path: str = "",
+    local_slide_encoder_path: str = "",
+    global_pool: bool = False,
+) -> Tuple[tuple, tuple]:
+    """Load both encoders; returns ``((tile_model, tile_params),
+    (slide_model, slide_params))`` (reference ``pipeline.py:118-137``)."""
+    tile_model, tile_params = tile_encoder_lib.create_tile_encoder(
+        pretrained=local_tile_encoder_path, dtype=jnp.bfloat16
+    )
+    n_tile = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(tile_params))
+    print("Tile encoder param #", n_tile)
+
+    slide_model, slide_params = slide_encoder_lib.create_model(
+        local_slide_encoder_path or "hf_hub:prov-gigapath/prov-gigapath",
+        "gigapath_slide_enc12l768d",
+        1536,
+        global_pool=global_pool,
+        dtype=jnp.bfloat16,
+    )
+    n_slide = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(slide_params))
+    print("Slide encoder param #", n_slide)
+    return (tile_model, tile_params), (slide_model, slide_params)
+
+
+def run_inference_with_tile_encoder(
+    image_paths: List[str],
+    tile_encoder,
+    tile_params=None,
+    batch_size: int = 128,
+) -> dict:
+    """Encode tiles in fixed-size batches -> {'tile_embeds' [N, 1536],
+    'coords' [N, 2]} (reference ``pipeline.py:140-162``).
+
+    ``tile_encoder`` may be the ``(model, params)`` tuple from
+    :func:`load_tile_slide_encoder` or a module with params passed
+    separately."""
+    if tile_params is None:
+        tile_encoder, tile_params = tile_encoder
+    dataset = TileEncodingDataset(
+        image_paths,
+        transform=load_tile_encoder_transforms(crop_size=tile_encoder.img_size),
+    )
+
+    @jax.jit
+    def encode(params, imgs):
+        return tile_encoder.apply({"params": params}, imgs)
+
+    embeds, coords = [], []
+    for start in range(0, len(dataset), batch_size):
+        samples = [dataset[i] for i in range(start, min(start + batch_size, len(dataset)))]
+        imgs = np.stack([s["img"] for s in samples])
+        n = imgs.shape[0]
+        if n < batch_size:  # pad to the compiled batch shape, slice after
+            imgs = np.concatenate(
+                [imgs, np.zeros((batch_size - n, *imgs.shape[1:]), imgs.dtype)]
+            )
+        out = encode(tile_params, jnp.asarray(imgs, jnp.bfloat16))
+        embeds.append(np.asarray(out[:n], np.float32))
+        coords.append(np.stack([s["coords"] for s in samples]))
+    return {
+        "tile_embeds": np.concatenate(embeds),
+        "coords": np.concatenate(coords).astype(np.float32),
+    }
+
+
+def run_inference_with_slide_encoder(
+    tile_embeds: np.ndarray,
+    coords: np.ndarray,
+    slide_encoder_model=None,
+    slide_params=None,
+) -> dict:
+    """All-layer slide embedding from tile embeddings
+    (reference ``pipeline.py:165-190``)."""
+    if slide_params is None:
+        slide_encoder_model, slide_params = slide_encoder_model
+    tile_embeds = jnp.asarray(tile_embeds)
+    coords = jnp.asarray(coords, jnp.float32)
+    if tile_embeds.ndim == 2:
+        tile_embeds = tile_embeds[None]
+        coords = coords[None]
+
+    slide_embeds = jax.jit(
+        lambda p, x, c: slide_encoder_model.apply(
+            {"params": p}, x, c, all_layer_embed=True
+        )
+    )(slide_params, tile_embeds.astype(jnp.bfloat16), coords)
+    outputs = {
+        f"layer_{i}_embed": np.asarray(e, np.float32)
+        for i, e in enumerate(slide_embeds)
+    }
+    outputs["last_layer_embed"] = np.asarray(slide_embeds[-1], np.float32)
+    return outputs

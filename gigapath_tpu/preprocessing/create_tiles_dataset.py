@@ -1,0 +1,371 @@
+"""Slide -> tiles preprocessing pipeline.
+
+Parity with reference ``gigapath/preprocessing/data/create_tiles_dataset.py``:
+occupancy-filtered tiling of the foreground ROI, per-tile PNGs named
+``{x:05d}x_{y:05d}y.png``, per-slide ``dataset.csv`` + ``failed_tiles.csv``
+ledgers, thumbnails + tile-location overlay, resume-if-processed idempotence
+(``is_already_processed:221``), per-dataset csv merge, and a multiprocessing
+slide map. Host-side CPU work feeding the TPU tile encoder — no jax here.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import shutil
+import traceback
+import warnings
+from pathlib import Path
+from typing import Any, Dict, Iterable, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from gigapath_tpu.data import tiling
+from gigapath_tpu.preprocessing.foreground_segmentation import (
+    LoadROId,
+    open_slide,
+    segment_foreground,
+)
+
+
+def select_tiles(
+    foreground_mask: np.ndarray, occupancy_threshold: float
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Keep tiles whose foreground occupancy exceeds the threshold
+    (reference ``select_tiles:30-42``)."""
+    if occupancy_threshold < 0.0 or occupancy_threshold > 1.0:
+        raise ValueError("Tile occupancy threshold must be between 0 and 1")
+    occupancy = foreground_mask.mean(axis=(-2, -1), dtype=np.float16)
+    return (occupancy > occupancy_threshold).squeeze(), occupancy.squeeze()
+
+
+def get_tile_descriptor(tile_location: Sequence[int]) -> str:
+    return f"{tile_location[0]:05d}x_{tile_location[1]:05d}y"
+
+
+def get_tile_id(slide_id: str, tile_location: Sequence[int]) -> str:
+    return f"{slide_id}.{get_tile_descriptor(tile_location)}"
+
+
+def save_image(array_chw: np.ndarray, path: Path):
+    """Save a (C, H, W) array as an RGB image."""
+    import PIL
+
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    array_hwc = np.moveaxis(array_chw, 0, -1).astype(np.uint8).squeeze()
+    pil_image = PIL.Image.fromarray(array_hwc)
+    pil_image.convert("RGB").save(path)
+    return pil_image
+
+
+def check_empty_tiles(
+    tiles: np.ndarray, std_th: int = 5, extreme_value_portion_th: float = 0.5
+) -> np.ndarray:
+    """Low-variance / extreme-value emptiness heuristic
+    (reference ``check_empty_tiles:64-84``)."""
+    b, c, h, w = tiles.shape
+    flat = tiles.reshape(b, c, h * w)
+    std_rgb_mean = flat.std(axis=2).mean(axis=1)
+    low_std_mask = std_rgb_mean < std_th
+    extreme_value_proportion = (flat == 0).sum(axis=2) / (h * w)
+    extreme_value_mask = extreme_value_proportion.max(axis=1) > extreme_value_portion_th
+    return low_std_mask | extreme_value_mask
+
+
+def generate_tiles(
+    slide_image: np.ndarray,
+    tile_size: int,
+    foreground_threshold: float,
+    occupancy_threshold: float,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Tile the ROI and drop background tiles (reference
+    ``generate_tiles:87-124``). Returns (tiles [N,C,h,w], locations [N,2],
+    occupancies [N], n_discarded)."""
+    image_tiles, tile_locations = tiling.tile_array_2d(
+        slide_image, tile_size=tile_size, constant_values=255
+    )
+    logging.info(f"Tiled {slide_image.shape} to {image_tiles.shape}")
+    foreground_mask, _ = segment_foreground(image_tiles, foreground_threshold)
+    selected, occupancies = select_tiles(foreground_mask, occupancy_threshold)
+    n_discarded = int((~selected).sum())
+    logging.info(f"Percentage tiles discarded: {n_discarded / len(selected) * 100:.2f}")
+
+    image_tiles = image_tiles[selected]
+    tile_locations = tile_locations[selected]
+    occupancies = occupancies[selected]
+    if len(tile_locations) == 0:
+        logging.warning("No tiles selected")
+    return image_tiles, tile_locations, occupancies, n_discarded
+
+
+def get_tile_info(
+    sample: Dict[str, Any],
+    occupancy: float,
+    tile_location: Sequence[int],
+    rel_slide_dir: Path,
+) -> Dict[str, Any]:
+    slide_id = sample["slide_id"]
+    descriptor = get_tile_descriptor(tile_location)
+    return {
+        "slide_id": slide_id,
+        "tile_id": get_tile_id(slide_id, tile_location),
+        "image": f"{rel_slide_dir}/{descriptor}.png",
+        "label": sample.get("label", None),
+        "tile_x": tile_location[0],
+        "tile_y": tile_location[1],
+        "occupancy": occupancy,
+        "metadata": {
+            "slide_" + key: value for key, value in sample.get("metadata", {}).items()
+        },
+    }
+
+
+def format_csv_row(
+    tile_info: Dict[str, Any],
+    keys_to_save: Iterable[str],
+    metadata_keys: Iterable[str],
+) -> str:
+    tile_slide_metadata = tile_info.pop("metadata")
+    fields = [str(tile_info[key]) for key in keys_to_save]
+    fields.extend(str(tile_slide_metadata[key]) for key in metadata_keys)
+    return ",".join(fields)
+
+
+def save_thumbnail(slide_path, output_path, size_target: int = 1024) -> None:
+    """Downscaled whole-slide thumbnail (reference ``save_thumbnail:192``)."""
+    from PIL import Image
+
+    reader = open_slide(slide_path)
+    try:
+        arr = reader.read_level(reader.level_count - 1)
+        img = Image.fromarray(np.moveaxis(arr, 0, -1))
+        scale = size_target / max(img.size)
+        if scale < 1:
+            img = img.resize([max(1, int(m * scale)) for m in img.size])
+        img.save(output_path)
+        logging.info(f"Saving thumbnail {output_path}, shape {img.size}")
+    finally:
+        reader.close()
+
+
+def visualize_tile_locations(
+    slide_sample, output_path, tile_info_list, tile_size, origin_offset
+) -> None:
+    """Overlay of selected tile boxes on the ROI thumbnail
+    (reference ``visualize_tile_locations:200-218``)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    from matplotlib import collections, patches, pyplot as plt
+
+    slide_image = slide_sample["image"]
+    downscale_factor = slide_sample["scale"]
+    fig, ax = plt.subplots()
+    ax.imshow(slide_image.transpose(1, 2, 0))
+    rects = []
+    for tile_info in tile_info_list:
+        xy = (
+            (tile_info["tile_x"] - origin_offset[1]) / downscale_factor,
+            (tile_info["tile_y"] - origin_offset[0]) / downscale_factor,
+        )
+        rects.append(patches.Rectangle(xy, tile_size, tile_size))
+    pc = collections.PatchCollection(
+        rects, match_original=True, alpha=0.5, edgecolor="black"
+    )
+    pc.set_array(np.array([100] * len(tile_info_list)))
+    ax.add_collection(pc)
+    fig.savefig(output_path)
+    plt.close(fig)
+
+
+def is_already_processed(output_tiles_dir) -> bool:
+    """Resume support: a slide directory with tiles + a non-empty csv is
+    done (reference ``is_already_processed:221-234``)."""
+    import pandas as pd
+
+    output_tiles_dir = Path(output_tiles_dir)
+    if not output_tiles_dir.exists():
+        return False
+    if len(list(output_tiles_dir.glob("*.png"))) == 0:
+        return False
+    try:
+        df = pd.read_csv(output_tiles_dir / "dataset.csv")
+    except Exception:
+        return False
+    return len(df) > 0
+
+
+def process_slide(
+    sample: Dict[str, Any],
+    level: int,
+    margin: int,
+    tile_size: int,
+    foreground_threshold: Optional[float],
+    occupancy_threshold: float,
+    output_dir: Path,
+    thumbnail_dir: Path,
+    tile_progress: bool = False,
+) -> Path:
+    """Tile one slide end-to-end, writing PNGs + csv ledgers
+    (reference ``process_slide:237-354``)."""
+    output_dir, thumbnail_dir = Path(output_dir), Path(thumbnail_dir)
+    output_dir.mkdir(parents=True, exist_ok=True)
+    thumbnail_dir.mkdir(parents=True, exist_ok=True)
+    slide_metadata: Dict[str, Any] = sample.get("metadata", {})
+    keys_to_save = (
+        "slide_id", "tile_id", "image", "label", "tile_x", "tile_y", "occupancy",
+    )
+    metadata_keys = tuple("slide_" + key for key in slide_metadata)
+    csv_columns = (*keys_to_save, *metadata_keys)
+
+    slide_id: str = sample["slide_id"]
+    rel_slide_dir = Path(slide_id)
+    output_tiles_dir = output_dir / rel_slide_dir
+    logging.info(f">>> Slide dir {output_tiles_dir}")
+    if is_already_processed(output_tiles_dir):
+        logging.info(f">>> Skipping {output_tiles_dir} - already processed")
+        return output_tiles_dir
+
+    output_tiles_dir.mkdir(parents=True, exist_ok=True)
+    dataset_csv_path = output_tiles_dir / "dataset.csv"
+    failed_tiles_csv_path = output_tiles_dir / "failed_tiles.csv"
+    n_failed_tiles = 0
+
+    with dataset_csv_path.open("w") as dataset_csv_file, failed_tiles_csv_path.open(
+        "w"
+    ) as failed_tiles_file:
+        dataset_csv_file.write(",".join(csv_columns) + "\n")
+        failed_tiles_file.write("tile_id\n")
+
+        slide_image_path = Path(sample["image"])
+        logging.info(f"Loading slide {slide_id} ...\nFile: {slide_image_path}")
+        save_thumbnail(
+            slide_image_path, thumbnail_dir / (slide_image_path.name + "_original.png")
+        )
+
+        loader = LoadROId(
+            level=level, margin=margin, foreground_threshold=foreground_threshold
+        )
+        sample = loader(dict(sample))
+
+        save_image(
+            sample["image"], thumbnail_dir / (slide_image_path.name + "_roi.png")
+        )
+
+        logging.info(f"Tiling slide {slide_id} ...")
+        image_tiles, rel_tile_locations, occupancies, _ = generate_tiles(
+            sample["image"],
+            tile_size,
+            sample["foreground_threshold"],
+            occupancy_threshold,
+        )
+        # tile locations: level coords -> level-0 coords; origin is (y, x)
+        # while locations are (x, y) (reference process_slide:314-318)
+        tile_locations = (
+            sample["scale"] * rel_tile_locations + np.asarray(sample["origin"])[::-1]
+        ).astype(int)
+        n_tiles = image_tiles.shape[0]
+        logging.info(f"{n_tiles} tiles found")
+
+        tile_info_list = []
+        for i in range(n_tiles):
+            try:
+                tile_info = get_tile_info(
+                    sample, occupancies[i], tile_locations[i], rel_slide_dir
+                )
+                tile_info_list.append(tile_info)
+                save_image(image_tiles[i], output_dir / tile_info["image"])
+                dataset_csv_file.write(
+                    format_csv_row(tile_info, keys_to_save, metadata_keys) + "\n"
+                )
+            except Exception as e:
+                n_failed_tiles += 1
+                descriptor = get_tile_descriptor(tile_locations[i])
+                failed_tiles_file.write(descriptor + "\n")
+                traceback.print_exc()
+                warnings.warn(
+                    f"An error occurred while saving tile "
+                    f"{get_tile_id(slide_id, tile_locations[i])}: {e}"
+                )
+
+    visualize_tile_locations(
+        sample,
+        thumbnail_dir / (slide_image_path.name + "_roi_tiles.png"),
+        tile_info_list,
+        tile_size,
+        origin_offset=sample["origin"],
+    )
+    if n_failed_tiles > 0:
+        logging.warning(f"{slide_id} is incomplete. {n_failed_tiles} tiles failed.")
+    logging.info(f"Finished processing slide {slide_id}")
+    return output_tiles_dir
+
+
+def merge_dataset_csv_files(dataset_dir: Path) -> Path:
+    """All ``*/dataset.csv`` -> one ``dataset.csv``
+    (reference ``merge_dataset_csv_files:357-374``)."""
+    dataset_dir = Path(dataset_dir)
+    full_csv = dataset_dir / "dataset.csv"
+    with full_csv.open("w") as full_csv_file:
+        first_file = True
+        for slide_csv in sorted(dataset_dir.glob("*/dataset.csv")):
+            logging.info(f"Merging slide {slide_csv}")
+            content = slide_csv.read_text()
+            if not first_file:
+                content = content[content.index("\n") + 1 :]
+            full_csv_file.write(content)
+            first_file = False
+    return full_csv
+
+
+def main(
+    slides: Sequence[Dict[str, Any]],
+    root_output_dir: Union[str, Path],
+    level: int,
+    tile_size: int,
+    margin: int,
+    foreground_threshold: Optional[float],
+    occupancy_threshold: float,
+    parallel: bool = False,
+    overwrite: bool = False,
+    n_slides: Optional[int] = None,
+) -> None:
+    """Process a list of slide sample dicts into a tiles dataset
+    (reference ``main:377-437``); resume-by-skip unless ``overwrite``."""
+    dataset = list(slides)[:n_slides]
+    for sample in dataset:
+        image_path = Path(sample["image"])
+        assert image_path.exists(), f"{image_path} doesn't exist"
+
+    output_dir = Path(root_output_dir)
+    logging.info(
+        f"Creating dataset of level-{level} {tile_size}x{tile_size} tiles at: {output_dir}"
+    )
+    if overwrite and output_dir.exists():
+        shutil.rmtree(output_dir)
+    output_dir.mkdir(parents=True, exist_ok=not overwrite)
+    thumbnail_dir = output_dir / "thumbnails"
+    thumbnail_dir.mkdir(exist_ok=True)
+
+    func = functools.partial(
+        process_slide,
+        level=level,
+        margin=margin,
+        tile_size=tile_size,
+        foreground_threshold=foreground_threshold,
+        occupancy_threshold=occupancy_threshold,
+        output_dir=output_dir,
+        thumbnail_dir=thumbnail_dir,
+        tile_progress=not parallel,
+    )
+    if parallel:
+        import multiprocessing
+
+        with multiprocessing.Pool() as pool:
+            list(pool.imap_unordered(func, dataset))
+    else:
+        list(map(func, dataset))
+
+    logging.info("Merging slide files in a single file")
+    merge_dataset_csv_files(output_dir)

@@ -1,0 +1,321 @@
+"""Two-stage pretraining driver (replication additions).
+
+Parity with reference ``docker/workspace/prov-gigapath/pretrain_gigapath.py``:
+
+- **Stage 1 — simplified-MAE tile pretrain** (``MaskedAutoencoder:48``,
+  ``pretrain_tile_encoder:120``): random pixel-token zero-masking (ratio
+  0.75), the ViT tile encoder, an MLP decoder reconstructing the full
+  224x224x3 image, MSE over *all* pixels (the reference computes the loss on
+  everything despite its masked-region comment); AdamW + cosine; best +
+  periodic checkpoints.
+- **Stage 2 — contrastive slide pretrain** (``pretrain_slide_encoder:206``):
+  frozen tile encoder feature extraction per slide, a mean-pool MLP
+  ``SimpleSlideEncoder`` stand-in (``:226-250``), InfoNCE at temperature
+  0.07 with self-similarity logits (``contrastive_loss:264``), one optimizer
+  step per epoch over the stacked slide features.
+- Orchestration with resume-if-processed slide preprocessing
+  (``main:506``, skip at ``:487-490``).
+
+TPU deltas: the per-sample Python masking loop becomes a vectorized
+``jax.random.permutation`` over pixel tokens; fp16 autocast becomes bf16;
+checkpoints are orbax state dicts.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from gigapath_tpu.models.tile_encoder import VisionTransformer
+from gigapath_tpu.utils.checkpoint import restore_checkpoint, save_checkpoint
+
+
+def random_masking(rng: jax.Array, imgs: jnp.ndarray, mask_ratio: float) -> jnp.ndarray:
+    """Zero a random ``mask_ratio`` of pixel positions per image
+    ([B, H, W, C]); vectorized counterpart of the reference's per-sample
+    token loop (``pretrain_gigapath.py:66-91``)."""
+    B, H, W, C = imgs.shape
+    L = H * W
+    len_keep = int(L * (1 - mask_ratio))
+    noise = jax.random.uniform(rng, (B, L))
+    # rank of each position in the random shuffle; the len_keep lowest-noise
+    # positions are kept — scatter-free formulation of the reference's
+    # ids_shuffle / ids_keep dance
+    ranks = jnp.argsort(jnp.argsort(noise, axis=1), axis=1)
+    keep = ranks < len_keep
+    return imgs * keep.reshape(B, H, W, 1).astype(imgs.dtype)
+
+
+class MaskedAutoencoder(nn.Module):
+    """Simplified MAE: encoder + MLP pixel decoder (reference ``:48-107``)."""
+
+    encoder: VisionTransformer
+    decoder_dim: int = 512
+    mask_ratio: float = 0.75
+
+    @nn.compact
+    def __call__(self, imgs: jnp.ndarray, rng: Optional[jax.Array] = None):
+        masked = imgs if rng is None else random_masking(rng, imgs, self.mask_ratio)
+        latent = self.encoder(masked)
+        h = nn.Dense(self.decoder_dim, name="dec1")(latent)
+        h = nn.gelu(h)
+        h = nn.Dense(self.decoder_dim, name="dec2")(h)
+        h = nn.gelu(h)
+        size = self.encoder.img_size
+        pred = nn.Dense(3 * size * size, name="dec3")(h)
+        pred = pred.reshape(pred.shape[0], size, size, 3)
+        loss = jnp.mean((pred.astype(jnp.float32) - imgs.astype(jnp.float32)) ** 2)
+        return loss, pred
+
+
+def _load_tile_batch(paths: Sequence[str], img_size: int) -> np.ndarray:
+    from PIL import Image
+
+    from gigapath_tpu.data.transforms import preprocess_tile
+
+    return np.stack(
+        [preprocess_tile(Image.open(p), crop_size=img_size) for p in paths]
+    )
+
+
+def collect_image_paths(data_dir: str, extensions=(".png", ".jpg", ".jpeg")) -> List[str]:
+    image_paths: List[str] = []
+    for ext in extensions:
+        image_paths.extend(
+            glob.glob(os.path.join(data_dir, f"**/*{ext}"), recursive=True)
+        )
+    return sorted(image_paths)
+
+
+def pretrain_tile_encoder(
+    image_paths: Sequence[str],
+    output_dir: str,
+    *,
+    encoder: Optional[VisionTransformer] = None,
+    batch_size: int = 64,
+    num_epochs: int = 100,
+    learning_rate: float = 1e-4,
+    mask_ratio: float = 0.75,
+    checkpoint_every: int = 10,
+    seed: int = 0,
+) -> str:
+    """Stage 1 (reference ``pretrain_tile_encoder:120-204``): returns the
+    best-checkpoint path."""
+    os.makedirs(output_dir, exist_ok=True)
+    encoder = encoder or VisionTransformer(dtype=jnp.bfloat16)
+    mae = MaskedAutoencoder(encoder=encoder, mask_ratio=mask_ratio)
+
+    rng = jax.random.PRNGKey(seed)
+    init_imgs = jnp.zeros((1, encoder.img_size, encoder.img_size, 3), jnp.float32)
+    params = mae.init(rng, init_imgs)["params"]
+
+    steps_per_epoch = max(len(image_paths) // batch_size, 1)
+    tx = optax.adamw(
+        optax.cosine_decay_schedule(learning_rate, num_epochs * steps_per_epoch)
+    )
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, imgs, rng):
+        (loss, _), grads = jax.value_and_grad(
+            lambda p: mae.apply({"params": p}, imgs, rng), has_aux=True
+        )(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    order_rng = np.random.default_rng(seed)
+    best_loss = float("inf")
+    best_path = os.path.join(output_dir, "best_tile_encoder")
+    for epoch in range(num_epochs):
+        order = order_rng.permutation(len(image_paths))
+        epoch_loss, n_steps = 0.0, 0
+        for start in range(0, steps_per_epoch * batch_size, batch_size):
+            idx = order[start : start + batch_size]
+            if len(idx) == 0:
+                break
+            imgs = jnp.asarray(
+                _load_tile_batch([image_paths[i] for i in idx], encoder.img_size)
+            )
+            rng, mask_rng = jax.random.split(rng)
+            params, opt_state, loss = step(params, opt_state, imgs, mask_rng)
+            epoch_loss += float(loss)
+            n_steps += 1
+        epoch_loss /= max(n_steps, 1)
+        print(f"Epoch {epoch + 1}/{num_epochs}, loss {epoch_loss:.6f}")
+        if epoch_loss < best_loss:
+            best_loss = epoch_loss
+            save_checkpoint(
+                best_path,
+                {"params": jax.device_get(params), "epoch": np.asarray(epoch), "loss": np.asarray(epoch_loss)},
+            )
+        if (epoch + 1) % checkpoint_every == 0:
+            save_checkpoint(
+                os.path.join(output_dir, f"tile_encoder_epoch_{epoch + 1}"),
+                {"params": jax.device_get(params), "epoch": np.asarray(epoch)},
+            )
+    print(f"Pretraining done. Best loss: {best_loss:.6f}")
+    return best_path
+
+
+class SimpleSlideEncoder(nn.Module):
+    """Mean-pool MLP slide-encoder stand-in (reference ``:226-250``)."""
+
+    in_dim: int = 1536
+    hidden_dim: int = 768
+    out_dim: int = 768
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, coords=None) -> jnp.ndarray:
+        x = x.mean(axis=1)
+        # ONE norm applied at both sites, params tied — the reference
+        # declares a single self.norm and calls it twice
+        # (pretrain_gigapath.py:237,243-246)
+        norm = nn.LayerNorm(name="norm")
+        x = norm(nn.gelu(nn.Dense(self.hidden_dim, name="fc1")(x)))
+        x = norm(nn.gelu(nn.Dense(self.hidden_dim, name="fc2")(x)))
+        return nn.Dense(self.out_dim, name="fc3")(x)
+
+
+def contrastive_loss(features: jnp.ndarray, temperature: float = 0.07) -> jnp.ndarray:
+    """InfoNCE on the self-similarity matrix (reference
+    ``contrastive_loss:264-287``)."""
+    if features.shape[0] <= 1:
+        return jnp.float32(0.1)
+    features = features / jnp.clip(
+        jnp.linalg.norm(features, axis=1, keepdims=True), 1e-8
+    )
+    sim = features @ features.T
+    labels = jnp.arange(features.shape[0])
+    return optax.softmax_cross_entropy_with_integer_labels(
+        sim / temperature, labels
+    ).mean()
+
+
+def extract_slide_features(
+    tile_encoder, tile_params, slide_dirs: Sequence[str], batch_size: int = 64
+) -> List[np.ndarray]:
+    """Frozen tile-encoder features per slide directory
+    (reference ``:329-352``)."""
+    encode = jax.jit(lambda p, x: tile_encoder.apply({"params": p}, x))
+    all_feats = []
+    for slide_dir in slide_dirs:
+        image_paths = collect_image_paths(slide_dir)
+        if not image_paths:
+            continue
+        feats = []
+        for start in range(0, len(image_paths), batch_size):
+            imgs = _load_tile_batch(
+                image_paths[start : start + batch_size], tile_encoder.img_size
+            )
+            feats.append(np.asarray(encode(tile_params, jnp.asarray(imgs)), np.float32))
+        all_feats.append(np.concatenate(feats))
+    return all_feats
+
+
+def pretrain_slide_encoder(
+    tile_encoder,
+    tile_params,
+    image_dirs: Sequence[str],
+    output_dir: str,
+    *,
+    num_epochs: int = 50,
+    learning_rate: float = 1e-4,
+    max_tiles: int = 256,
+    seed: int = 0,
+) -> str:
+    """Stage 2 (reference ``pretrain_slide_encoder:206-451``): contrastive
+    training of the slide encoder over frozen tile features; one optimizer
+    step per epoch, matching the reference (``:405-420``)."""
+    os.makedirs(output_dir, exist_ok=True)
+    slide_feats = extract_slide_features(tile_encoder, tile_params, image_dirs)
+    if not slide_feats:
+        raise ValueError("no slides with tiles found")
+    n = min(min(f.shape[0] for f in slide_feats), max_tiles)
+    batch = jnp.asarray(np.stack([f[:n] for f in slide_feats]))  # [S, n, D]
+
+    model = SimpleSlideEncoder(in_dim=batch.shape[-1])
+    params = model.init(jax.random.PRNGKey(seed), batch)["params"]
+    tx = optax.adamw(optax.cosine_decay_schedule(learning_rate, num_epochs))
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            return contrastive_loss(model.apply({"params": p}, batch))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    best_loss = float("inf")
+    best_path = os.path.join(output_dir, "best_slide_encoder")
+    for epoch in range(num_epochs):
+        params, opt_state, loss = step(params, opt_state)
+        loss = float(loss)
+        print(f"Epoch {epoch + 1}/{num_epochs}, contrastive loss {loss:.6f}")
+        if loss < best_loss:
+            best_loss = loss
+            save_checkpoint(
+                best_path, {"params": jax.device_get(params), "loss": np.asarray(loss)}
+            )
+    print(f"Slide pretraining done. Best loss: {best_loss:.6f}")
+    return best_path
+
+
+def preprocess_slides(
+    slide_files: Sequence[str], output_dir: str, tile_size: int = 256
+) -> List[str]:
+    """Tile raw slides, skipping already-processed ones
+    (reference ``preprocess_slides:476-504``)."""
+    from gigapath_tpu.pipeline import tile_one_slide
+
+    slide_dirs = []
+    for slide_file in slide_files:
+        slide_id = os.path.basename(slide_file)
+        out = os.path.join(output_dir, "output", slide_id)
+        if os.path.isdir(out) and glob.glob(os.path.join(out, "*.png")):
+            print(f"Skipping {slide_id} - already processed")
+        else:
+            tile_one_slide(slide_file, output_dir, level=0, tile_size=tile_size)
+        slide_dirs.append(out)
+    return slide_dirs
+
+
+def main(
+    slide_files: Sequence[str],
+    output_dir: str,
+    *,
+    encoder: Optional[VisionTransformer] = None,
+    tile_size: int = 256,
+    tile_epochs: int = 100,
+    slide_epochs: int = 50,
+    batch_size: int = 64,
+):
+    """Full two-stage orchestration (reference ``main:506-537``)."""
+    slide_dirs = preprocess_slides(slide_files, output_dir, tile_size)
+    image_paths = [p for d in slide_dirs for p in collect_image_paths(d)]
+    encoder = encoder or VisionTransformer(dtype=jnp.bfloat16)
+    best_tile = pretrain_tile_encoder(
+        image_paths,
+        os.path.join(output_dir, "tile_pretrain"),
+        encoder=encoder,
+        batch_size=batch_size,
+        num_epochs=tile_epochs,
+    )
+    tile_state = restore_checkpoint(best_tile)
+    tile_params = tile_state["params"]["encoder"]
+    best_slide = pretrain_slide_encoder(
+        encoder,
+        tile_params,
+        slide_dirs,
+        os.path.join(output_dir, "slide_pretrain"),
+        num_epochs=slide_epochs,
+    )
+    return best_tile, best_slide

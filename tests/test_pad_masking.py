@@ -1,0 +1,137 @@
+"""Suffix-padding correctness: padded+masked forward == unpadded forward.
+
+The property the reference never guarantees (its live path drops the pad
+mask, SURVEY §2.7) and that bucketed collation makes load-bearing here: for
+every component in the slide path, padding a batch to a larger bucket and
+passing the mask must reproduce the unpadded result exactly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gigapath_tpu.ops.dilated_attention import dilated_attention
+from gigapath_tpu.models import slide_encoder as slide_lib
+from gigapath_tpu.models.classification_head import ClassificationHead
+
+
+def test_dilated_attention_valid_len_matches_unpadded(rng):
+    B, L, H, D = 2, 24, 4, 8
+    pad_to = 32
+    q = jnp.asarray(rng.normal(size=(B, pad_to, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, pad_to, H, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, pad_to, H, D)), jnp.float32)
+
+    out_ref = dilated_attention(
+        q[:, :L], k[:, :L], v[:, :L], [8, 16], [1, 2]
+    )
+    out_masked = dilated_attention(
+        q, k, v, [8, 16], [1, 2], valid_len=jnp.asarray([L, L])
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_ref), np.asarray(out_masked[:, :L]), atol=1e-5
+    )
+
+
+def test_dilated_attention_ragged_batch(rng):
+    """Different valid lengths per row: each row matches its own unpadded run."""
+    B, pad_to, H, D = 2, 32, 4, 8
+    lens = [20, 28]
+    q = jnp.asarray(rng.normal(size=(B, pad_to, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, pad_to, H, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, pad_to, H, D)), jnp.float32)
+    out = dilated_attention(
+        q, k, v, [8, 16], [1, 2], valid_len=jnp.asarray(lens)
+    )
+    for b, n in enumerate(lens):
+        ref = dilated_attention(
+            q[b : b + 1, :n], k[b : b + 1, :n], v[b : b + 1, :n], [8, 16], [1, 2]
+        )
+        np.testing.assert_allclose(
+            np.asarray(ref[0]), np.asarray(out[b, :n]), atol=1e-5
+        )
+
+
+def test_slide_encoder_pad_mask_matches_unpadded(rng):
+    """LongNetViT: bucketed padding + mask == exact-length forward (the
+    finding that motivated this file: without the mask, logits change with
+    the bucket size)."""
+    model = slide_lib.create_model("", "gigapath_slide_enc_tiny", in_chans=16)[0]
+    n, pad_to = 21, 32
+    x_full = np.asarray(rng.normal(size=(1, pad_to, 16)), np.float32)
+    c_full = np.asarray(rng.uniform(0, 25000, (1, pad_to, 2)), np.float32)
+    x_pad, c_pad = x_full.copy(), c_full.copy()
+    x_pad[:, n:] = 0.0
+    c_pad[:, n:] = 0.0
+    mask = np.zeros((1, pad_to), bool)
+    mask[:, :n] = True
+
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.asarray(x_full), jnp.asarray(c_full)
+    )["params"]
+    ref = model.apply(
+        {"params": params}, jnp.asarray(x_full[:, :n]), jnp.asarray(c_full[:, :n])
+    )
+    masked = model.apply(
+        {"params": params},
+        jnp.asarray(x_pad),
+        jnp.asarray(c_pad),
+        pad_mask=jnp.asarray(mask),
+    )
+    np.testing.assert_allclose(
+        np.asarray(ref[0]), np.asarray(masked[0]), atol=2e-4
+    )
+
+
+def test_slide_encoder_global_pool_excludes_pads(rng):
+    model = slide_lib.create_model(
+        "", "gigapath_slide_enc_tiny", in_chans=16, global_pool=True
+    )[0]
+    n, pad_to = 19, 32
+    x = np.asarray(rng.normal(size=(1, pad_to, 16)), np.float32)
+    c = np.asarray(rng.uniform(0, 25000, (1, pad_to, 2)), np.float32)
+    x[:, n:] = 0.0
+    mask = np.zeros((1, pad_to), bool)
+    mask[:, :n] = True
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(x), jnp.asarray(c))["params"]
+    ref = model.apply(
+        {"params": params}, jnp.asarray(x[:, :n]), jnp.asarray(c[:, :n])
+    )
+    masked = model.apply(
+        {"params": params}, jnp.asarray(x), jnp.asarray(c), pad_mask=jnp.asarray(mask)
+    )
+    np.testing.assert_allclose(np.asarray(ref[0]), np.asarray(masked[0]), atol=2e-4)
+
+
+def test_classification_head_logits_invariant_to_bucket(rng):
+    """End-to-end: same slide, two bucket sizes -> identical logits."""
+    model = ClassificationHead(
+        input_dim=16,
+        latent_dim=32,
+        feat_layer="1",
+        n_classes=3,
+        model_arch="gigapath_slide_enc_tiny",
+    )
+    n = 21
+    x = np.asarray(rng.normal(size=(1, n, 16)), np.float32)
+    c = np.asarray(rng.uniform(0, 25000, (1, n, 2)), np.float32)
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(x), jnp.asarray(c))["params"]
+
+    logits_by_bucket = []
+    for pad_to in (32, 64):
+        xp = np.zeros((1, pad_to, 16), np.float32)
+        cp = np.zeros((1, pad_to, 2), np.float32)
+        xp[:, :n], cp[:, :n] = x, c
+        mask = np.zeros((1, pad_to), bool)
+        mask[:, :n] = True
+        logits_by_bucket.append(
+            np.asarray(
+                model.apply(
+                    {"params": params},
+                    jnp.asarray(xp),
+                    jnp.asarray(cp),
+                    pad_mask=jnp.asarray(mask),
+                )
+            )
+        )
+    np.testing.assert_allclose(logits_by_bucket[0], logits_by_bucket[1], atol=2e-4)

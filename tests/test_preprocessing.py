@@ -1,0 +1,204 @@
+"""WSI preprocessing: Otsu, ROI, tiling pipeline, ledgers, resume, merge.
+
+Synthetic-slide end-to-end tests (the reference has none): a white slide
+with a dark tissue blob -> ROI crop covers the blob, PNG tiles +
+``dataset.csv`` ledger written, failed_tiles.csv empty, resume skips
+re-processing, merged csv aggregates slides.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+from PIL import Image
+
+from gigapath_tpu.preprocessing.create_tiles_dataset import (
+    check_empty_tiles,
+    generate_tiles,
+    get_tile_descriptor,
+    get_tile_id,
+    is_already_processed,
+    main as preprocess_main,
+    merge_dataset_csv_files,
+    process_slide,
+    select_tiles,
+)
+from gigapath_tpu.preprocessing.foreground_segmentation import (
+    ImageSlideReader,
+    LoadROId,
+    otsu_threshold,
+    segment_foreground,
+)
+
+
+def _synthetic_slide(size=256, blob=None, seed=0):
+    """White background + dark noisy tissue blob, HWC uint8."""
+    rng = np.random.default_rng(seed)
+    arr = np.full((size, size, 3), 245, np.uint8)
+    if blob is None:
+        blob = ((size // 4, 3 * size // 4), (3 * size // 8, 7 * size // 8))
+    (y0, y1), (x0, x1) = blob
+    arr[y0:y1, x0:x1] = rng.integers(30, 120, (y1 - y0, x1 - x0, 3))
+    return arr
+
+
+class TestSegmentation:
+    def test_otsu_separates_bimodal(self, rng):
+        values = np.concatenate([rng.normal(40, 5, 500), rng.normal(220, 5, 500)])
+        th = otsu_threshold(values)
+        assert 60 < th < 200
+
+    def test_foreground_is_dark_tissue(self):
+        arr = _synthetic_slide()
+        chw = np.moveaxis(arr, -1, 0)
+        mask, th = segment_foreground(chw)
+        assert mask.shape == chw.shape[1:]
+        assert mask[128, 128]  # inside blob
+        assert not mask[10, 10]  # background
+        # fixed threshold respected
+        mask2, th2 = segment_foreground(chw, threshold=150.0)
+        assert th2 == 150.0
+
+    def test_image_slide_reader_pyramid(self):
+        arr = _synthetic_slide(128)
+        reader = ImageSlideReader(arr, n_levels=3)
+        assert reader.level_count == 3
+        assert reader.level_dimensions[0] == (128, 128)
+        assert reader.level_dimensions[2] == (32, 32)
+        region = reader.read_region((8, 16), 0, (32, 32))
+        np.testing.assert_array_equal(
+            region, np.moveaxis(arr[8:40, 16:48], -1, 0)
+        )
+
+    def test_load_roid_crops_to_blob(self, tmp_path):
+        arr = _synthetic_slide()
+        path = tmp_path / "slide.png"
+        Image.fromarray(arr).save(path)
+        loader = LoadROId(level=0, margin=0)
+        out = loader({"image": str(path), "slide_id": "s1"})
+        img = out["image"]
+        # ROI is roughly blob-sized (pyramid rounding allows slack)
+        assert img.shape[0] == 3
+        assert img.shape[1] <= 160 and img.shape[2] <= 160
+        assert out["scale"] == 1.0
+        y, x = out["origin"]
+        assert 48 <= y <= 72 and 80 <= x <= 104
+
+
+class TestTileSelection:
+    def test_select_tiles_threshold(self):
+        mask = np.zeros((4, 8, 8), bool)
+        mask[0] = True  # fully occupied
+        mask[1, :4] = True  # half
+        selected, occ = select_tiles(mask, 0.4)
+        np.testing.assert_array_equal(selected, [True, True, False, False])
+        assert occ[0] == 1.0
+
+    def test_select_tiles_invalid_threshold(self):
+        with pytest.raises(ValueError):
+            select_tiles(np.zeros((1, 2, 2), bool), 1.5)
+
+    def test_descriptors(self):
+        assert get_tile_descriptor((123, 456)) == "00123x_00456y"
+        assert get_tile_id("s1", (1, 2)) == "s1.00001x_00002y"
+
+    def test_check_empty_tiles(self, rng):
+        tiles = rng.integers(0, 255, (3, 3, 16, 16)).astype(np.float32)
+        tiles[1] = 128.0  # zero variance
+        tiles[2] = 0.0  # extreme values
+        empty = check_empty_tiles(tiles)
+        np.testing.assert_array_equal(empty, [False, True, True])
+
+    def test_generate_tiles_discards_background(self):
+        arr = _synthetic_slide(128, blob=((0, 64), (0, 64)))
+        chw = np.moveaxis(arr, -1, 0)
+        tiles, locations, occ, n_discarded = generate_tiles(
+            chw, tile_size=64, foreground_threshold=150.0, occupancy_threshold=0.5
+        )
+        assert tiles.shape[0] == 1  # only the blob tile survives
+        np.testing.assert_array_equal(locations[0], [0, 0])
+        assert n_discarded == 3
+
+
+class TestProcessSlide:
+    def _sample(self, tmp_path, slide_id="slide_a", seed=0):
+        arr = _synthetic_slide(256, seed=seed)
+        path = tmp_path / f"{slide_id}.png"
+        Image.fromarray(arr).save(path)
+        return {
+            "slide_id": slide_id,
+            "image": str(path),
+            "label": 1,
+            "metadata": {"provider": "synthetic"},
+        }
+
+    def test_end_to_end_single_slide(self, tmp_path):
+        sample = self._sample(tmp_path)
+        out_dir = tmp_path / "out"
+        tiles_dir = process_slide(
+            sample,
+            level=0,
+            margin=0,
+            tile_size=64,
+            foreground_threshold=None,
+            occupancy_threshold=0.1,
+            output_dir=out_dir,
+            thumbnail_dir=out_dir / "thumbnails",
+        )
+        df = pd.read_csv(tiles_dir / "dataset.csv")
+        assert len(df) > 0
+        assert set(df.columns) >= {
+            "slide_id", "tile_id", "image", "tile_x", "tile_y", "occupancy",
+            "slide_provider",
+        }
+        # the reference pipeline's invariant (pipeline.py:96-101):
+        # dataset non-empty, failed_tiles empty
+        failed = pd.read_csv(tiles_dir / "failed_tiles.csv")
+        assert len(failed) == 0
+        # every listed PNG exists and parses back to its coordinates
+        from gigapath_tpu.data.tile_dataset import parse_tile_coords
+
+        for _, row in df.iterrows():
+            p = out_dir / row["image"]
+            assert p.exists()
+            x, y = parse_tile_coords(str(p))
+            assert x == row["tile_x"] and y == row["tile_y"]
+        # thumbnails + overlay written
+        assert (out_dir / "thumbnails" / "slide_a.png_original.png").exists()
+        assert (out_dir / "thumbnails" / "slide_a.png_roi_tiles.png").exists()
+
+    def test_resume_skips_processed(self, tmp_path):
+        sample = self._sample(tmp_path)
+        out_dir = tmp_path / "out"
+        kwargs = dict(
+            level=0, margin=0, tile_size=64, foreground_threshold=None,
+            occupancy_threshold=0.1, output_dir=out_dir,
+            thumbnail_dir=out_dir / "thumbnails",
+        )
+        tiles_dir = process_slide(sample, **kwargs)
+        assert is_already_processed(tiles_dir)
+        mtime = (tiles_dir / "dataset.csv").stat().st_mtime_ns
+        process_slide(sample, **kwargs)  # resume: no rewrite
+        assert (tiles_dir / "dataset.csv").stat().st_mtime_ns == mtime
+
+    def test_main_merges_csvs(self, tmp_path):
+        samples = [
+            self._sample(tmp_path, "slide_a", 0),
+            self._sample(tmp_path, "slide_b", 1),
+        ]
+        out_dir = tmp_path / "dataset"
+        preprocess_main(
+            samples,
+            out_dir,
+            level=0,
+            tile_size=64,
+            margin=0,
+            foreground_threshold=None,
+            occupancy_threshold=0.1,
+        )
+        merged = pd.read_csv(out_dir / "dataset.csv")
+        assert set(merged["slide_id"]) == {"slide_a", "slide_b"}
+        per_slide = [
+            len(pd.read_csv(out_dir / s / "dataset.csv"))
+            for s in ("slide_a", "slide_b")
+        ]
+        assert len(merged) == sum(per_slide)
