@@ -29,6 +29,8 @@ N = 10240
 
 
 def main():
+    import jax
+
     from gigapath_tpu.models import slide_encoder
     from gigapath_tpu.utils.timing import chained_seconds_per_iter
 
@@ -48,6 +50,21 @@ def main():
     sec_per_iter, overhead = chained_seconds_per_iter(step, x, args=(params, coords))
     tokens_per_sec = N / sec_per_iter
 
+    # train-step variant (fwd+bwd, the reference's actual hot loop —
+    # finetune/training.py:223-282): grad of a scalar readout wrt params
+    def train_step(x, params, coords):
+        def loss_fn(p):
+            return model.apply({"params": p}, x, coords)[0].astype(jnp.float32).var()
+
+        grads = jax.grad(loss_fn)(params)
+        leaf = jax.tree.leaves(grads)[0]
+        return x + (leaf.sum().astype(jnp.float32) * 1e-30).astype(x.dtype)
+
+    sec_train, _ = chained_seconds_per_iter(
+        train_step, x, args=(params, coords), iters_low=2, iters_high=8
+    )
+    train_tokens_per_sec = N / sec_train
+
     print(
         json.dumps(
             {
@@ -55,6 +72,7 @@ def main():
                 "value": round(tokens_per_sec, 1),
                 "unit": "tokens/s",
                 "vs_baseline": round(tokens_per_sec / A100_REF_TOKENS_PER_SEC, 3),
+                "train_tokens_per_sec": round(train_tokens_per_sec, 1),
             }
         )
     )

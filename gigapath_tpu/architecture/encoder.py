@@ -211,8 +211,10 @@ class Encoder(nn.Module):
                 name="embed_tokens",
             )(src_tokens)
 
-        if encoder_padding_mask is None:
-            encoder_padding_mask = jnp.zeros(token_embeddings.shape[:2], bool)
+        # encoder_padding_mask stays None when absent (the reference
+        # materializes a zeros mask; a traced all-False mask would push
+        # DilatedAttention off the static Pallas path for every unmasked
+        # call, so None is load-bearing here)
 
         embed_scale = 1.0 if args.no_scale_embedding else math.sqrt(args.encoder_embed_dim)
         x = embed = embed_scale * token_embeddings
@@ -230,7 +232,8 @@ class Encoder(nn.Module):
                 dtype=self.dtype,
             )(x, split_position=multiway_split_position)
         x = nn.Dropout(args.dropout)(x, deterministic=deterministic)
-        x = x * (1 - encoder_padding_mask[..., None].astype(x.dtype))
+        if encoder_padding_mask is not None:
+            x = jnp.where(encoder_padding_mask[..., None], 0, x)
 
         rel_pos_bias = None
         if args.rel_pos_buckets > 0 and args.max_rel_pos > 0:

@@ -47,16 +47,7 @@ def count_model_statistics(model, params) -> Dict[str, Any]:
     return {"total_params": total, "params_by_module": by_top}
 
 
-def compiled_flops(fn, *args) -> Optional[float]:
-    """FLOPs of the compiled computation (replaces thop,
-    ``finetune/training.py:14,53``)."""
-    try:
-        analysis = jax.jit(fn).lower(*args).compile().cost_analysis()
-        if isinstance(analysis, list):
-            analysis = analysis[0]
-        return float(analysis.get("flops", float("nan")))
-    except Exception:
-        return None
+from gigapath_tpu.utils.profiling import compiled_flops  # noqa: F401  (re-export)
 
 
 def _batch_to_device(batch):

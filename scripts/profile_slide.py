@@ -26,8 +26,10 @@ SEGS = [1024, 5792, 32768, 185363, 1048576]
 RATIOS = [1, 2, 4, 8, 16]
 
 
-def timeit(name, step, x0, args=()):
-    sec, _ = chained_seconds_per_iter(step, x0, args=args)
+def timeit(name, step, x0, args=(), lo=4, hi=24):
+    sec, _ = chained_seconds_per_iter(
+        step, x0, args=args, iters_low=lo, iters_high=hi, repeats=3
+    )
     print(f"{name:40s} {sec*1e3:9.3f} ms")
     return sec
 

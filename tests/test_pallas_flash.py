@@ -89,6 +89,41 @@ def test_kv_len_ragged_masking(rng, lens):
         )
 
 
+def test_kv_len_masks_large_real_keys(rng):
+    """Masked key slots holding LARGE real activations (alignment padding
+    becomes nonzero after residual layers) must not perturb outputs, lse, or
+    gradients — a post-softmax zero-multiply would let them dominate the
+    running max (underflowing valid rows) and produce inf*0 NaNs in the
+    backward. Regression for the column-bias masking."""
+    B, L, H, D = 1, 64, 2, 16
+    n_valid = 40
+    kv = np.full((B, H), n_valid, np.int32)
+    q = jnp.asarray(rng.normal(size=(B, L, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, L, H, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, L, H, D)), jnp.float32)
+    # masked tail keys are huge -> logits ~ +-40*|q| >> valid logits
+    k = k.at[:, n_valid:].set(40.0)
+    v = v.at[:, n_valid:].set(40.0)
+
+    out_p, lse_p = flash(q, k, v, kv_len=kv)
+    ref, lse_ref = attention_with_lse(
+        q, k[:, :n_valid], v[:, :n_valid]
+    )
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(ref), atol=2e-5, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(lse_p), np.asarray(lse_ref), atol=2e-4, rtol=1e-4)
+
+    def loss_p(q, k, v):
+        o, _ = flash(q, k, v, kv_len=kv)
+        return (o * o).sum()
+
+    grads = jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v)
+    for g, name in zip(grads, "qkv"):
+        assert np.isfinite(np.asarray(g)).all(), f"d{name} has NaN/inf"
+    # masked key/value slots receive zero gradient
+    np.testing.assert_allclose(np.asarray(grads[1][:, n_valid:]), 0.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(grads[2][:, n_valid:]), 0.0, atol=1e-6)
+
+
 def test_unaligned_lengths(rng):
     """L not a multiple of the block size: padded keys must be masked."""
     q, k, v = (jnp.asarray(rng.normal(size=(1, 333, 2, 48)), jnp.float32) for _ in range(3))

@@ -1,0 +1,50 @@
+"""Profiling hooks: trace capture, MoE telemetry extraction, cost analysis."""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gigapath_tpu.utils.profiling import (
+    annotate,
+    collect_moe_metadata,
+    compiled_flops,
+    compiled_memory,
+    trace,
+)
+
+
+def test_trace_writes_artifacts(tmp_path):
+    with trace(str(tmp_path)):
+        with annotate("matmul"):
+            x = jnp.ones((64, 64))
+            (x @ x).block_until_ready()
+    # jax writes plugin event files under the log dir
+    files = glob.glob(os.path.join(str(tmp_path), "**", "*"), recursive=True)
+    assert any("trace" in f or "xplane" in f for f in files)
+
+
+def test_collect_moe_metadata(rng):
+    from gigapath_tpu.ops.moe.moe_layer import MOELayer
+
+    layer = MOELayer(embed_dim=16, ffn_dim=32, num_experts=4, top1=True)
+    x = jnp.asarray(rng.normal(size=(1, 8, 16)), jnp.float32)
+    params = layer.init(jax.random.PRNGKey(0), x)["params"]
+    _, mods = layer.apply({"params": params}, x, mutable=["intermediates"])
+    meta = collect_moe_metadata(mods["intermediates"])
+    assert any(k.endswith("entropy_gating") for k in meta)
+    assert any("unused_expert1_count" in k for k in meta)
+    assert all(np.isfinite(v) for v in meta.values())
+
+
+def test_cost_analysis():
+    def fn(x):
+        return (x @ x).sum()
+
+    x = jnp.ones((32, 32))
+    flops = compiled_flops(fn, x)
+    assert flops is None or flops > 0
+    mem = compiled_memory(fn, x)
+    assert mem is None or "argument_bytes" in mem
