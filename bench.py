@@ -57,8 +57,10 @@ def main():
             return model.apply({"params": p}, x, coords)[0].astype(jnp.float32).var()
 
         grads = jax.grad(loss_fn)(params)
-        leaf = jax.tree.leaves(grads)[0]
-        return x + (leaf.sum().astype(jnp.float32) * 1e-30).astype(x.dtype)
+        # depend on EVERY grad leaf — depending on one would let XLA DCE all
+        # other weight-gradient matmuls and overstate the throughput
+        total = sum(g.sum().astype(jnp.float32) for g in jax.tree.leaves(grads))
+        return x + (total * 1e-30).astype(x.dtype)
 
     sec_train, _ = chained_seconds_per_iter(
         train_step, x, args=(params, coords), iters_low=2, iters_high=8

@@ -1,0 +1,25 @@
+"""Demo: export a region of a slide at every pyramid level
+(reference ``demo/ndpi_extractor.py``: per-level region export)."""
+
+import os
+import sys
+
+import numpy as np
+from PIL import Image
+
+from gigapath_tpu.preprocessing.foreground_segmentation import open_slide
+
+if __name__ == "__main__":
+    slide_path = sys.argv[1] if len(sys.argv) > 1 else "sample_data/slide.png"
+    out_dir = sys.argv[2] if len(sys.argv) > 2 else "outputs/regions"
+    y, x = (int(a) for a in sys.argv[3:5]) if len(sys.argv) > 4 else (0, 0)
+    size = int(sys.argv[5]) if len(sys.argv) > 5 else 256
+
+    os.makedirs(out_dir, exist_ok=True)
+    reader = open_slide(slide_path)
+    for level in range(reader.level_count):
+        region = reader.read_region((y, x), level, (size, size))
+        out = os.path.join(out_dir, f"level_{level}.png")
+        Image.fromarray(np.moveaxis(region, 0, -1)).save(out)
+        print(f"level {level} (downsample {reader.level_downsamples[level]}): {out}")
+    reader.close()

@@ -1,0 +1,32 @@
+"""Demo: render a slide thumbnail to a PNG (reference ``demo/show_slide.py``,
+sans interactive window — headless image save)."""
+
+import sys
+
+import numpy as np
+
+from gigapath_tpu.preprocessing.foreground_segmentation import open_slide
+
+if __name__ == "__main__":
+    slide_path = sys.argv[1] if len(sys.argv) > 1 else "sample_data/slide.png"
+    out_path = sys.argv[2] if len(sys.argv) > 2 else "outputs/slide_view.png"
+
+    reader = open_slide(slide_path)
+    print("levels:", reader.level_count)
+    print("dimensions per level:", reader.level_dimensions)
+    arr = reader.read_level(reader.level_count - 1)
+
+    import os
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    plt.figure(figsize=(8, 8))
+    plt.imshow(np.moveaxis(arr, 0, -1))
+    plt.axis("off")
+    plt.savefig(out_path, bbox_inches="tight")
+    print("saved", out_path)
+    reader.close()

@@ -8,11 +8,9 @@ CPU work feeding ``jax.device_put``; kept torch-free.
 
 from __future__ import annotations
 
-from typing import Sequence
-
 import numpy as np
 
-from gigapath_tpu.models.tile_encoder import IMAGENET_MEAN, IMAGENET_STD
+from gigapath_tpu.models.tile_encoder import IMAGENET_MEAN, IMAGENET_STD  # noqa: F401  (public constants)
 
 
 def resize_shorter_side(img, size: int = 256):
@@ -36,25 +34,23 @@ def center_crop(arr: np.ndarray, size: int = 224) -> np.ndarray:
     return arr[top : top + size, left : left + size]
 
 
-def normalize(
-    arr: np.ndarray,
-    mean: Sequence[float] = IMAGENET_MEAN,
-    std: Sequence[float] = IMAGENET_STD,
-) -> np.ndarray:
-    return (arr - np.asarray(mean, np.float32)) / np.asarray(std, np.float32)
-
-
 def preprocess_tile(img, crop_size: int = 224) -> np.ndarray:
     """PIL image (or uint8 [H, W, 3] array) -> float32 [crop, crop, 3], the
     tile encoder's expected NHWC input (channels-last; the reference feeds
     torch NCHW, same values). The resize keeps the reference's 256/224
-    ratio for non-default crop sizes (small test encoders)."""
+    ratio for non-default crop sizes (small test encoders).
+
+    The scale+normalize hot loop runs through the native C++ kernel when
+    built (:mod:`gigapath_tpu.native`); the numpy path computes the same
+    affine."""
     from PIL import Image
 
     if isinstance(img, np.ndarray):
         img = Image.fromarray(img)
     img = img.convert("RGB")
     img = resize_shorter_side(img, round(crop_size * 256 / 224))
-    arr = np.asarray(img, np.float32) / 255.0
-    arr = center_crop(arr, crop_size)
-    return normalize(arr).astype(np.float32)
+    arr = center_crop(np.asarray(img, np.uint8), crop_size)
+
+    from gigapath_tpu import native
+
+    return native.normalize_tiles(arr)

@@ -1,0 +1,144 @@
+"""Native (C++) host-runtime kernels with build-on-demand + numpy fallback.
+
+The reference's performance-critical code is all external native binaries
+(SURVEY §2.9); the TPU compute path here is Pallas/XLA, and this package is
+the native piece of the *host* runtime: tile normalization, occupancy
+filtering, ragged-batch padding. The shared library compiles once from
+``tile_ops.cpp`` with the system ``g++`` into a per-user cache and binds via
+ctypes — no pybind11 required. Every entry point has an exact numpy
+fallback, so the package degrades gracefully where no toolchain exists.
+
+>>> from gigapath_tpu import native
+>>> native.available()          # True when the .so built
+>>> native.normalize_tiles(u8_batch)   # fast path or numpy, same results
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional, Sequence
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "tile_ops.cpp")
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+IMAGENET_MEAN = np.asarray([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.asarray([0.229, 0.224, 0.225], np.float32)
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    """Compile tile_ops.cpp once (content-hashed cache) and dlopen it."""
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    try:
+        with open(_SRC, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        cache_dir = os.path.join(
+            os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+            "gigapath_tpu",
+        )
+        os.makedirs(cache_dir, exist_ok=True)
+        so_path = os.path.join(cache_dir, f"tile_ops_{digest}.so")
+        if not os.path.exists(so_path):
+            with tempfile.NamedTemporaryFile(
+                suffix=".so", dir=cache_dir, delete=False
+            ) as tmp:
+                tmp_path = tmp.name
+            cmd = [
+                "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                _SRC, "-o", tmp_path,
+            ]
+            subprocess.run(cmd, check=True, capture_output=True)
+            os.replace(tmp_path, so_path)
+        lib = ctypes.CDLL(so_path)
+        lib.normalize_tiles.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+        ]
+        lib.luminance_occupancy.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_float, ctypes.c_void_p,
+        ]
+        lib.pad_sequences.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+        ]
+        _lib = lib
+    except Exception as e:  # toolchain absent / compile error -> numpy path
+        print(f"gigapath_tpu.native: falling back to numpy ({e})")
+        _build_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return _build() is not None
+
+
+def normalize_tiles(
+    batch_u8: np.ndarray,
+    mean: Sequence[float] = IMAGENET_MEAN,
+    std: Sequence[float] = IMAGENET_STD,
+) -> np.ndarray:
+    """uint8 [..., H, W, C] -> float32 ``(x/255 - mean) / std``."""
+    batch_u8 = np.ascontiguousarray(batch_u8, np.uint8)
+    c = batch_u8.shape[-1]
+    mean = np.ascontiguousarray(mean, np.float32)
+    std = np.ascontiguousarray(std, np.float32)
+    lib = _build()
+    if lib is None:
+        return ((batch_u8.astype(np.float32) / 255.0) - mean) / std
+    out = np.empty(batch_u8.shape, np.float32)
+    lib.normalize_tiles(
+        batch_u8.ctypes.data, out.ctypes.data,
+        batch_u8.size // c, mean.ctypes.data, std.ctypes.data, c,
+    )
+    return out
+
+
+def luminance_occupancy(
+    tiles_u8: np.ndarray, threshold: float
+) -> np.ndarray:
+    """NCHW uint8 tiles -> per-tile fraction of pixels with mean-channel
+    luminance below ``threshold`` (== ``segment_foreground`` +
+    ``select_tiles`` occupancy, computed in one pass)."""
+    tiles_u8 = np.ascontiguousarray(tiles_u8, np.uint8)
+    n, c, h, w = tiles_u8.shape
+    lib = _build()
+    if lib is None:
+        lum = tiles_u8.mean(axis=1)
+        return (lum < threshold).mean(axis=(-2, -1)).astype(np.float32)
+    out = np.empty(n, np.float32)
+    lib.luminance_occupancy(
+        tiles_u8.ctypes.data, n, c, h, w, ctypes.c_float(threshold),
+        out.ctypes.data,
+    )
+    return out
+
+
+def pad_sequences(seqs: Sequence[np.ndarray], max_len: int) -> np.ndarray:
+    """List of float32 [len_i, dim] -> zero-padded [n, max_len, dim]."""
+    n = len(seqs)
+    dim = seqs[0].shape[1]
+    lib = _build()
+    if lib is None:
+        out = np.zeros((n, max_len, dim), np.float32)
+        for i, s in enumerate(seqs):
+            rows = min(len(s), max_len)
+            out[i, :rows] = s[:rows]
+        return out
+    flat = np.ascontiguousarray(np.concatenate(seqs, axis=0), np.float32)
+    lengths = np.asarray([len(s) for s in seqs], np.int64)
+    offsets = np.concatenate([[0], np.cumsum(lengths)[:-1]]).astype(np.int64)
+    out = np.empty((n, max_len, dim), np.float32)
+    lib.pad_sequences(
+        flat.ctypes.data, offsets.ctypes.data, lengths.ctypes.data,
+        n, max_len, dim, out.ctypes.data,
+    )
+    return out

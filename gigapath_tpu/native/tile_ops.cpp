@@ -1,0 +1,80 @@
+// Native host-side kernels for the tile ingestion path.
+//
+// The reference leans on external native code for every hot loop (SURVEY
+// §2.9: flash-attn/xformers CUDA for attention, openslide C for WSI IO).
+// The TPU compute path is Pallas/XLA; this file is the native piece of the
+// *host* runtime: the per-tile preprocessing loops that feed the device.
+// Exposed via ctypes (gigapath_tpu/native/__init__.py) with numpy fallbacks.
+//
+// Build: g++ -O3 -shared -fPIC -std=c++17 (see _build() in __init__.py).
+
+#include <cstdint>
+#include <cstddef>
+
+extern "C" {
+
+// uint8 NHWC tiles -> float32 normalized (value/255 - mean) / std.
+// The transform hot loop of gigapath/pipeline.py:106-115 (resize/crop stay
+// in PIL; the scale+normalize is the O(N*H*W*C) part).
+void normalize_tiles(const uint8_t* in, float* out, int64_t n_pixels,
+                     const float* mean, const float* std_, int channels) {
+  // precompute per-channel affine: out = px * a[c] + b[c]
+  float a[8];
+  float b[8];
+  for (int c = 0; c < channels && c < 8; ++c) {
+    a[c] = 1.0f / (255.0f * std_[c]);
+    b[c] = -mean[c] / std_[c];
+  }
+  for (int64_t i = 0; i < n_pixels; ++i) {
+    const uint8_t* px = in + i * channels;
+    float* o = out + i * channels;
+    for (int c = 0; c < channels; ++c) {
+      o[c] = static_cast<float>(px[c]) * a[c] + b[c];
+    }
+  }
+}
+
+// Per-tile foreground occupancy from NCHW uint8 tiles: fraction of pixels
+// whose mean-channel luminance is below `threshold` (the
+// segment_foreground + select_tiles hot loop,
+// gigapath_tpu/preprocessing/create_tiles_dataset.py).
+void luminance_occupancy(const uint8_t* tiles, int64_t n, int64_t c,
+                         int64_t h, int64_t w, float threshold,
+                         float* occupancy) {
+  const int64_t plane = h * w;
+  for (int64_t t = 0; t < n; ++t) {
+    const uint8_t* tile = tiles + t * c * plane;
+    int64_t count = 0;
+    for (int64_t p = 0; p < plane; ++p) {
+      int32_t sum = 0;
+      for (int64_t ch = 0; ch < c; ++ch) {
+        sum += tile[ch * plane + p];
+      }
+      if (static_cast<float>(sum) < threshold * static_cast<float>(c)) {
+        ++count;
+      }
+    }
+    occupancy[t] = static_cast<float>(count) / static_cast<float>(plane);
+  }
+}
+
+// Pad a ragged [len, dim] float32 sequence list into one [n, max_len, dim]
+// zero-padded batch (the collate hot loop, data/collate.py:pad_tensors).
+// `offsets[i]` is the row offset of sequence i in `in`; lengths[i] its rows.
+void pad_sequences(const float* in, const int64_t* offsets,
+                   const int64_t* lengths, int64_t n, int64_t max_len,
+                   int64_t dim, float* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    const float* src = in + offsets[i] * dim;
+    float* dst = out + i * max_len * dim;
+    const int64_t rows = lengths[i] < max_len ? lengths[i] : max_len;
+    for (int64_t r = 0; r < rows * dim; ++r) {
+      dst[r] = src[r];
+    }
+    for (int64_t r = rows * dim; r < max_len * dim; ++r) {
+      dst[r] = 0.0f;
+    }
+  }
+}
+
+}  // extern "C"

@@ -88,6 +88,9 @@ def generate_tiles(
     logging.info(f"Tiled {slide_image.shape} to {image_tiles.shape}")
     foreground_mask, _ = segment_foreground(image_tiles, foreground_threshold)
     selected, occupancies = select_tiles(foreground_mask, occupancy_threshold)
+    # select_tiles squeezes to scalars for a single-tile slide
+    selected = np.atleast_1d(selected)
+    occupancies = np.atleast_1d(occupancies)
     n_discarded = int((~selected).sum())
     logging.info(f"Percentage tiles discarded: {n_discarded / len(selected) * 100:.2f}")
 

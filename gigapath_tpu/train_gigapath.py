@@ -1,0 +1,213 @@
+"""End-to-end training driver over raw slides (replication additions).
+
+Parity with reference ``docker/workspace/prov-gigapath/train_gigapath.py``:
+rename raw slide files, tile them (skip-if-processed), extract tile + slide
+features to per-slide ``*_features.pt``-style caches (orbax dirs here,
+skip-if-cached, ``extract_features:72,128-131``), then train a
+ClassificationHead on the cached slide embeddings with optional frozen
+encoder (``train_model:205``); ``create_dummy_labels`` scaffolding
+(``:356``) mirrors ``create_labels.py``.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def rename_slide_files(data_dir: str, ext: str = ".ndpi") -> List[str]:
+    """Strip query-string suffixes from downloaded slide filenames
+    (reference ``rename_ndpi_files:24``)."""
+    renamed = []
+    for name in sorted(os.listdir(data_dir)):
+        if "?" in name:
+            clean = name.split("?")[0]
+            os.rename(os.path.join(data_dir, name), os.path.join(data_dir, clean))
+            name = clean
+        if name.endswith(ext) or name.endswith(".png"):
+            renamed.append(os.path.join(data_dir, name))
+    return renamed
+
+
+def extract_features(
+    slide_files: Sequence[str],
+    output_dir: str,
+    *,
+    tile_encoder=None,
+    tile_params=None,
+    batch_size: int = 128,
+    tile_size: int = 256,
+) -> List[str]:
+    """Tile + encode each slide into ``<slide>_features`` caches, skipping
+    existing ones (reference ``extract_features:72`` + ``:128-131``)."""
+    from gigapath_tpu.pipeline import (
+        run_inference_with_tile_encoder,
+        tile_one_slide,
+    )
+    from gigapath_tpu.utils.checkpoint import checkpoint_exists, save_checkpoint
+
+    if tile_encoder is None:
+        from gigapath_tpu.models.tile_encoder import create_tile_encoder, init_params
+
+        tile_encoder, tile_params = create_tile_encoder(dtype=jnp.bfloat16)
+
+    os.makedirs(output_dir, exist_ok=True)
+    feature_paths = []
+    for slide_file in slide_files:
+        slide_id = os.path.splitext(os.path.basename(slide_file))[0]
+        out_path = os.path.join(output_dir, f"{slide_id}_features")
+        feature_paths.append(out_path)
+        if checkpoint_exists(out_path):
+            print(f"Skipping {slide_id} - features cached")
+            continue
+        slide_dir = tile_one_slide(
+            slide_file, os.path.join(output_dir, "tiles"), tile_size=tile_size
+        )
+        tile_paths = sorted(glob.glob(os.path.join(str(slide_dir), "*.png")))
+        out = run_inference_with_tile_encoder(
+            tile_paths, tile_encoder, tile_params, batch_size=batch_size
+        )
+        save_checkpoint(
+            out_path, {"features": out["tile_embeds"], "coords": out["coords"]}
+        )
+    return feature_paths
+
+
+def create_dummy_labels(
+    feature_dir: str, output_file: str, num_classes: int = 2
+) -> str:
+    """Random labels for cached slides (reference ``create_dummy_labels:356``
+    / ``create_labels.py:10``)."""
+    import pandas as pd
+
+    slide_ids = [
+        os.path.basename(p).replace("_features", "")
+        for p in sorted(glob.glob(os.path.join(feature_dir, "*_features")))
+    ]
+    rng = np.random.default_rng(42)
+    labels = rng.integers(0, num_classes, size=len(slide_ids))
+    df = pd.DataFrame({"slide_id": slide_ids, "label": labels})
+    os.makedirs(os.path.dirname(output_file) or ".", exist_ok=True)
+    df.to_csv(output_file, index=False)
+    print(f"Created labels file: {output_file}")
+    print(f"Label distribution: {df['label'].value_counts().to_dict()}")
+    return output_file
+
+
+def train_model(
+    feature_dir: str,
+    labels_file: str,
+    output_dir: str,
+    *,
+    num_epochs: int = 50,
+    learning_rate: float = 1e-4,
+    freeze_pretrained: bool = True,
+    model_arch: str = "gigapath_slide_enc12l768d",
+    latent_dim: int = 768,
+    feat_layer: str = "11",
+    seed: int = 0,
+) -> dict:
+    """Train a ClassificationHead on cached slide features
+    (reference ``train_model:205``)."""
+    import optax
+    import pandas as pd
+
+    from gigapath_tpu.models.classification_head import get_model
+    from gigapath_tpu.utils.checkpoint import restore_checkpoint, save_checkpoint
+
+    labels_df = pd.read_csv(labels_file).set_index("slide_id")
+    feats, coords, labels = [], [], []
+    for path in sorted(glob.glob(os.path.join(feature_dir, "*_features"))):
+        slide_id = os.path.basename(path).replace("_features", "")
+        if slide_id not in labels_df.index:
+            continue
+        state = restore_checkpoint(path)
+        feats.append(np.asarray(state["features"], np.float32))
+        coords.append(np.asarray(state["coords"], np.float32))
+        labels.append(int(labels_df.loc[slide_id, "label"]))
+    assert feats, f"no cached features matched {labels_file}"
+    n_classes = int(max(labels)) + 1
+    input_dim = feats[0].shape[-1]
+
+    model, params = get_model(
+        input_dim=input_dim,
+        latent_dim=latent_dim,
+        feat_layer=feat_layer,
+        n_classes=n_classes,
+        model_arch=model_arch,
+        freeze=freeze_pretrained,
+        dtype=jnp.bfloat16,
+    )
+    from gigapath_tpu.models.classification_head import frozen_param_labels
+
+    if freeze_pretrained:
+        tx = optax.multi_transform(
+            {"frozen": optax.set_to_zero(), "trainable": optax.adamw(learning_rate)},
+            frozen_param_labels(params),
+        )
+    else:
+        tx = optax.adamw(learning_rate)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, x, c, y):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, x, c, deterministic=False,
+                                 rngs={"dropout": jax.random.PRNGKey(0)})
+            return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    os.makedirs(output_dir, exist_ok=True)
+    history = []
+    for epoch in range(num_epochs):
+        total = 0.0
+        for x, c, y in zip(feats, coords, labels):
+            params, opt_state, loss = step(
+                params,
+                opt_state,
+                jnp.asarray(x[None]),
+                jnp.asarray(c[None]),
+                jnp.asarray([y]),
+            )
+            total += float(loss)
+        history.append(total / len(feats))
+        print(f"Epoch {epoch + 1}/{num_epochs}, loss {history[-1]:.4f}")
+    save_checkpoint(os.path.join(output_dir, "model"), {"params": jax.device_get(params)})
+    return {"loss_history": history, "n_classes": n_classes}
+
+
+def main(
+    data_dir: str,
+    output_dir: str,
+    *,
+    tile_encoder=None,
+    tile_params=None,
+    num_classes: int = 2,
+    num_epochs: int = 10,
+    **train_kwargs,
+):
+    """Full journey: rename -> tile -> extract -> (dummy) labels -> train
+    (reference ``main:387``)."""
+    slide_files = rename_slide_files(data_dir)
+    feature_dir = os.path.join(output_dir, "features")
+    extract_features(
+        slide_files, feature_dir, tile_encoder=tile_encoder, tile_params=tile_params
+    )
+    labels_file = os.path.join(output_dir, "labels.csv")
+    if not os.path.exists(labels_file):
+        create_dummy_labels(feature_dir, labels_file, num_classes)
+    return train_model(
+        feature_dir,
+        labels_file,
+        os.path.join(output_dir, "model"),
+        num_epochs=num_epochs,
+        **train_kwargs,
+    )

@@ -1,0 +1,69 @@
+"""Native C++ host kernels: build, ctypes binding, exact numpy parity.
+
+The compute path's native story is Pallas (tests/test_pallas_flash.py);
+this covers the host-runtime C++ (gigapath_tpu/native): tile normalization,
+luminance occupancy, ragged padding — each against its numpy reference.
+"""
+
+import numpy as np
+import pytest
+
+from gigapath_tpu import native
+
+
+def test_library_builds():
+    """g++ is baked into this image; the .so must build and load."""
+    assert native.available(), "native tile_ops failed to build"
+
+
+def test_normalize_tiles_matches_numpy(rng):
+    batch = rng.integers(0, 256, (4, 32, 32, 3)).astype(np.uint8)
+    out = native.normalize_tiles(batch)
+    ref = (
+        (batch.astype(np.float32) / 255.0) - native.IMAGENET_MEAN
+    ) / native.IMAGENET_STD
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+    assert out.dtype == np.float32
+
+
+def test_normalize_custom_stats(rng):
+    batch = rng.integers(0, 256, (2, 8, 8, 3)).astype(np.uint8)
+    mean, std = [0.5, 0.5, 0.5], [0.25, 0.25, 0.25]
+    out = native.normalize_tiles(batch, mean, std)
+    ref = ((batch.astype(np.float32) / 255.0) - 0.5) / 0.25
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_luminance_occupancy_matches_numpy(rng):
+    tiles = rng.integers(0, 256, (6, 3, 16, 16)).astype(np.uint8)
+    threshold = 127.5
+    out = native.luminance_occupancy(tiles, threshold)
+    lum = tiles.astype(np.float32).mean(axis=1)
+    ref = (lum < threshold).mean(axis=(-2, -1)).astype(np.float32)
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+def test_pad_sequences_matches_numpy(rng):
+    seqs = [
+        rng.normal(size=(5, 8)).astype(np.float32),
+        rng.normal(size=(9, 8)).astype(np.float32),
+        rng.normal(size=(1, 8)).astype(np.float32),
+    ]
+    out = native.pad_sequences(seqs, max_len=9)
+    assert out.shape == (3, 9, 8)
+    np.testing.assert_array_equal(out[0, :5], seqs[0])
+    np.testing.assert_array_equal(out[0, 5:], 0)
+    np.testing.assert_array_equal(out[1], seqs[1])
+    # truncation beyond max_len
+    out2 = native.pad_sequences(seqs, max_len=4)
+    np.testing.assert_array_equal(out2[1], seqs[1][:4])
+
+
+def test_preprocess_tile_uses_native(rng):
+    """Transform output through the native path equals the pure formula."""
+    from gigapath_tpu.data.transforms import preprocess_tile
+
+    img = rng.integers(0, 256, (64, 64, 3)).astype(np.uint8)
+    out = preprocess_tile(img, crop_size=32)
+    assert out.shape == (32, 32, 3) and out.dtype == np.float32
+    assert np.isfinite(out).all()
