@@ -11,9 +11,17 @@ makes per-call host timing meaningless (see gigapath_tpu/utils/timing.py).
 
 vs_baseline: the reference publishes no numbers (BASELINE.md), so the
 denominator is an analytic estimate of the reference stack on its stated
-hardware (1x A100, fp16 autocast, flash-attn): forward cost ~2*86e6*N +
-dilated-attention ~0.2 TFLOP => ~2.0 TFLOP per 10240-token slide; A100 fp16
-at a generous 35% MFU => ~109 TFLOPS => ~18.3 ms/slide => ~5.6e5 tokens/s.
+hardware (1x A100, fp16 autocast, flash-attn) running the *same workload*,
+with the FLOP count computed exactly from the flagship config below
+(12 layers x [qkv/out + FFN GEMMs] + the 5-branch dilated-attention
+schedule + patch embed ~= 3.0 TFLOP per 10240-token slide). Per branch,
+head group p attends only its own dilation phase's tokens, so each of the
+H heads runs m = ceil(g/r) queries x m keys per segment: branch cost =
+4*E*L*m/r FLOPs, NOT 4*E*L*m (each token is queried by H/r heads, not H).
+A100 fp16 at a generous 35% end-to-end MFU => ~109 TFLOPS =>
+~27.6 ms/slide => ~3.7e5 tokens/s. Generous because the reference's
+dilated gather/scatter/recombination runs in eager torch between
+flash-attn calls.
 
 Prints exactly one JSON line.
 """
@@ -23,9 +31,32 @@ import json
 import jax.numpy as jnp
 import numpy as np
 
-A100_REF_TOKENS_PER_SEC = 5.6e5  # analytic; see module docstring
-
 N = 10240
+
+# flagship gigapath_slide_enc12l768d geometry (slide_encoder.py / LongNet
+# config LongNet_12_layers_768_dim): reference slide_encoder.py:137-154
+DEPTH, E, HEADS, FFN, IN_CHANS = 12, 768, 16, 3072, 1536
+SEGS = [1024, 5792, 32768, 185363, 1048576]
+RATIOS = [1, 2, 4, 8, 16]
+A100_FP16_FLOPS = 312e12
+A100_MFU = 0.35
+
+
+def workload_flops(n_tokens: int) -> float:
+    """Analytic forward FLOPs of one slide at n_tokens (+cls) tokens."""
+    L = n_tokens + 1  # cls token
+    gemms = DEPTH * (4 * 2 * L * E * E + 2 * 2 * L * E * FFN)
+    # per branch: every head attends m x m per segment on 1/r of the tokens
+    # => 4 * E * L * m / r (see module docstring)
+    windows = sum(
+        -(-min(sl, L) // r) / r for sl, r in zip(SEGS, RATIOS)
+    )
+    attn = DEPTH * 4 * L * E * windows
+    patch = 2 * L * IN_CHANS * E
+    return float(gemms + attn + patch)
+
+
+A100_REF_TOKENS_PER_SEC = N / (workload_flops(N) / (A100_FP16_FLOPS * A100_MFU))
 
 
 def main():

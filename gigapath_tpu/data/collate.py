@@ -41,12 +41,19 @@ def pad_tensors(
     if bucket_fn is not None:
         max_len = bucket_fn(max_len)
     B, D = len(imgs), imgs[0].shape[1]
-    padded = np.zeros((B, max_len, D), imgs[0].dtype)
+    if all(t.dtype == np.float32 for t in imgs):
+        # collate hot loop: native C++ ragged pad (numpy fallback inside)
+        from gigapath_tpu import native
+
+        padded = native.pad_sequences(list(imgs), max_len)
+    else:
+        padded = np.zeros((B, max_len, D), imgs[0].dtype)
+        for i, tensor in enumerate(imgs):
+            padded[i, : tensor.shape[0]] = tensor
     padded_coords = np.zeros((B, max_len, 2), np.float32)
     mask = np.zeros((B, max_len), bool)
-    for i, (tensor, coord) in enumerate(zip(imgs, coords)):
-        n = tensor.shape[0]
-        padded[i, :n] = tensor
+    for i, coord in enumerate(coords):
+        n = coord.shape[0]
         padded_coords[i, :n] = coord
         mask[i, :n] = True
     return padded, padded_coords, mask

@@ -28,8 +28,6 @@ _SRC = os.path.join(os.path.dirname(__file__), "tile_ops.cpp")
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 
-IMAGENET_MEAN = np.asarray([0.485, 0.456, 0.406], np.float32)
-IMAGENET_STD = np.asarray([0.229, 0.224, 0.225], np.float32)
 
 
 def _build() -> Optional[ctypes.CDLL]:
@@ -62,6 +60,7 @@ def _build() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
         ]
+        lib.normalize_tiles.restype = ctypes.c_int
         lib.luminance_occupancy.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_int64, ctypes.c_float, ctypes.c_void_p,
@@ -83,22 +82,32 @@ def available() -> bool:
 
 def normalize_tiles(
     batch_u8: np.ndarray,
-    mean: Sequence[float] = IMAGENET_MEAN,
-    std: Sequence[float] = IMAGENET_STD,
+    mean: Optional[Sequence[float]] = None,
+    std: Optional[Sequence[float]] = None,
 ) -> np.ndarray:
-    """uint8 [..., H, W, C] -> float32 ``(x/255 - mean) / std``."""
+    """uint8 [..., H, W, C] -> float32 ``(x/255 - mean) / std``.
+
+    Defaults to the canonical ImageNet constants from
+    ``gigapath_tpu.models.tile_encoder`` (single source of truth)."""
+    if mean is None or std is None:
+        from gigapath_tpu.models.tile_encoder import IMAGENET_MEAN, IMAGENET_STD
+
+        mean = IMAGENET_MEAN if mean is None else mean
+        std = IMAGENET_STD if std is None else std
     batch_u8 = np.ascontiguousarray(batch_u8, np.uint8)
     c = batch_u8.shape[-1]
     mean = np.ascontiguousarray(mean, np.float32)
     std = np.ascontiguousarray(std, np.float32)
     lib = _build()
-    if lib is None:
+    if lib is None or c > 8:  # kernel's per-channel table is 8 wide
         return ((batch_u8.astype(np.float32) / 255.0) - mean) / std
     out = np.empty(batch_u8.shape, np.float32)
-    lib.normalize_tiles(
+    rc = lib.normalize_tiles(
         batch_u8.ctypes.data, out.ctypes.data,
         batch_u8.size // c, mean.ctypes.data, std.ctypes.data, c,
     )
+    if rc != 0:
+        return ((batch_u8.astype(np.float32) / 255.0) - mean) / std
     return out
 
 
@@ -112,8 +121,13 @@ def luminance_occupancy(
     n, c, h, w = tiles_u8.shape
     lib = _build()
     if lib is None:
-        lum = tiles_u8.mean(axis=1)
-        return (lum < threshold).mean(axis=(-2, -1)).astype(np.float32)
+        # mirror the C kernel bit-for-bit: exact integer channel sums
+        # compared against float32(threshold) * float32(c), so tile
+        # selection is identical with or without a toolchain
+        lum_sum = tiles_u8.astype(np.int32).sum(axis=1)
+        thr = np.float32(threshold) * np.float32(c)
+        count = (lum_sum.astype(np.float32) < thr).sum(axis=(-2, -1))
+        return (count.astype(np.float32) / np.float32(h * w)).astype(np.float32)
     out = np.empty(n, np.float32)
     lib.luminance_occupancy(
         tiles_u8.ctypes.data, n, c, h, w, ctypes.c_float(threshold),

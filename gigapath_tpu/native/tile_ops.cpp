@@ -16,12 +16,19 @@ extern "C" {
 // uint8 NHWC tiles -> float32 normalized (value/255 - mean) / std.
 // The transform hot loop of gigapath/pipeline.py:106-115 (resize/crop stay
 // in PIL; the scale+normalize is the O(N*H*W*C) part).
-void normalize_tiles(const uint8_t* in, float* out, int64_t n_pixels,
-                     const float* mean, const float* std_, int channels) {
+// Returns 0 on success, -1 when channels is out of range (the Python
+// binding then falls back to numpy): the per-channel affine table is a
+// fixed-size stack array, and indexing past it would be undefined behavior.
+int normalize_tiles(const uint8_t* in, float* out, int64_t n_pixels,
+                    const float* mean, const float* std_, int channels) {
+  constexpr int kMaxChannels = 8;
+  if (channels < 1 || channels > kMaxChannels) {
+    return -1;
+  }
   // precompute per-channel affine: out = px * a[c] + b[c]
-  float a[8];
-  float b[8];
-  for (int c = 0; c < channels && c < 8; ++c) {
+  float a[kMaxChannels];
+  float b[kMaxChannels];
+  for (int c = 0; c < channels; ++c) {
     a[c] = 1.0f / (255.0f * std_[c]);
     b[c] = -mean[c] / std_[c];
   }
@@ -32,6 +39,7 @@ void normalize_tiles(const uint8_t* in, float* out, int64_t n_pixels,
       o[c] = static_cast<float>(px[c]) * a[c] + b[c];
     }
   }
+  return 0;
 }
 
 // Per-tile foreground occupancy from NCHW uint8 tiles: fraction of pixels

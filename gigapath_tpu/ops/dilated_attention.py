@@ -74,29 +74,31 @@ def _pad_to_multiple(x: jnp.ndarray, mult: int, axis: int) -> jnp.ndarray:
     return jnp.pad(x, pads)
 
 
-def _head_phases(num_heads: int, ratio: int) -> jnp.ndarray:
-    """Phase (position offset mod ratio) assigned to each head.
-
-    Matches the reference's head-rotated diagonal: heads are split into
-    ``ratio`` groups of ``ceil(H/ratio)`` and group ``p`` samples positions
-    congruent to ``p`` (``dense_to_sparse:24-26``).
-    """
+def _phase_head_ranges(num_heads: int, ratio: int):
+    """Static (phase, head_start, head_end) triples: heads [hs, he) share
+    ``phase`` — phases are contiguous head ranges by construction
+    (``arange(H) // ceil(H/r)``), which is what makes the slice formulations
+    below pure static slices."""
     heads_per_group = -(-num_heads // ratio)
-    return jnp.arange(num_heads) // heads_per_group
-
-
-def _phase_onehot(num_heads: int, ratio: int, dtype) -> jnp.ndarray:
-    """[ratio, H] one-hot: entry (p, h) = 1 iff head h has phase p."""
-    phases = _head_phases(num_heads, ratio)
-    return (phases[None, :] == jnp.arange(ratio)[:, None]).astype(dtype)
+    ranges = []
+    for p in range(ratio):
+        hs = p * heads_per_group
+        he = min((p + 1) * heads_per_group, num_heads)
+        if hs >= num_heads:
+            break
+        ranges.append((p, hs, he))
+    return ranges
 
 
 def dense_to_sparse(x: jnp.ndarray, ratio: int) -> jnp.ndarray:
     """Dilated subsample of segments: [b, g, H, D] -> [b, m, H, D], m=ceil(g/r).
 
-    Head ``h`` keeps positions ``phase(h) + r*j``. Implemented as a one-hot
-    einsum select (a VPU multiply-add) rather than a gather — TPU scatters /
-    gathers over the token axis are far slower than this contraction.
+    Head ``h`` keeps positions ``phase(h) + r*j``. Implemented as static
+    phase slices of the ``[b, m, r, H, D]`` view concatenated over the head
+    axis — every index is a trace-time constant, so XLA lowers this to plain
+    strided copies (measured ~8x cheaper than the one-hot einsum select,
+    whose ``r``-contraction forces a relayout; gathers over the token axis
+    are slower still).
     """
     if ratio == 1:
         return x
@@ -104,8 +106,8 @@ def dense_to_sparse(x: jnp.ndarray, ratio: int) -> jnp.ndarray:
     x = _pad_to_multiple(x, ratio, axis=1)
     m = x.shape[1] // ratio
     x5 = x.reshape(b, m, ratio, H, Dh)
-    onehot = _phase_onehot(H, ratio, x.dtype)  # [r, H]
-    return jnp.einsum("bmrhd,rh->bmhd", x5, onehot)
+    parts = [x5[:, :, p, hs:he, :] for p, hs, he in _phase_head_ranges(H, ratio)]
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=2)
 
 
 def sparse_to_dense(
@@ -115,18 +117,284 @@ def sparse_to_dense(
 
     ``out_s`` [b, m, H, D], ``lse_s`` [b, H, m] -> (out [b, g, H, D],
     lse [b, H, g]) with uncovered positions zero / NEG_INF, so they get zero
-    weight in the cross-branch softmax fusion. Scatter-free: the inverse
-    one-hot broadcast of :func:`dense_to_sparse`.
+    weight in the cross-branch softmax fusion. The inverse of
+    :func:`dense_to_sparse`: ``.at[...].set`` on static phase slices of the
+    ``[b, m, r, H, D]`` view — static dynamic-update-slices, no scatter op.
     """
     b, m, H, Dh = out_s.shape
     if ratio == 1:
         return out_s[:, :seg_len], lse_s[..., :seg_len]
-    onehot = _phase_onehot(H, ratio, out_s.dtype)  # [r, H]
-    out_d = jnp.einsum("bmhd,rh->bmrhd", out_s, onehot).reshape(b, m * ratio, H, Dh)
-    oh_t = _phase_onehot(H, ratio, lse_s.dtype).T  # [H, r]
-    lse_d = lse_s[:, :, :, None] * oh_t[None, :, None, :] + NEG_INF * (1.0 - oh_t[None, :, None, :])
-    lse_d = lse_d.reshape(b, H, m * ratio)
+    out_d5 = jnp.zeros((b, m, ratio, H, Dh), out_s.dtype)
+    lse_d5 = jnp.full((b, H, m, ratio), NEG_INF, lse_s.dtype)
+    for p, hs, he in _phase_head_ranges(H, ratio):
+        out_d5 = out_d5.at[:, :, p, hs:he, :].set(out_s[:, :, hs:he, :])
+        lse_d5 = lse_d5.at[:, hs:he, :, p].set(lse_s[:, hs:he, :])
+    out_d = out_d5.reshape(b, m * ratio, H, Dh)
+    lse_d = lse_d5.reshape(b, H, m * ratio)
     return out_d[:, :seg_len], lse_d[..., :seg_len]
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def _branch_kvlen_bhld(
+    num_heads: int, n_seg: int, g: int, ratio: int, m: int, real_len: int
+) -> Optional[np.ndarray]:
+    """Static [H, n_seg] valid sparse-key counts for the head-major branch.
+
+    Sparse slot ``j`` of segment ``s`` / head ``h`` maps to dense position
+    ``s*g + phase(h) + ratio*j``; it is valid iff that position is a real
+    token (< real_len) *and* falls inside the segment's own ``g`` dense slots
+    (per-segment alignment padding beyond ``g`` belongs to no token).
+    Returns None when every slot is valid. Trace-time constants: free under
+    jit, and fully-padded key blocks are skipped by the kernel.
+    """
+    heads_per_group = -(-num_heads // ratio)
+    phases = np.arange(num_heads) // heads_per_group  # [H]
+    seg = np.arange(n_seg)[None, :]  # [1, n_seg]
+    in_seg = np.clip(real_len - seg * g, 0, g)  # real dense tokens in segment
+    counts = np.ceil((in_seg - phases[:, None]) / ratio)
+    counts = np.clip(counts, 0, m).astype(np.int32)  # [H, n_seg]
+    if (counts == m).all():
+        return None
+    return counts
+
+
+def _dilate_bhld(x: jnp.ndarray, ratio: int) -> jnp.ndarray:
+    """[B, H, n, gp, D] -> [B, H, n, gp/r, D] dilated subsample, head-phased.
+
+    Same phase-slice trick as :func:`dense_to_sparse`, on the head-major
+    layout: view the per-segment axis as (m, r) and take each phase's head
+    range — all static slices.
+    """
+    if ratio == 1:
+        return x
+    B, H, n, gp, Dh = x.shape
+    assert gp % ratio == 0, (gp, ratio)
+    m = gp // ratio
+    x6 = x.reshape(B, H, n, m, ratio, Dh)
+    parts = [x6[:, hs:he, :, :, p, :] for p, hs, he in _phase_head_ranges(H, ratio)]
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+
+
+def _undilate_bhld(
+    out_s: jnp.ndarray, lse_s: jnp.ndarray, ratio: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Inverse of :func:`_dilate_bhld`: sparse [B, H, n, m, D] back to dense
+    [B, H, n, m*r, D] (+ lse [B, H, n, m*r]), uncovered slots zero / NEG_INF.
+
+    One fused broadcast-select against a static [H, r] phase mask (a
+    per-phase ``.at[].set`` loop re-copies the full dense buffer per phase —
+    ~r x the write traffic)."""
+    B, H, n, m, Dh = out_s.shape
+    if ratio == 1:
+        return out_s, lse_s
+    # [H, r] phase mask built from iotas on-device: a host constant here
+    # shows up as a per-step pred[] DMA in profiles
+    h_idx = jax.lax.broadcasted_iota(jnp.int32, (H, ratio), 0)
+    p_idx = jax.lax.broadcasted_iota(jnp.int32, (H, ratio), 1)
+    mask = (h_idx // -(-H // ratio)) == p_idx  # [H, r]
+    out_d = jnp.where(
+        mask[None, :, None, None, :, None], out_s[:, :, :, :, None, :], 0
+    )
+    lse_d = jnp.where(mask[None, :, None, None, :], lse_s[..., None], NEG_INF)
+    return out_d.reshape(B, H, n, m * ratio, Dh), lse_d.reshape(B, H, n, m * ratio)
+
+
+def _segment_attention_jnp(
+    q5: jnp.ndarray, k5: jnp.ndarray, v5: jnp.ndarray, kvlen, is_causal: bool
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Dense (out, lse) attention on the segment-batched head-major layout
+    [B, H, S, M, D] — the fallback tier for short segments / non-TPU runs,
+    numerically matching the Pallas kernel (fp32 softmax, masked rows -> 0)."""
+    B, H, S, M, Dh = q5.shape
+    scale = Dh ** -0.5
+    s = jnp.einsum(
+        "bhsqd,bhskd->bhsqk", q5, k5, preferred_element_type=jnp.float32
+    ).astype(jnp.float32) * scale
+    mask = None
+    if kvlen is not None:
+        lens = jnp.asarray(np.asarray(kvlen, np.int32).reshape(-1, H, S))
+        mask = jnp.arange(k5.shape[3])[None, None, None, None, :] >= lens[..., None, None]
+        s = jnp.where(mask, NEG_INF, s)
+    if is_causal:
+        qi = jnp.arange(M)[:, None] + (k5.shape[3] - M)
+        ki = jnp.arange(k5.shape[3])[None, :]
+        s = jnp.where(ki > qi, NEG_INF, s)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)  # [B, H, S, M]
+    p = jnp.exp(s - lse[..., None])
+    if mask is not None:
+        p = jnp.where(mask, 0.0, p)
+    out = jnp.einsum(
+        "bhsqk,bhskd->bhsqd", p.astype(v5.dtype), v5,
+        preferred_element_type=jnp.float32,
+    ).astype(q5.dtype)
+    return out, lse
+
+
+def _branch_bhld(
+    qh: jnp.ndarray,
+    kh: jnp.ndarray,
+    vh: jnp.ndarray,
+    sl: int,
+    r: int,
+    *,
+    is_causal: bool,
+    real_len: int,
+    interpret: bool,
+    use_pallas: Optional[bool],
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One dilated branch, entirely in [B, H, L, D]: segment via a free
+    reshape, dilate via static phase slices, run the segment-grid flash
+    kernel, and undo — no batch-axis reshuffling or relayouts anywhere."""
+    B, H, L, Dh = qh.shape
+    g = min(sl, L)
+    Lp = _round_up(L, g)
+    n = Lp // g
+    gp = _round_up(g, r)
+    m = gp // r
+
+    def seg(x):
+        if Lp != L:
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, Lp - L), (0, 0)))
+        x = x.reshape(B, H, n, g, Dh)
+        if gp != g:
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, gp - g), (0, 0)))
+        return _dilate_bhld(x, r)
+
+    q5, k5, v5 = seg(qh), seg(kh), seg(vh)
+    kvlen = _branch_kvlen_bhld(H, n, g, r, m, real_len)
+    if kvlen is not None:
+        kvlen = np.broadcast_to(kvlen[None], (B, H, n))
+
+    if use_pallas is None:
+        from gigapath_tpu.ops.flash_attention import PALLAS_MIN_SEQ, _on_tpu
+
+        use_pallas = (interpret or _on_tpu()) and m >= PALLAS_MIN_SEQ
+    if use_pallas:
+        from gigapath_tpu.ops.pallas_flash import pallas_segment_flash
+
+        block = min(1024, _round_up(m, 128))
+        out_s, lse_s = pallas_segment_flash(
+            q5, k5, v5, is_causal=is_causal, kv_len=kvlen,
+            block_q=block, block_k=block, interpret=interpret,
+        )
+    else:
+        out_s, lse_s = _segment_attention_jnp(q5, k5, v5, kvlen, is_causal)
+
+    out_d, lse_d = _undilate_bhld(out_s, lse_s, r)  # [B, H, n, gp, D]
+    out = out_d[:, :, :, :g].reshape(B, H, Lp, Dh)[:, :, :L]
+    lse = lse_d[:, :, :, :g].reshape(B, H, Lp)[:, :, :L]
+    return out, lse
+
+
+def dilated_attention_fused(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    segment_lengths: Sequence[int],
+    dilated_ratios: Sequence[int],
+    *,
+    is_causal: bool = False,
+    valid_len: Optional[int] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fastest path: per-branch phase-major Pallas kernels on dense
+    [B, L, E] activations (see :mod:`gigapath_tpu.ops.pallas_dilated`).
+
+    Activations never leave the 128-lane-aligned ``[B, L, E]`` layout:
+    segmenting and dilation ride the kernels' BlockSpec index maps, each
+    branch emits a dense ``(out [B,L,E], lse [B,H,L])`` pair, and the
+    cross-branch LSE-softmax fusion is one fused elementwise pass. Branches
+    whose ratio does not divide the head count (never the case for LongNet's
+    power-of-two schedules) fall back to the head-major path.
+    """
+    from gigapath_tpu.ops.pallas_dilated import dilated_branch_attention
+
+    B, L, H, Dh = q.shape
+    E = H * Dh
+    qE, kE, vE = (x.reshape(B, L, E) for x in (q, k, v))
+    real_len = L if valid_len is None else min(int(valid_len), L)
+    outs, lses = [], []
+    for sl, r in zip(segment_lengths, dilated_ratios):
+        sl, r = int(sl), int(r)
+        if H % r == 0 and E % r == 0:
+            o, l = dilated_branch_attention(
+                qE, kE, vE, sl, r, H,
+                real_len=real_len, is_causal=is_causal, interpret=interpret,
+            )
+        else:
+            qh, kh, vh = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+            o4, l = _branch_bhld(
+                qh, kh, vh, sl, r, is_causal=is_causal, real_len=real_len,
+                interpret=interpret, use_pallas=None,
+            )
+            o = o4.transpose(0, 2, 1, 3).reshape(B, L, E)
+        outs.append(o)
+        lses.append(l)
+
+    if len(outs) == 1:
+        out = outs[0]
+    else:
+        lse = jnp.stack(lses)  # [n_branch, B, H, L]
+        weights = jax.nn.softmax(jax.lax.stop_gradient(lse), axis=0)
+        acc = 0.0
+        for o, w in zip(outs, weights):
+            # w [B,H,L] -> [B,L,H,1] broadcast over the head's lanes; the
+            # whole fusion is one elementwise pass over the branch outputs
+            acc = acc + o.reshape(B, L, H, Dh).astype(jnp.float32) * (
+                w.transpose(0, 2, 1)[..., None]
+            )
+        out = acc.reshape(B, L, E)
+    return out.astype(q.dtype).reshape(B, L, H, Dh)
+
+
+def dilated_attention_bhld(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    segment_lengths: Sequence[int],
+    dilated_ratios: Sequence[int],
+    *,
+    is_causal: bool = False,
+    valid_len: Optional[int] = None,
+    interpret: bool = False,
+    use_pallas: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Head-major fast path for multi-branch dilated attention.
+
+    Same math as :func:`dilated_attention` (same branch schedule, same
+    LSE-softmax fusion with stop-gradient weights), restructured for TPU
+    memory layout: one [B,L,H,D] -> [B,H,L,D] relayout at entry, one at
+    exit, and every per-branch step in between — segmenting, dilation,
+    attention, scatter-back, fusion — is a free reshape, a static slice, or
+    a segment-grid Pallas kernel. The per-branch transposes of the generic
+    path (3 inputs + out + lse per branch, 5 branches in the flagship) are
+    gone. ``valid_len``: static suffix-padding bound (alignment padding).
+    """
+    B, L, H, Dh = q.shape
+    real_len = L if valid_len is None else min(int(valid_len), L)
+    qh = q.transpose(0, 2, 1, 3)
+    kh = k.transpose(0, 2, 1, 3)
+    vh = v.transpose(0, 2, 1, 3)
+    outs, lses = [], []
+    for sl, r in zip(segment_lengths, dilated_ratios):
+        o, l = _branch_bhld(
+            qh, kh, vh, int(sl), int(r),
+            is_causal=is_causal, real_len=real_len,
+            interpret=interpret, use_pallas=use_pallas,
+        )
+        outs.append(o)
+        lses.append(l)
+
+    if len(outs) == 1:
+        out = outs[0]
+    else:
+        lse = jnp.stack(lses)  # [n_branch, B, H, L]
+        weights = jax.nn.softmax(jax.lax.stop_gradient(lse), axis=0)[..., None]
+        out = sum(o.astype(jnp.float32) * w for o, w in zip(outs, weights))
+    return out.astype(q.dtype).transpose(0, 2, 1, 3)
 
 
 def _gather_kv_seq_parallel(
@@ -216,6 +484,32 @@ def dilated_attention(
             "a common length (the encoder path uses offset=0)"
         )
     B, L, H, Dh = q.shape
+
+    # Head-major fast path (TPU): see dilated_attention_bhld. Taken whenever
+    # nothing forces the generic layout — no custom attn_fn, no dropout, no
+    # sequence parallelism, no decoding offset, and a static (or absent)
+    # padding bound.
+    valid_len_is_static = valid_len is None or isinstance(valid_len, int)
+    if (
+        attn_fn_was_default
+        and not (dropout_rate > 0.0 and dropout_rng is not None)
+        and (seq_axis_name is None or seq_axis_size <= 1)
+        and offset == 0
+        and q.shape == k.shape == v.shape
+        and valid_len_is_static
+    ):
+        from gigapath_tpu.ops.flash_attention import _on_tpu
+
+        if _on_tpu():
+            # Head-major fast path. The phase-major dilated_attention_fused
+            # kernels (pallas_dilated.py) have faster attention cells but
+            # their per-branch packing relayouts currently cost more than
+            # they save end-to-end (v5e traces: reshape+pad dominate); keep
+            # them opt-in until the packing is kernel-side.
+            return dilated_attention_bhld(
+                q, k, v, segment_lengths, dilated_ratios,
+                is_causal=is_causal, valid_len=valid_len,
+            )
 
     outs, lses = [], []
     for i, (sl, r) in enumerate(zip(segment_lengths, dilated_ratios)):

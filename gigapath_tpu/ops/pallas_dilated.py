@@ -1,0 +1,559 @@
+"""Fused dilated-attention branch kernel (phase-major layout), fwd + bwd.
+
+Second-generation Pallas path for LongNet dilated attention (the reference's
+``torchscale/component/dilated_attention.py`` branch loop). The first
+generation ran a segment-grid flash kernel on a head-major ``[B, H, S, M, D]``
+layout; profiling showed the kernel itself was fine but the XLA glue around it
+(BLHD<->BHLD relayouts with a 48-wide minor dim, per-branch dilation
+selects/scatters, and the mega-fusions XLA built across them) cost more than
+the attention math. This kernel removes that glue by construction:
+
+- Activations stay ``[B, L, E]`` (E = H*Dh, 128-lane aligned) end to end. The
+  only relayout per branch is a *phase-major* reshape/transpose
+  ``[B, L, E] -> [B, S, r, r, M, E/r]`` splitting tokens by dilation phase
+  (dim 2) and lanes by head band (dim 3) — a single fast, clean-lane copy.
+- A dilated branch with ratio ``r`` makes head band ``p`` (heads
+  ``p*H/r .. (p+1)*H/r - 1``, lanes ``p*E/r .. (p+1)*E/r``) attend exactly
+  the tokens of phase ``p`` (positions ``s*g + p + r*j``,
+  ``dense_to_sparse`` in the reference). In the phase-major view those are
+  the *diagonal* ``(p, p)`` blocks, so the kernel grid is
+  ``(B, S, r, nq, nk)`` and every BlockSpec indexes ``(b, s, p, p, i)``:
+  dilation costs nothing inside the kernel.
+- Heads within a band are unrolled in the kernel body over *static* lane
+  slices (a band always sits at block-local lanes ``t*Dh..(t+1)*Dh``).
+- Off-diagonal ``(p, p')`` blocks of the outputs are never visited — they
+  are exactly the (token, head) pairs this branch does not cover. Their HBM
+  contents stay uninitialized; the wrapper replaces them with 0 via a
+  ``jnp.where`` on the branch's static cover pattern (select, not multiply,
+  so NaN garbage cannot leak), and the cross-branch fusion gives them
+  weight 0 through the NEG_INF lse. Gradients at those slots are genuinely
+  zero, so the same where makes the backward exact.
+- The log-sum-exp per (token, head) — required by the cross-branch fusion
+  (reference ``dilated_attention.py:119-128``) — is emitted compactly as
+  ``[B, S, r, M, LANES]`` with one lane per band head.
+
+Same numerics as ``pallas_flash.py``: fp32 online softmax (base-2 in the
+forward: log2(e) folds into the q scale so the hot loop runs ``exp2``),
+running max floored at ``M_FLOOR`` so masked/padded slots underflow to
+exactly 0 and fully-masked rows produce out=0 / lse ~ -1e20, ragged tails
+masked from an SMEM table of per-(segment, phase) valid counts with
+fully-masked key blocks skipped.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+M_FLOOR = -1e20
+LANES = 128
+LOG2E = 1.4426950408889634
+LN2 = 0.6931471805599453
+DEFAULT_BLOCK = 512
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref,
+                m_ref, l_ref, acc_ref, *, scale, causal,
+                block_q, block_k):
+    # grid (B, S, r, nq, hb, nk): one head-band slice per cell — blocks are
+    # [block, Dh] lane slices picked by the head index in the BlockSpecs, so
+    # the body never slices lanes (Mosaic lane shuffles measured ~1.6x the
+    # whole kernel cost when heads were unrolled over an [block, W] tile)
+    b, s, p = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    i, t, j = pl.program_id(3), pl.program_id(4), pl.program_id(5)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, M_FLOOR)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j * block_k < kvlen_ref[b, s, p])
+    def _compute():
+        # log2(e) folded into the scale: exp2 instead of exp in the hot loop
+        qh = (q_ref[0, 0, 0, 0, 0].astype(jnp.float32) * (scale * LOG2E)).astype(
+            q_ref.dtype
+        )  # [bq, Dh]
+        s_ = jax.lax.dot_general(
+            qh, k_ref[0, 0, 0, 0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk], in log2 units
+        col_bias = jnp.where(
+            jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1) + j * block_k
+            < kvlen_ref[b, s, p],
+            0.0,
+            NEG_INF,
+        )
+        s_ = s_ + col_bias
+        if causal:
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
+            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
+            s_ = jnp.where(cols > rows, NEG_INF, s_)
+
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s_, axis=-1, keepdims=True))
+        pp = jnp.exp2(s_ - m_new)
+        alpha = jnp.exp2(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(pp, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            pp.astype(v_ref.dtype), v_ref[0, 0, 0, 0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == pl.num_programs(5) - 1)
+    def _finalize():
+        safe_l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0, 0, 0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        # natural-log lse from the base-2 stats, written into lane t of the
+        # shared [bq, LANES] block. The block persists in VMEM across the
+        # (t, j) iterations of one i, so each head deposits its lane; lanes
+        # beyond the band's heads keep the t=0 fill (sliced off outside).
+        val = (m_ref[:, :1] + jnp.log2(safe_l)) * LN2  # [bq, 1]
+        lane = jax.lax.broadcasted_iota(jnp.int32, (block_q, LANES), 1)
+
+        @pl.when(t == 0)
+        def _first_head():
+            lse_ref[0, 0, 0] = jnp.where(lane == 0, val, NEG_INF)
+
+        @pl.when(t > 0)
+        def _later_head():
+            lse_ref[0, 0, 0] = jnp.where(lane == t, val, lse_ref[0, 0, 0])
+
+
+def _fwd_impl(q5, k5, v5, kvlen, causal, scale, heads, head_dim,
+              block_q, block_k, interpret):
+    B, S, r, _, hb, M, Dh = q5.shape
+    Mk = k5.shape[5]
+    nq, nk = M // block_q, Mk // block_k
+    assert hb == heads and Dh == head_dim, (hb, heads, Dh, head_dim)
+
+    spec_q = pl.BlockSpec(
+        (1, 1, 1, 1, 1, block_q, head_dim),
+        lambda b, s, p, i, t, j: (b, s, p, p, t, i, 0),
+        memory_space=pltpu.VMEM,
+    )
+    spec_k = pl.BlockSpec(
+        (1, 1, 1, 1, 1, block_k, head_dim),
+        lambda b, s, p, i, t, j: (b, s, p, p, t, j, 0),
+        memory_space=pltpu.VMEM,
+    )
+    lse_spec = pl.BlockSpec(
+        (1, 1, 1, block_q, LANES), lambda b, s, p, i, t, j: (b, s, p, i, 0),
+        memory_space=pltpu.VMEM,
+    )
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(B, S, r, nq, heads, nk),
+        in_specs=[spec_q, spec_k, spec_k, pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=[spec_q, lse_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(q5.shape, q5.dtype),
+            jax.ShapeDtypeStruct((B, S, r, M, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q5, k5, v5, kvlen)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _lane(vec_block, t, block_q):
+    """Extract lane ``t`` (a traced grid index) of a [bq, LANES] block as
+    [bq, 1]: mask-and-rowsum, no dynamic lane slicing."""
+    lane = jax.lax.broadcasted_iota(jnp.int32, (block_q, LANES), 1)
+    return jnp.sum(jnp.where(lane == t, vec_block, 0.0), axis=1, keepdims=True)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref,
+               dq_ref, dq_acc, *, scale, causal, block_q, block_k):
+    b, s, p = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    i, t, j = pl.program_id(3), pl.program_id(4), pl.program_id(5)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    @pl.when(j * block_k < kvlen_ref[b, s, p])
+    def _compute():
+        qh = q_ref[0, 0, 0, 0, 0]
+        kh = k_ref[0, 0, 0, 0, 0]
+        s_ = jax.lax.dot_general(
+            qh, kh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        col_bias = jnp.where(
+            jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1) + j * block_k
+            < kvlen_ref[b, s, p],
+            0.0,
+            NEG_INF,
+        )
+        pp = jnp.exp(s_ + col_bias - _lane(lse_ref[0, 0, 0], t, block_q))
+        if causal:
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
+            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
+            pp = jnp.where(cols > rows, 0.0, pp)
+        dp = jax.lax.dot_general(
+            do_ref[0, 0, 0, 0, 0].astype(jnp.float32),
+            v_ref[0, 0, 0, 0, 0].astype(jnp.float32),
+            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+        ds = pp * (dp - _lane(delta_ref[0, 0, 0], t, block_q))
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(kh.dtype), kh, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(j == pl.num_programs(5) - 1)
+    def _finalize():
+        dq_ref[0, 0, 0, 0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                block_q, block_k):
+    b, s, p = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    j, t, i = pl.program_id(3), pl.program_id(4), pl.program_id(5)  # grid: (B, S, r, nk, hb, nq)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(j * block_k < kvlen_ref[b, s, p])
+    def _compute():
+        qh = q_ref[0, 0, 0, 0, 0]
+        kh = k_ref[0, 0, 0, 0, 0]
+        s_ = jax.lax.dot_general(
+            qh, kh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        col_bias = jnp.where(
+            jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1) + j * block_k
+            < kvlen_ref[b, s, p],
+            0.0,
+            NEG_INF,
+        )
+        pp = jnp.exp(s_ + col_bias - _lane(lse_ref[0, 0, 0], t, block_q))
+        if causal:
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
+            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
+            pp = jnp.where(cols > rows, 0.0, pp)
+        do_h = do_ref[0, 0, 0, 0, 0].astype(jnp.float32)
+        dv_acc[:] += jax.lax.dot_general(
+            pp, do_h, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do_h, v_ref[0, 0, 0, 0, 0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = pp * (dp - _lane(delta_ref[0, 0, 0], t, block_q))
+        dk_acc[:] += jax.lax.dot_general(
+            ds, qh.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(i == pl.num_programs(5) - 1)
+    def _finalize():
+        dk_ref[0, 0, 0, 0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, 0, 0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_impl(q5, k5, v5, do5, lse, delta, kvlen, causal, scale,
+              heads, head_dim, block_q, block_k, interpret):
+    B, S, r, _, hb, M, Dh = q5.shape
+    Mk = k5.shape[5]
+    nq, nk = M // block_q, Mk // block_k
+
+    spec_q = pl.BlockSpec(
+        (1, 1, 1, 1, 1, block_q, head_dim),
+        lambda b, s, p, i, t, j: (b, s, p, p, t, i, 0),
+        memory_space=pltpu.VMEM,
+    )
+    spec_k = pl.BlockSpec(
+        (1, 1, 1, 1, 1, block_k, head_dim),
+        lambda b, s, p, i, t, j: (b, s, p, p, t, j, 0),
+        memory_space=pltpu.VMEM,
+    )
+    vec_spec = pl.BlockSpec(
+        (1, 1, 1, block_q, LANES), lambda b, s, p, i, t, j: (b, s, p, i, 0),
+        memory_space=pltpu.VMEM,
+    )
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=(B, S, r, nq, heads, nk),
+        in_specs=[spec_q, spec_k, spec_k, spec_q, vec_spec, vec_spec, smem],
+        out_specs=[spec_q],
+        out_shape=[jax.ShapeDtypeStruct(q5.shape, q5.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
+        interpret=interpret,
+    )(q5, k5, v5, do5, lse, delta, kvlen)[0]
+
+    # grid (B, S, r, nk, hb, nq): index maps see (b, s, p, j, t, i)
+    spec_q_kv = pl.BlockSpec(
+        (1, 1, 1, 1, 1, block_q, head_dim),
+        lambda b, s, p, j, t, i: (b, s, p, p, t, i, 0),
+        memory_space=pltpu.VMEM,
+    )
+    spec_k_kv = pl.BlockSpec(
+        (1, 1, 1, 1, 1, block_k, head_dim),
+        lambda b, s, p, j, t, i: (b, s, p, p, t, j, 0),
+        memory_space=pltpu.VMEM,
+    )
+    vec_spec_kv = pl.BlockSpec(
+        (1, 1, 1, block_q, LANES), lambda b, s, p, j, t, i: (b, s, p, i, 0),
+        memory_space=pltpu.VMEM,
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=(B, S, r, nk, heads, nq),
+        in_specs=[spec_q_kv, spec_k_kv, spec_k_kv, spec_q_kv,
+                  vec_spec_kv, vec_spec_kv, smem],
+        out_specs=[spec_k_kv, spec_k_kv],
+        out_shape=[
+            jax.ShapeDtypeStruct(k5.shape, k5.dtype),
+            jax.ShapeDtypeStruct(v5.shape, v5.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q5, k5, v5, do5, lse, delta, kvlen)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# phase-major packing + the differentiable branch op
+# ---------------------------------------------------------------------------
+
+
+def _branch_geometry(L: int, E: int, sl: int, r: int) -> Tuple[int, int, int, int, int, int]:
+    """(g, S, gp, m, Mp, block): segment length/count, r-padded segment,
+    sparse length, block-padded sparse length, block size.
+
+    Block choice: one block when the whole sparse segment fits the VMEM
+    budget; otherwise the candidate (multiple of 128) minimizing q-row
+    padding — padded key blocks are skipped by the kernel, padded q rows are
+    not. The cap keeps q/k/v/out double-buffered blocks plus the fp32 logits
+    tile inside VMEM (W = E/r lanes per block row)."""
+    g = min(sl, L)
+    S = _round_up(L, g) // g
+    gp = _round_up(g, r)
+    m = gp // r
+    # per-cell VMEM is dominated by the [bq, bk] fp32 logits/probs tiles
+    # (blocks themselves are [b, Dh], tiny): 1024^2 blocks fit and are
+    # ~2x faster than 512 on the LongNet shapes (fewer K/V restreams);
+    # candidates below trade q-row padding against cell count
+    cap = 1024
+    single = _round_up(m, LANES)
+    if single <= cap:
+        block = single
+    else:
+        block = min(
+            (512, 640, 768, 896, 1024),
+            key=lambda b: (_round_up(m, b), -b),
+        )
+    Mp = _round_up(m, block)
+    return g, S, gp, m, Mp, block
+
+
+def _to_phase_major(x: jnp.ndarray, g: int, S: int, gp: int, r: int,
+                    Mp: int, H: int) -> jnp.ndarray:
+    """[B, L, E] -> [B, S, r, r, H/r, Mp, Dh]: tokens split by (segment,
+    phase), lanes split by (head band, head, head_dim) with the head-dim
+    minor so kernel blocks can be full-[Dh]-lane slices. One transpose;
+    everything else is free reshapes / zero pads."""
+    B, L, E = x.shape
+    hb = H // r
+    Dh = E // H
+    if S * g != L:
+        x = jnp.pad(x, ((0, 0), (0, S * g - L), (0, 0)))
+    x = x.reshape(B, S, g, E)
+    if gp != g:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
+    m = gp // r
+    # (tokens m, phase r) x (band r, head hb, dim Dh)
+    x = x.reshape(B, S, m, r, r, hb, Dh)
+    x = x.transpose(0, 1, 3, 4, 5, 2, 6)  # [B, S, r, r, hb, m, Dh]
+    if Mp != m:
+        x = jnp.pad(
+            x, ((0, 0), (0, 0), (0, 0), (0, 0), (0, 0), (0, Mp - m), (0, 0))
+        )
+    return x
+
+
+def _from_phase_major(x7: jnp.ndarray, B: int, L: int, E: int, g: int,
+                      S: int, gp: int, r: int, m: int) -> jnp.ndarray:
+    """Inverse of :func:`_to_phase_major` (drops all padding)."""
+    x7 = x7[:, :, :, :, :, :m]  # [B, S, r, r, hb, m, Dh]
+    x = x7.transpose(0, 1, 5, 2, 3, 4, 6).reshape(B, S, gp, E)
+    return x[:, :, :g].reshape(B, S * g, E)[:, :L]
+
+
+def _cover_mask(L: int, E: int, g: int, r: int) -> jnp.ndarray:
+    """[L, E] bool: lane e (head band e // (E/r)) is covered at token t iff
+    the band equals the token's phase ``(t % g) % r``. Built from iotas so no
+    host constant is DMA'd per step."""
+    tok = jax.lax.broadcasted_iota(jnp.int32, (L, E), 0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (L, E), 1)
+    return (tok % g) % r == lane // (E // r)
+
+
+def _phase_kvlen(S: int, g: int, r: int, m: int, real_len: int) -> np.ndarray:
+    """[S, r] valid sparse keys per (segment, phase): position
+    ``s*g + p + r*j`` must be a real token and inside its segment."""
+    seg = np.arange(S)[:, None]
+    phase = np.arange(r)[None, :]
+    in_seg = np.clip(real_len - seg * g, 0, g)
+    counts = np.ceil((in_seg - phase) / r)
+    return np.clip(counts, 0, m).astype(np.int32)
+
+
+def _scatter_lse(lse5: jnp.ndarray, B: int, L: int, H: int, g: int, S: int,
+                 r: int, m: int) -> jnp.ndarray:
+    """Kernel lse [B, S, r, Mp, LANES] -> dense [B, H, L] with NEG_INF at
+    (token, head) pairs the branch does not cover. Small fp32 data; plain
+    jnp reshapes + a where."""
+    hb = H // r  # heads per band
+    lse = lse5[:, :, :, :m, :hb]  # [B, S, r(phase), m, hb]
+    lse = lse.transpose(0, 2, 4, 1, 3).reshape(B, H, S, m)  # head h = p*hb + t
+    # token t = s*g + j*r + p is covered by head h iff phase(h) == p
+    phase_of_head = jax.lax.broadcasted_iota(jnp.int32, (H, r), 0) // hb
+    cover = phase_of_head == jax.lax.broadcasted_iota(jnp.int32, (H, r), 1)
+    dense = jnp.where(cover[None, :, None, None, :], lse[..., None], NEG_INF)
+    dense = dense.reshape(B, H, S, m * r)[:, :, :, :g].reshape(B, H, S * g)
+    return dense[:, :, :L]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _dilated_branch(q, k, v, sl, r, H, real_len, causal, interpret):
+    out, lse, _res = _dilated_branch_fwd_impl(
+        q, k, v, sl, r, H, real_len, causal, interpret
+    )
+    return out, lse
+
+
+def _dilated_branch_fwd_impl(q, k, v, sl, r, H, real_len, causal, interpret):
+    B, L, E = q.shape
+    Dh = E // H
+    g, S, gp, m, Mp, block = _branch_geometry(L, E, sl, r)
+    q5 = _to_phase_major(q, g, S, gp, r, Mp, H)
+    k5 = _to_phase_major(k, g, S, gp, r, Mp, H)
+    v5 = _to_phase_major(v, g, S, gp, r, Mp, H)
+    kvlen = jnp.asarray(
+        np.broadcast_to(_phase_kvlen(S, g, r, m, real_len)[None], (B, S, r))
+    )
+    hb = H // r
+    out5, lse5 = _fwd_impl(
+        q5, k5, v5, kvlen, causal, Dh ** -0.5, hb, Dh, block, block, interpret
+    )
+    out = _from_phase_major(out5, B, L, E, g, S, gp, r, m)
+    if r > 1:
+        out = jnp.where(_cover_mask(L, E, g, r)[None], out, 0)
+    lse = _scatter_lse(lse5, B, L, H, g, S, r, m)
+    return out, lse, (q5, k5, v5, out5, lse5)
+
+
+def _dilated_branch_fwd(q, k, v, sl, r, H, real_len, causal, interpret):
+    out, lse, res = _dilated_branch_fwd_impl(
+        q, k, v, sl, r, H, real_len, causal, interpret
+    )
+    return (out, lse), (res, q.shape)
+
+
+def _dilated_branch_bwd(sl, r, H, real_len, causal, interpret, saved, cotangents):
+    (q5, k5, v5, out5, lse5), (B, L, E) = saved
+    do, _dlse = cotangents  # no gradient flows through the lse output
+    Dh = E // H
+    hb = H // r
+    g, S, gp, m, Mp, block = _branch_geometry(L, E, sl, r)
+    do5 = _to_phase_major(do, g, S, gp, r, Mp, H)
+    # delta = rowsum(do * out) per (token, head), in the kernel's lse layout;
+    # only the diagonal (phase == band) blocks are real
+    prod = do5.astype(jnp.float32) * out5.astype(jnp.float32)
+    delta = prod.sum(axis=-1)  # [B, S, r, r, hb, Mp]
+    delta = jnp.diagonal(delta, axis1=2, axis2=3)  # [B, S, hb, Mp, r]
+    delta = delta.transpose(0, 1, 4, 3, 2)  # [B, S, r, Mp, hb]
+    delta = jnp.pad(delta, ((0, 0),) * 4 + ((0, LANES - hb),))
+    kvlen = jnp.asarray(
+        np.broadcast_to(_phase_kvlen(S, g, r, m, real_len)[None], (B, S, r))
+    )
+    dq5, dk5, dv5 = _bwd_impl(
+        q5, k5, v5, do5, lse5, delta, kvlen, causal, Dh ** -0.5,
+        hb, Dh, block, block, interpret,
+    )
+    cover = _cover_mask(L, E, g, r)[None] if r > 1 else None
+
+    def undo(x5):
+        x = _from_phase_major(x5, B, L, E, g, S, gp, r, m)
+        return x if cover is None else jnp.where(cover, x, 0)
+
+    return undo(dq5), undo(dk5), undo(dv5)
+
+
+_dilated_branch.defvjp(_dilated_branch_fwd, _dilated_branch_bwd)
+
+
+def dilated_branch_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    sl: int,
+    r: int,
+    num_heads: int,
+    *,
+    real_len: Optional[int] = None,
+    is_causal: bool = False,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One dilated-attention branch on dense [B, L, E] activations.
+
+    Returns ``(out [B, L, E], lse [B, H, L])`` where positions/heads not
+    covered by this branch hold 0 / NEG_INF — ready for the cross-branch
+    LSE-softmax fusion. Requires ``num_heads % r == 0`` and ``E % r == 0``
+    (true for every LongNet config's power-of-two schedule).
+    """
+    B, L, E = q.shape
+    assert E % num_heads == 0
+    assert num_heads % r == 0 and E % r == 0, (num_heads, E, r)
+    rl = L if real_len is None else min(int(real_len), L)
+    return _dilated_branch(
+        q, k, v, int(sl), int(r), num_heads, rl, is_causal, interpret
+    )

@@ -62,8 +62,8 @@ def _round_up(n: int, m: int) -> int:
 
 def _fwd_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                 *, scale, causal, block_q, block_k):
-    b, h = pl.program_id(0), pl.program_id(1)
-    i, j = pl.program_id(2), pl.program_id(3)
+    b, h, sg = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    i, j = pl.program_id(3), pl.program_id(4)
 
     @pl.when(j == 0)
     def _init():
@@ -71,11 +71,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref, m_ref, l_ref, ac
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    @pl.when(j * block_k < kvlen_ref[b, h])
+    @pl.when(j * block_k < kvlen_ref[b, h, sg])
     def _compute():
         # scale folded into q: block_q*D elements instead of block_q*block_k
-        q = (q_ref[0, 0].astype(jnp.float32) * scale).astype(q_ref.dtype)
-        k = k_ref[0, 0]
+        q = (q_ref[0, 0, 0].astype(jnp.float32) * scale).astype(q_ref.dtype)
+        k = k_ref[0, 0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (BQ, BK)
@@ -98,7 +98,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref, m_ref, l_ref, ac
         # underflows to exactly 0.
         col_bias = jnp.where(
             jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1) + j * block_k
-            < kvlen_ref[b, h],
+            < kvlen_ref[b, h, sg],
             0.0,
             NEG_INF,
         )
@@ -108,37 +108,37 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref, m_ref, l_ref, ac
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[0, 0, 0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    @pl.when(j == pl.num_programs(3) - 1)
+    @pl.when(j == pl.num_programs(4) - 1)
     def _finalize():
         l = l_ref[:, :1]
         safe_l = jnp.maximum(l, 1e-30)
-        o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        o_ref[0, 0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
         # lse carried at LANES width (TPU tiling needs a 128-lane last dim);
         # the wrapper slices lane 0
-        lse_ref[0, 0] = jnp.broadcast_to(
+        lse_ref[0, 0, 0] = jnp.broadcast_to(
             m_ref[:, :1] + jnp.log(safe_l), (block_q, LANES)
         )
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dq_ref, dq_acc,
                *, scale, causal, block_q, block_k):
-    b, h = pl.program_id(0), pl.program_id(1)
-    i, j = pl.program_id(2), pl.program_id(3)
+    b, h, sg = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    i, j = pl.program_id(3), pl.program_id(4)
 
     @pl.when(j == 0)
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    @pl.when(j * block_k < kvlen_ref[b, h])
+    @pl.when(j * block_k < kvlen_ref[b, h, sg])
     def _compute():
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
+        q = q_ref[0, 0, 0]
+        k = k_ref[0, 0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
@@ -147,125 +147,130 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dq_re
         # logits — inf * 0 = NaN in the gradients
         col_bias = jnp.where(
             jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1) + j * block_k
-            < kvlen_ref[b, h],
+            < kvlen_ref[b, h, sg],
             0.0,
             NEG_INF,
         )
-        p = jnp.exp(s + col_bias - lse_ref[0, 0][:, :1])
+        p = jnp.exp(s + col_bias - lse_ref[0, 0, 0][:, :1])
         if causal:
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
             rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
             p = jnp.where(cols > rows, 0.0, p)
 
         dp = jax.lax.dot_general(
-            do_ref[0, 0].astype(jnp.float32), v_ref[0, 0].astype(jnp.float32),
+            do_ref[0, 0, 0].astype(jnp.float32), v_ref[0, 0, 0].astype(jnp.float32),
             (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_ref[0, 0][:, :1])
+        ds = p * (dp - delta_ref[0, 0, 0][:, :1])
         dq_acc[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
 
-    @pl.when(j == pl.num_programs(3) - 1)
+    @pl.when(j == pl.num_programs(4) - 1)
     def _finalize():
-        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+        dq_ref[0, 0, 0] = dq_acc[:].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dk_ref, dv_ref,
                 dk_acc, dv_acc, *, scale, causal, block_q, block_k):
-    b, h = pl.program_id(0), pl.program_id(1)
-    j, i = pl.program_id(2), pl.program_id(3)  # grid: (B, H, nk, nq)
+    b, h, sg = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    j, i = pl.program_id(3), pl.program_id(4)  # grid: (B, H, S, nk, nq)
 
     @pl.when(i == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    @pl.when(j * block_k < kvlen_ref[b, h])
+    @pl.when(j * block_k < kvlen_ref[b, h, sg])
     def _compute():
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
+        q = q_ref[0, 0, 0]
+        k = k_ref[0, 0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # (BQ, BK)
         col_bias = jnp.where(
             jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1) + j * block_k
-            < kvlen_ref[b, h],
+            < kvlen_ref[b, h, sg],
             0.0,
             NEG_INF,
         )
-        p = jnp.exp(s + col_bias - lse_ref[0, 0][:, :1])  # (BQ, BK)
+        p = jnp.exp(s + col_bias - lse_ref[0, 0, 0][:, :1])  # (BQ, BK)
         if causal:
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
             rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
             p = jnp.where(cols > rows, 0.0, p)
 
-        do = do_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0, 0].astype(jnp.float32)
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )  # (BK, D)
         dp = jax.lax.dot_general(
-            do, v_ref[0, 0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            do, v_ref[0, 0, 0].astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (BQ, BK)
-        ds = p * (dp - delta_ref[0, 0][:, :1])
+        ds = p * (dp - delta_ref[0, 0, 0][:, :1])
         dk_acc[:] += jax.lax.dot_general(
             ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # (BK, D)
 
-    @pl.when(i == pl.num_programs(3) - 1)
+    @pl.when(i == pl.num_programs(4) - 1)
     def _finalize():
-        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
-        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+        dk_ref[0, 0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _to_bhld(x: jnp.ndarray, L: int) -> jnp.ndarray:
-    """[B, L0, H, D] -> [B, H, L, D], zero-padded to length L on the seq axis."""
-    x = x.transpose(0, 2, 1, 3)
-    if x.shape[2] != L:
-        x = jnp.pad(x, ((0, 0), (0, 0), (0, L - x.shape[2]), (0, 0)))
-    return x
-
-
-def _kvlen_array(kv_lens, B: int, H: int, Lk: int) -> jnp.ndarray:
-    """[B, H] int32 valid-key counts from a static tuple (None = all valid)."""
+def _kvlen_array(kv_lens, B: int, H: int, S: int, Lk: int) -> jnp.ndarray:
+    """[B, H, S] int32 valid-key counts from a static tuple (None = all valid)."""
     if kv_lens is None:
-        arr = np.full((B, H), Lk, np.int32)
+        arr = np.full((B, H, S), Lk, np.int32)
     else:
-        arr = np.asarray(kv_lens, np.int32).reshape(B, H)
+        arr = np.asarray(kv_lens, np.int32).reshape(B, H, S)
     return jnp.asarray(arr)
 
 
+def _pad_seg(x: jnp.ndarray, M: int) -> jnp.ndarray:
+    """[B, H, S, M0, D] zero-padded to M on the per-segment axis."""
+    if x.shape[3] == M:
+        return x
+    return jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, M - x.shape[3]), (0, 0)))
+
+
 def _fwd_impl(q, k, v, kv_lens, causal, scale, block_q, block_k, interpret):
-    B, Lq, H, D = q.shape
-    Lk = k.shape[1]
-    block_q = min(block_q, _round_up(Lq, LANES))
-    block_k = min(block_k, _round_up(Lk, LANES))
-    Lqp, Lkp = _round_up(Lq, block_q), _round_up(Lk, block_k)
-    qp, kp, vp = _to_bhld(q, Lqp), _to_bhld(k, Lkp), _to_bhld(v, Lkp)
-    nq, nk = Lqp // block_q, Lkp // block_k
-    kvlen = _kvlen_array(kv_lens, B, H, Lk)
+    """Segment-batched flash forward on [B, H, S, M, D] -> (out, lse [B,H,S,M]).
+
+    Each of the S segments attends independently (block-diagonal attention);
+    the segment axis is a grid dimension, so segmented layouts coming from
+    dilated attention need no batch-axis reshuffling.
+    """
+    B, H, S, Mq, D = q.shape
+    Mk = k.shape[3]
+    block_q = min(block_q, _round_up(Mq, LANES))
+    block_k = min(block_k, _round_up(Mk, LANES))
+    Mqp, Mkp = _round_up(Mq, block_q), _round_up(Mk, block_k)
+    qp, kp, vp = _pad_seg(q, Mqp), _pad_seg(k, Mkp), _pad_seg(v, Mkp)
+    nq, nk = Mqp // block_q, Mkp // block_k
+    kvlen = _kvlen_array(kv_lens, B, H, S, Mk)
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k,
     )
-    q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0), memory_space=pltpu.VMEM)
-    k_spec = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0), memory_space=pltpu.VMEM)
-    kvlen_spec = pl.BlockSpec(memory_space=pltpu.SMEM)  # whole (B,H) array; indexed by program_id
+    q_spec = pl.BlockSpec((1, 1, 1, block_q, D), lambda b, h, s, i, j: (b, h, s, i, 0), memory_space=pltpu.VMEM)
+    k_spec = pl.BlockSpec((1, 1, 1, block_k, D), lambda b, h, s, i, j: (b, h, s, j, 0), memory_space=pltpu.VMEM)
+    kvlen_spec = pl.BlockSpec(memory_space=pltpu.SMEM)  # whole (B,H,S) array; indexed by program_id
     out, lse = pl.pallas_call(
         kernel,
-        grid=(B, H, nq, nk),
+        grid=(B, H, S, nq, nk),
         in_specs=[q_spec, k_spec, k_spec, kvlen_spec],
         out_specs=[
             q_spec,
-            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, i, j: (b, h, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, 1, block_q, LANES), lambda b, h, s, i, j: (b, h, s, i, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, Lqp, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, Lqp, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, S, Mqp, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, S, Mqp, LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, LANES), jnp.float32),
@@ -274,31 +279,33 @@ def _fwd_impl(q, k, v, kv_lens, causal, scale, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qp, kp, vp, kvlen)
-    return out[:, :, :Lq].transpose(0, 2, 1, 3), lse[:, :, :Lq, 0]
+    return out[:, :, :, :Mq], lse[:, :, :, :Mq, 0]
 
 
 def _bwd_impl(q, k, v, lse, delta, do, kv_lens, causal, scale, block_q, block_k, interpret):
-    B, Lq, H, D = q.shape
-    Lk = k.shape[1]
-    block_q = min(block_q, _round_up(Lq, LANES))
-    block_k = min(block_k, _round_up(Lk, LANES))
-    Lqp, Lkp = _round_up(Lq, block_q), _round_up(Lk, block_k)
-    qp, kp, vp = _to_bhld(q, Lqp), _to_bhld(k, Lkp), _to_bhld(v, Lkp)
-    dop = _to_bhld(do, Lqp)
+    B, H, S, Mq, D = q.shape
+    Mk = k.shape[3]
+    block_q = min(block_q, _round_up(Mq, LANES))
+    block_k = min(block_k, _round_up(Mk, LANES))
+    Mqp, Mkp = _round_up(Mq, block_q), _round_up(Mk, block_k)
+    qp, kp, vp = _pad_seg(q, Mqp), _pad_seg(k, Mkp), _pad_seg(v, Mkp)
+    dop = _pad_seg(do, Mqp)
     # lse/delta carried at LANES width for TPU tiling; padded q rows get
     # lse=0, which is harmless (their p rows multiply masked ds/do = 0)
     lsep = jnp.broadcast_to(
-        jnp.pad(lse, ((0, 0), (0, 0), (0, Lqp - Lq)))[..., None], (B, H, Lqp, LANES)
+        jnp.pad(lse, ((0, 0), (0, 0), (0, 0), (0, Mqp - Mq)))[..., None],
+        (B, H, S, Mqp, LANES),
     )
     deltap = jnp.broadcast_to(
-        jnp.pad(delta, ((0, 0), (0, 0), (0, Lqp - Lq)))[..., None], (B, H, Lqp, LANES)
+        jnp.pad(delta, ((0, 0), (0, 0), (0, 0), (0, Mqp - Mq)))[..., None],
+        (B, H, S, Mqp, LANES),
     )
-    nq, nk = Lqp // block_q, Lkp // block_k
-    kvlen = _kvlen_array(kv_lens, B, H, Lk)
+    nq, nk = Mqp // block_q, Mkp // block_k
+    kvlen = _kvlen_array(kv_lens, B, H, S, Mk)
 
-    q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0), memory_space=pltpu.VMEM)
-    k_spec = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0), memory_space=pltpu.VMEM)
-    vec_spec = pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, i, j: (b, h, i, 0), memory_space=pltpu.VMEM)
+    q_spec = pl.BlockSpec((1, 1, 1, block_q, D), lambda b, h, s, i, j: (b, h, s, i, 0), memory_space=pltpu.VMEM)
+    k_spec = pl.BlockSpec((1, 1, 1, block_k, D), lambda b, h, s, i, j: (b, h, s, j, 0), memory_space=pltpu.VMEM)
+    vec_spec = pl.BlockSpec((1, 1, 1, block_q, LANES), lambda b, h, s, i, j: (b, h, s, i, 0), memory_space=pltpu.VMEM)
     kvlen_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
 
     dq = pl.pallas_call(
@@ -306,29 +313,29 @@ def _bwd_impl(q, k, v, lse, delta, do, kv_lens, causal, scale, block_q, block_k,
             _dq_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k,
         ),
-        grid=(B, H, nq, nk),
+        grid=(B, H, S, nq, nk),
         in_specs=[q_spec, k_spec, k_spec, q_spec, vec_spec, vec_spec, kvlen_spec],
         out_specs=[q_spec],
-        out_shape=[jax.ShapeDtypeStruct((B, H, Lqp, D), q.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((B, H, S, Mqp, D), q.dtype)],
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
     )(qp, kp, vp, dop, lsep, deltap, kvlen)[0]
 
-    # grid (B, H, nk, nq): index maps see (b, h, j, i)
-    q_spec_kv = pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0), memory_space=pltpu.VMEM)
-    k_spec_kv = pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0), memory_space=pltpu.VMEM)
-    vec_spec_kv = pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, j, i: (b, h, i, 0), memory_space=pltpu.VMEM)
+    # grid (B, H, S, nk, nq): index maps see (b, h, s, j, i)
+    q_spec_kv = pl.BlockSpec((1, 1, 1, block_q, D), lambda b, h, s, j, i: (b, h, s, i, 0), memory_space=pltpu.VMEM)
+    k_spec_kv = pl.BlockSpec((1, 1, 1, block_k, D), lambda b, h, s, j, i: (b, h, s, j, 0), memory_space=pltpu.VMEM)
+    vec_spec_kv = pl.BlockSpec((1, 1, 1, block_q, LANES), lambda b, h, s, j, i: (b, h, s, i, 0), memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k,
         ),
-        grid=(B, H, nk, nq),
+        grid=(B, H, S, nk, nq),
         in_specs=[q_spec_kv, k_spec_kv, k_spec_kv, q_spec_kv, vec_spec_kv, vec_spec_kv, kvlen_spec],
         out_specs=[k_spec_kv, k_spec_kv],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, Lkp, D), k.dtype),
-            jax.ShapeDtypeStruct((B, H, Lkp, D), v.dtype),
+            jax.ShapeDtypeStruct((B, H, S, Mkp, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, S, Mkp, D), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),
@@ -337,44 +344,69 @@ def _bwd_impl(q, k, v, lse, delta, do, kv_lens, causal, scale, block_q, block_k,
         interpret=interpret,
     )(qp, kp, vp, dop, lsep, deltap, kvlen)
     return (
-        dq[:, :, :Lq].transpose(0, 2, 1, 3),
-        dk[:, :, :Lk].transpose(0, 2, 1, 3),
-        dv[:, :, :Lk].transpose(0, 2, 1, 3),
+        dq[:, :, :, :Mq],
+        dk[:, :, :, :Mk],
+        dv[:, :, :, :Mk],
     )
 
 
-def _flash_fwd_rule(q, k, v, kv_lens, causal, interpret):
+def _flash_fwd_rule(kv_lens, causal, interpret, block_q, block_k, q, k, v):
     scale = q.shape[-1] ** -0.5
     out, lse = _fwd_impl(
-        q, k, v, kv_lens, causal, scale, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, interpret
+        q, k, v, kv_lens, causal, scale, block_q, block_k, interpret
     )
     return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(kv_lens, causal, interpret, res, cotangents):
+def _flash_bwd_rule(kv_lens, causal, interpret, block_q, block_k, res, cotangents):
     q, k, v, out, lse = res
     do, _dlse = cotangents  # no gradient flows through the lse output
     scale = q.shape[-1] ** -0.5
     delta = jnp.sum(
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
-    ).transpose(0, 2, 1)  # [B, H, Lq]
+    )  # [B, H, S, Mq]
     dq, dk, dv = _bwd_impl(
         q, k, v, lse, delta, do, kv_lens, causal, scale,
-        DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, interpret,
+        block_q, block_k, interpret,
     )
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_with_lse(q, k, v, kv_lens, causal, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _flash_with_lse(kv_lens, causal, interpret, block_q, block_k, q, k, v):
     out, lse = _fwd_impl(
         q, k, v, kv_lens, causal, q.shape[-1] ** -0.5,
-        DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, interpret,
+        block_q, block_k, interpret,
     )
     return out, lse
 
 
 _flash_with_lse.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def pallas_segment_flash(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    is_causal: bool = False,
+    kv_len=None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Segment-batched flash attention on [B, H, S, M, D] (head-major layout).
+
+    Returns ``(out [B,H,S,M,D], lse [B,H,S,M])``. Segment ``s`` of batch/head
+    ``(b, h)`` attends only within itself. ``kv_len``: optional static
+    [B, H, S] array-like of valid key counts per segment (numpy, trace-time
+    constant); fully-padded key *blocks* are skipped entirely, so generous
+    segment padding costs DMA but no MXU work.
+    """
+    kv_lens = None
+    if kv_len is not None:
+        kv_lens = tuple(int(x) for x in np.asarray(kv_len).reshape(-1))
+    return _flash_with_lse(kv_lens, is_causal, interpret, block_q, block_k, q, k, v)
 
 
 def pallas_flash_attention(
@@ -391,9 +423,20 @@ def pallas_flash_attention(
     ``kv_len``: optional static [B, H] array-like of per-(batch, head) valid
     key counts (ragged masking for dilated-attention tail segments); must be
     trace-time constants (numpy, not traced arrays).
+
+    Thin wrapper over :func:`pallas_segment_flash` with a single segment:
+    kernels run on ``[B, H, S, M, D]`` blocks — the head-major layout whose
+    trailing block dims satisfy Mosaic's (8, 128) tiling rule — and the
+    wrapper transposes (XLA folds the relayout into surrounding reshapes).
     """
     B, Lq, H, D = q.shape
     kv_lens = None
     if kv_len is not None:
         kv_lens = tuple(int(x) for x in np.asarray(kv_len).reshape(B * H))
-    return _flash_with_lse(q, k, v, kv_lens, is_causal, interpret)
+    q5 = q.transpose(0, 2, 1, 3)[:, :, None]
+    k5 = k.transpose(0, 2, 1, 3)[:, :, None]
+    v5 = v.transpose(0, 2, 1, 3)[:, :, None]
+    out, lse = _flash_with_lse(
+        kv_lens, is_causal, interpret, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, q5, k5, v5
+    )
+    return out[:, :, 0].transpose(0, 2, 1, 3), lse[:, :, 0]

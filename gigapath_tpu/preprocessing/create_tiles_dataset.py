@@ -86,11 +86,28 @@ def generate_tiles(
         slide_image, tile_size=tile_size, constant_values=255
     )
     logging.info(f"Tiled {slide_image.shape} to {image_tiles.shape}")
-    foreground_mask, _ = segment_foreground(image_tiles, foreground_threshold)
-    selected, occupancies = select_tiles(foreground_mask, occupancy_threshold)
-    # select_tiles squeezes to scalars for a single-tile slide
-    selected = np.atleast_1d(selected)
-    occupancies = np.atleast_1d(occupancies)
+    if occupancy_threshold < 0.0 or occupancy_threshold > 1.0:
+        raise ValueError("Tile occupancy threshold must be between 0 and 1")
+    if isinstance(foreground_threshold, (int, float)) and image_tiles.dtype == np.uint8:
+        # fixed threshold (Otsu already ran at ROI load): the luminance +
+        # compare + occupancy mean collapses into one pass through the
+        # native C++ kernel. Exact integer luminance counts (the kernel and
+        # its numpy fallback are bit-identical) — deliberately *better* math
+        # than the reference's lossy fp16-accumulated means
+        # (select_tiles:38); the fp16 cast below only keeps the stored
+        # occupancy dtype for csv parity.
+        from gigapath_tpu import native
+
+        occupancies = native.luminance_occupancy(
+            image_tiles, float(foreground_threshold)
+        ).astype(np.float16)
+        selected = occupancies > occupancy_threshold
+    else:
+        foreground_mask, _ = segment_foreground(image_tiles, foreground_threshold)
+        selected, occupancies = select_tiles(foreground_mask, occupancy_threshold)
+        # select_tiles squeezes to scalars for a single-tile slide
+        selected = np.atleast_1d(selected)
+        occupancies = np.atleast_1d(occupancies)
     n_discarded = int((~selected).sum())
     logging.info(f"Percentage tiles discarded: {n_discarded / len(selected) * 100:.2f}")
 

@@ -155,10 +155,10 @@ def train_model(
     opt_state = tx.init(params)
 
     @jax.jit
-    def step(params, opt_state, x, c, y):
+    def step(params, opt_state, x, c, y, rng):
         def loss_fn(p):
             logits = model.apply({"params": p}, x, c, deterministic=False,
-                                 rngs={"dropout": jax.random.PRNGKey(0)})
+                                 rngs={"dropout": rng})
             return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
@@ -167,15 +167,20 @@ def train_model(
 
     os.makedirs(output_dir, exist_ok=True)
     history = []
+    # run seed; a fresh per-step dropout key is split off below (a constant
+    # key would freeze one dropout mask for the whole run)
+    rng = jax.random.PRNGKey(0)
     for epoch in range(num_epochs):
         total = 0.0
         for x, c, y in zip(feats, coords, labels):
+            rng, step_rng = jax.random.split(rng)
             params, opt_state, loss = step(
                 params,
                 opt_state,
                 jnp.asarray(x[None]),
                 jnp.asarray(c[None]),
                 jnp.asarray([y]),
+                step_rng,
             )
             total += float(loss)
         history.append(total / len(feats))

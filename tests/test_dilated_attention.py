@@ -158,3 +158,148 @@ def test_seq_parallel_matches_single_device(rng):
     )
     out = fn(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4)
+
+
+class TestBHLDFastPath:
+    """Head-major (BHLD) fast path == generic path / numpy oracle.
+
+    On CPU the auto-dispatch in ``dilated_attention`` never takes this path
+    (it is TPU-only), so these tests call ``dilated_attention_bhld``
+    directly — jnp tier and Pallas tier (interpret mode) both.
+    """
+
+    CASES = [
+        ([(4, 1), (8, 2), (16, 4)], 32, 8),
+        ([(8, 4)], 16, 2),
+        ([(6, 2)], 13, 4),
+        ([(64, 1), (128, 2), (512, 4)], 523, 12),
+    ]
+
+    @pytest.mark.parametrize("branches,N,H", CASES)
+    def test_jnp_tier_matches_oracle(self, rng, branches, N, H):
+        from gigapath_tpu.ops.dilated_attention import dilated_attention_bhld
+
+        q, k, v = (rng.normal(size=(2, N, H, 4)).astype(np.float32) for _ in range(3))
+        out = dilated_attention_bhld(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            [sl for sl, _ in branches], [r for _, r in branches],
+            use_pallas=False,
+        )
+        ref = _np_dilated_oracle(q, k, v, branches)
+        np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5, rtol=1e-4)
+
+    @pytest.mark.parametrize("branches,N,H", CASES[:2])
+    def test_pallas_tier_matches_oracle(self, rng, branches, N, H):
+        from gigapath_tpu.ops.dilated_attention import dilated_attention_bhld
+
+        q, k, v = (rng.normal(size=(2, N, H, 4)).astype(np.float32) for _ in range(3))
+        out = dilated_attention_bhld(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            [sl for sl, _ in branches], [r for _, r in branches],
+            use_pallas=True, interpret=True,
+        )
+        ref = _np_dilated_oracle(q, k, v, branches)
+        np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5, rtol=1e-4)
+
+    def test_valid_len_matches_generic(self, rng):
+        from gigapath_tpu.ops.dilated_attention import dilated_attention_bhld
+
+        q, k, v = (jnp.asarray(rng.normal(size=(2, 40, 4, 8)), jnp.float32) for _ in range(3))
+        ref = dilated_attention(q, k, v, [8, 16], [1, 2], valid_len=29)
+        out = dilated_attention_bhld(q, k, v, [8, 16], [1, 2], valid_len=29, use_pallas=False)
+        np.testing.assert_allclose(
+            np.asarray(out[:, :29]), np.asarray(ref[:, :29]), atol=2e-5, rtol=1e-4
+        )
+
+    def test_causal_matches_generic(self, rng):
+        from gigapath_tpu.ops.dilated_attention import dilated_attention_bhld
+
+        q, k, v = (jnp.asarray(rng.normal(size=(1, 32, 4, 8)), jnp.float32) for _ in range(3))
+        ref = dilated_attention(q, k, v, [8, 32], [1, 2], is_causal=True)
+        out = dilated_attention_bhld(q, k, v, [8, 32], [1, 2], is_causal=True, use_pallas=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4)
+
+    def test_gradients_match_generic(self, rng):
+        from gigapath_tpu.ops.dilated_attention import dilated_attention_bhld
+
+        q, k, v = (jnp.asarray(rng.normal(size=(1, 24, 4, 8)), jnp.float32) for _ in range(3))
+
+        def loss_bhld(q):
+            return dilated_attention_bhld(
+                q, k, v, [8, 16], [1, 2], use_pallas=True, interpret=True
+            ).sum()
+
+        def loss_ref(q):
+            return dilated_attention(q, k, v, [8, 16], [1, 2]).sum()
+
+        g1, g2 = jax.grad(loss_bhld)(q), jax.grad(loss_ref)(q)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=2e-4, rtol=1e-3)
+
+
+class TestFusedPhaseMajorPath:
+    """Phase-major fused kernels (pallas_dilated.py) == oracle/generic path.
+
+    CPU-only via interpret mode; on TPU these kernels back
+    ``dilated_attention_fused``.
+    """
+
+    @pytest.mark.parametrize(
+        "branches,N,H",
+        [
+            ([(4, 1), (8, 2), (16, 4)], 32, 8),
+            ([(64, 1), (128, 2), (512, 4)], 523, 16),
+        ],
+    )
+    def test_matches_oracle(self, rng, branches, N, H):
+        from gigapath_tpu.ops.dilated_attention import dilated_attention_fused
+
+        q, k, v = (rng.normal(size=(2, N, H, 4)).astype(np.float32) for _ in range(3))
+        out = dilated_attention_fused(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            [sl for sl, _ in branches], [r for _, r in branches],
+            interpret=True,
+        )
+        ref = _np_dilated_oracle(q, k, v, branches)
+        np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5, rtol=1e-4)
+
+    def test_valid_len_and_causal_match_generic(self, rng):
+        from gigapath_tpu.ops.dilated_attention import dilated_attention_fused
+
+        q, k, v = (jnp.asarray(rng.normal(size=(2, 40, 4, 8)), jnp.float32) for _ in range(3))
+        ref = dilated_attention(q, k, v, [8, 16], [1, 2], valid_len=29)
+        out = dilated_attention_fused(q, k, v, [8, 16], [1, 2], valid_len=29, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out[:, :29]), np.asarray(ref[:, :29]), atol=2e-5, rtol=1e-4
+        )
+        ref_c = dilated_attention(q, k, v, [8, 32], [1, 2], is_causal=True)
+        out_c = dilated_attention_fused(q, k, v, [8, 32], [1, 2], is_causal=True, interpret=True)
+        np.testing.assert_allclose(np.asarray(out_c), np.asarray(ref_c), atol=2e-5, rtol=1e-4)
+
+    def test_gradients_match_generic(self, rng):
+        from gigapath_tpu.ops.dilated_attention import dilated_attention_fused
+
+        q, k, v = (jnp.asarray(rng.normal(size=(1, 24, 4, 8)), jnp.float32) for _ in range(3))
+        for arg in range(3):
+            def loss_f(x, arg=arg):
+                a = [q, k, v]
+                a[arg] = x
+                return dilated_attention_fused(*a, [8, 16], [1, 2], interpret=True).sum()
+
+            def loss_r(x, arg=arg):
+                a = [q, k, v]
+                a[arg] = x
+                return dilated_attention(*a, [8, 16], [1, 2]).sum()
+
+            g1, g2 = jax.grad(loss_f)([q, k, v][arg]), jax.grad(loss_r)([q, k, v][arg])
+            np.testing.assert_allclose(
+                np.asarray(g1), np.asarray(g2), atol=2e-4, rtol=1e-3
+            )
+
+    def test_odd_ratio_falls_back(self, rng):
+        """A ratio not dividing H routes through the head-major branch."""
+        from gigapath_tpu.ops.dilated_attention import dilated_attention_fused
+
+        q, k, v = (jnp.asarray(rng.normal(size=(1, 24, 4, 8)), jnp.float32) for _ in range(3))
+        out = dilated_attention_fused(q, k, v, [8, 12], [1, 3], interpret=True)
+        ref = dilated_attention(q, k, v, [8, 12], [1, 3])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4)

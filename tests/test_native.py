@@ -17,13 +17,28 @@ def test_library_builds():
 
 
 def test_normalize_tiles_matches_numpy(rng):
+    from gigapath_tpu.models.tile_encoder import IMAGENET_MEAN, IMAGENET_STD
+
     batch = rng.integers(0, 256, (4, 32, 32, 3)).astype(np.uint8)
     out = native.normalize_tiles(batch)
     ref = (
-        (batch.astype(np.float32) / 255.0) - native.IMAGENET_MEAN
-    ) / native.IMAGENET_STD
+        (batch.astype(np.float32) / 255.0) - np.asarray(IMAGENET_MEAN, np.float32)
+    ) / np.asarray(IMAGENET_STD, np.float32)
     np.testing.assert_allclose(out, ref, atol=1e-5)
     assert out.dtype == np.float32
+
+
+def test_normalize_tiles_many_channels_falls_back(rng):
+    """channels > 8 exceeds the C kernel's affine table; numpy path must
+    kick in instead of reading past it."""
+    batch = rng.integers(0, 256, (2, 4, 4, 9)).astype(np.uint8)
+    mean = np.linspace(0.1, 0.9, 9)
+    std = np.linspace(0.5, 1.5, 9)
+    out = native.normalize_tiles(batch, mean, std)
+    ref = ((batch.astype(np.float32) / 255.0) - mean.astype(np.float32)) / std.astype(
+        np.float32
+    )
+    np.testing.assert_allclose(out, ref, atol=1e-5)
 
 
 def test_normalize_custom_stats(rng):
