@@ -66,7 +66,7 @@ def _build() -> Optional[ctypes.CDLL]:
             ctypes.c_int64, ctypes.c_float, ctypes.c_void_p,
         ]
         lib.pad_sequences.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_void_p,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
         ]
         _lib = lib
@@ -99,9 +99,10 @@ def normalize_tiles(
     mean = np.ascontiguousarray(mean, np.float32)
     std = np.ascontiguousarray(std, np.float32)
     lib = _build()
-    if lib is None or c > 8:  # kernel's per-channel table is 8 wide
+    if lib is None:
         return ((batch_u8.astype(np.float32) / 255.0) - mean) / std
     out = np.empty(batch_u8.shape, np.float32)
+    # rc != 0 = channel count outside the kernel's affine table -> numpy
     rc = lib.normalize_tiles(
         batch_u8.ctypes.data, out.ctypes.data,
         batch_u8.size // c, mean.ctypes.data, std.ctypes.data, c,
@@ -147,12 +148,13 @@ def pad_sequences(seqs: Sequence[np.ndarray], max_len: int) -> np.ndarray:
             rows = min(len(s), max_len)
             out[i, :rows] = s[:rows]
         return out
-    flat = np.ascontiguousarray(np.concatenate(seqs, axis=0), np.float32)
+    # per-sequence pointers: no concatenate (which would copy every row an
+    # extra time before the kernel copies it again)
+    seqs = [np.ascontiguousarray(s, np.float32) for s in seqs]
+    ptrs = (ctypes.c_void_p * n)(*[s.ctypes.data for s in seqs])
     lengths = np.asarray([len(s) for s in seqs], np.int64)
-    offsets = np.concatenate([[0], np.cumsum(lengths)[:-1]]).astype(np.int64)
     out = np.empty((n, max_len, dim), np.float32)
     lib.pad_sequences(
-        flat.ctypes.data, offsets.ctypes.data, lengths.ctypes.data,
-        n, max_len, dim, out.ctypes.data,
+        ptrs, lengths.ctypes.data, n, max_len, dim, out.ctypes.data
     )
     return out

@@ -68,12 +68,12 @@ void luminance_occupancy(const uint8_t* tiles, int64_t n, int64_t c,
 
 // Pad a ragged [len, dim] float32 sequence list into one [n, max_len, dim]
 // zero-padded batch (the collate hot loop, data/collate.py:pad_tensors).
-// `offsets[i]` is the row offset of sequence i in `in`; lengths[i] its rows.
-void pad_sequences(const float* in, const int64_t* offsets,
-                   const int64_t* lengths, int64_t n, int64_t max_len,
-                   int64_t dim, float* out) {
+// `seqs[i]` points at sequence i's rows — per-sequence pointers so the
+// caller never has to concatenate (a full extra copy) first.
+void pad_sequences(const float* const* seqs, const int64_t* lengths,
+                   int64_t n, int64_t max_len, int64_t dim, float* out) {
   for (int64_t i = 0; i < n; ++i) {
-    const float* src = in + offsets[i] * dim;
+    const float* src = seqs[i];
     float* dst = out + i * max_len * dim;
     const int64_t rows = lengths[i] < max_len ? lengths[i] : max_len;
     for (int64_t r = 0; r < rows * dim; ++r) {

@@ -7,10 +7,15 @@ TPU-native counterpart of reference
   into segments of ``min(sl, L)``; within a segment, heads are partitioned
   into ``r`` phase groups and head group ``p`` attends only positions
   ``p, p+r, ...`` (the reference implements this as a head-rotating
-  einops-diagonal trick, ``dense_to_sparse:16-31``; here it is a scatter-free
-  one-hot einsum select — TPU gathers/scatters over the token axis are slow,
-  a phase-mask contraction is a cheap VPU multiply-add).
+  einops-diagonal trick, ``dense_to_sparse:16-31``; here dilation is static
+  phase *slices* — every index is a trace-time constant, so XLA lowers it
+  to strided copies; TPU gathers/scatters over the token axis are slow).
 - Attention runs per sparse segment through an op returning ``(out, lse)``.
+- Three execution tiers, dispatched automatically: a head-major (BHLD)
+  Pallas fast path on TPU (one relayout per op, segment-grid flash
+  kernels), the phase-major fused kernels of
+  :mod:`gigapath_tpu.ops.pallas_dilated` (opt-in), and a generic jnp path
+  (CPU, dropout, traced masks, cross-attention, sequence parallelism).
 - Branch outputs are scattered back to dense positions (uncovered positions
   get ``lse = NEG_INF``) and fused by softmax-weighting of the LSEs across
   branches (``scattering:100-131``); like the reference, the fusion weights
@@ -37,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from gigapath_tpu.ops.attention import NEG_INF, MultiheadAttention, attention_with_lse
+from gigapath_tpu.ops.pallas_flash import round_up as _round_up
 
 AttnFn = Callable[..., Tuple[jnp.ndarray, jnp.ndarray]]
 
@@ -132,10 +138,6 @@ def sparse_to_dense(
     out_d = out_d5.reshape(b, m * ratio, H, Dh)
     lse_d = lse_d5.reshape(b, H, m * ratio)
     return out_d[:, :seg_len], lse_d[..., :seg_len]
-
-
-def _round_up(n: int, m: int) -> int:
-    return -(-n // m) * m
 
 
 def _branch_kvlen_bhld(

@@ -10,17 +10,18 @@ the attention math. This kernel removes that glue by construction:
 
 - Activations stay ``[B, L, E]`` (E = H*Dh, 128-lane aligned) end to end. The
   only relayout per branch is a *phase-major* reshape/transpose
-  ``[B, L, E] -> [B, S, r, r, M, E/r]`` splitting tokens by dilation phase
-  (dim 2) and lanes by head band (dim 3) — a single fast, clean-lane copy.
+  ``[B, L, E] -> [B, S, r, r, H/r, M, Dh]`` splitting tokens by (segment,
+  dilation phase) and lanes by (head band, head) — one transpose per tensor.
 - A dilated branch with ratio ``r`` makes head band ``p`` (heads
-  ``p*H/r .. (p+1)*H/r - 1``, lanes ``p*E/r .. (p+1)*E/r``) attend exactly
-  the tokens of phase ``p`` (positions ``s*g + p + r*j``,
-  ``dense_to_sparse`` in the reference). In the phase-major view those are
-  the *diagonal* ``(p, p)`` blocks, so the kernel grid is
-  ``(B, S, r, nq, nk)`` and every BlockSpec indexes ``(b, s, p, p, i)``:
-  dilation costs nothing inside the kernel.
-- Heads within a band are unrolled in the kernel body over *static* lane
-  slices (a band always sits at block-local lanes ``t*Dh..(t+1)*Dh``).
+  ``p*H/r .. (p+1)*H/r - 1``) attend exactly the tokens of phase ``p``
+  (positions ``s*g + p + r*j``, ``dense_to_sparse`` in the reference). In
+  the phase-major view those are the *diagonal* ``(p, p)`` blocks, so every
+  BlockSpec indexes ``(b, s, p, p, ...)``: dilation costs nothing inside
+  the kernel.
+- One head per grid cell — grid ``(B, S, r, nq, hb, nk)`` with ``[block,
+  Dh]`` blocks whose lane range the head grid index picks via the packed
+  array's 7th dim. (Unrolling a band's heads over lane slices of a single
+  ``[block, E/r]`` tile was ~1.6x slower: Mosaic lane shuffles.)
 - Off-diagonal ``(p, p')`` blocks of the outputs are never visited — they
   are exactly the (token, head) pairs this branch does not cover. Their HBM
   contents stay uninitialized; the wrapper replaces them with 0 via a
@@ -51,16 +52,15 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-NEG_INF = -1e30
-M_FLOOR = -1e20
-LANES = 128
+from gigapath_tpu.ops.pallas_flash import (  # shared kernel numerics
+    LANES,
+    M_FLOOR,
+    NEG_INF,
+    round_up as _round_up,
+)
+
 LOG2E = 1.4426950408889634
 LN2 = 0.6931471805599453
-DEFAULT_BLOCK = 512
-
-
-def _round_up(n: int, m: int) -> int:
-    return -(-n // m) * m
 
 
 # ---------------------------------------------------------------------------
