@@ -151,6 +151,13 @@ def pad_sequences(seqs: Sequence[np.ndarray], max_len: int) -> np.ndarray:
     # per-sequence pointers: no concatenate (which would copy every row an
     # extra time before the kernel copies it again)
     seqs = [np.ascontiguousarray(s, np.float32) for s in seqs]
+    for s in seqs:
+        if s.ndim != 2 or s.shape[1] != dim:
+            # the C kernel trusts `dim`; a mismatched sequence would read
+            # past its buffer (the numpy fallback raises on this too)
+            raise ValueError(
+                f"pad_sequences: expected [len, {dim}] sequences, got {s.shape}"
+            )
     ptrs = (ctypes.c_void_p * n)(*[s.ctypes.data for s in seqs])
     lengths = np.asarray([len(s) for s in seqs], np.int64)
     out = np.empty((n, max_len, dim), np.float32)

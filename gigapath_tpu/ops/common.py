@@ -1,0 +1,10 @@
+"""Tiny dependency-free helpers shared across the ops layer.
+
+Kept separate from the Pallas kernel modules so CPU-only import paths
+(e.g. the data layer pulling in dilated_attention via the model stack)
+never load ``jax.experimental.pallas`` just for arithmetic.
+"""
+
+
+def round_up(n: int, m: int) -> int:
+    return -(-n // m) * m

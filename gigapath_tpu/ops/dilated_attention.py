@@ -42,7 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from gigapath_tpu.ops.attention import NEG_INF, MultiheadAttention, attention_with_lse
-from gigapath_tpu.ops.pallas_flash import round_up as _round_up
+from gigapath_tpu.ops.common import round_up as _round_up
 
 AttnFn = Callable[..., Tuple[jnp.ndarray, jnp.ndarray]]
 
@@ -377,6 +377,11 @@ def dilated_attention_bhld(
     """
     B, L, H, Dh = q.shape
     real_len = L if valid_len is None else min(int(valid_len), L)
+    # optimization barriers pin the op's boundaries: without them XLA fuses
+    # the entry/exit relayouts into the surrounding layernorm/projection
+    # fusions, which then read the 48-lane-minor head-major layout strided
+    # (measured +0.65 ms/layer on the flagship, scripts/profile_slide.py)
+    q, k, v = jax.lax.optimization_barrier((q, k, v))
     qh = q.transpose(0, 2, 1, 3)
     kh = k.transpose(0, 2, 1, 3)
     vh = v.transpose(0, 2, 1, 3)
@@ -396,7 +401,9 @@ def dilated_attention_bhld(
         lse = jnp.stack(lses)  # [n_branch, B, H, L]
         weights = jax.nn.softmax(jax.lax.stop_gradient(lse), axis=0)[..., None]
         out = sum(o.astype(jnp.float32) * w for o, w in zip(outs, weights))
-    return out.astype(q.dtype).transpose(0, 2, 1, 3)
+    return jax.lax.optimization_barrier(
+        out.astype(q.dtype).transpose(0, 2, 1, 3)
+    )
 
 
 def _gather_kv_seq_parallel(

@@ -56,9 +56,7 @@ DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 
 
-def round_up(n: int, m: int) -> int:
-    return -(-n // m) * m
-
+from gigapath_tpu.ops.common import round_up  # noqa: E402  (re-export)
 
 _round_up = round_up  # internal alias
 
