@@ -6,6 +6,8 @@ sample download — pass a local slide path; zero-egress build).
 
 import sys
 
+import _bootstrap  # noqa: F401  (repo-checkout sys.path setup)
+
 from gigapath_tpu.data.slide_utils import find_level_for_target_mpp
 
 if __name__ == "__main__":

@@ -3,6 +3,8 @@
 import os
 import sys
 
+import _bootstrap  # noqa: F401  (repo-checkout sys.path setup)
+
 from gigapath_tpu.pipeline import tile_one_slide
 
 if __name__ == "__main__":

@@ -11,6 +11,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import _bootstrap  # noqa: F401  (repo-checkout sys.path setup)
+
 from gigapath_tpu.models import slide_encoder
 
 if __name__ == "__main__":

@@ -1,0 +1,8 @@
+"""Put the repo root on sys.path so `python demo/<script>.py` works from a
+checkout without installation (python puts demo/ itself on sys.path, which
+is how this module is found)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

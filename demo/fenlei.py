@@ -11,6 +11,8 @@ import sys
 
 import numpy as np
 
+import _bootstrap  # noqa: F401  (repo-checkout sys.path setup)
+
 from gigapath_tpu.pipeline import (
     load_tile_slide_encoder,
     run_inference_with_tile_encoder,

@@ -15,6 +15,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import _bootstrap  # noqa: F401  (repo-checkout sys.path setup)
+
 from gigapath_tpu.data.transforms import preprocess_tile
 from gigapath_tpu.models.tile_encoder import create_tile_encoder
 

@@ -7,6 +7,8 @@ import sys
 import numpy as np
 from PIL import Image
 
+import _bootstrap  # noqa: F401  (repo-checkout sys.path setup)
+
 from gigapath_tpu.preprocessing.foreground_segmentation import open_slide
 
 if __name__ == "__main__":

@@ -1,0 +1,125 @@
+"""Demo: interactive WSI pyramid viewer (reference ``demo/ndpi_viewer.py``).
+
+A matplotlib window with sliders for pyramid level and x/y position over any
+slide the repo's :class:`SlideReader` can open (OpenSlide formats incl.
+.ndpi when the C library is present; plain images via the pyramid
+fallback). Pass ``--headless OUT.png`` to render one view to a file
+instead of opening a window (CI / no-display environments).
+
+Usage:
+    python demo/ndpi_viewer.py slide.ndpi
+    python demo/ndpi_viewer.py slide.ndpi --headless outputs/view.png
+"""
+
+import argparse
+import os
+
+import numpy as np
+
+import _bootstrap  # noqa: F401  (repo-checkout sys.path setup)
+
+from gigapath_tpu.preprocessing.foreground_segmentation import open_slide
+
+VIEW = 1000  # viewport edge in pixels at the selected level
+
+
+class NDPIViewer:
+    """Level/x/y slider viewer over a pyramid reader (reference
+    ``NDPIViewer:9-241``, rebuilt on the repo's reader abstraction)."""
+
+    def __init__(self, path: str, headless_out: str | None = None):
+        self.reader = open_slide(path)
+        self.filename = os.path.basename(path)
+        self.level = self.reader.level_count - 1  # start at lowest resolution
+        self.x = 0
+        self.y = 0
+
+        print(f"file: {self.filename}")
+        print(f"dimensions: {self.reader.dimensions}")
+        print(f"levels: {self.reader.level_count}")
+        for i in range(self.reader.level_count):
+            w, h = self.reader.level_dimensions[i]
+            print(f"  level {i}: {w} x {h} (downsample {self.reader.level_downsamples[i]})")
+
+        if headless_out:
+            self._save(headless_out)
+        else:
+            self._run_interactive()
+
+    def _view(self) -> np.ndarray:
+        w, h = self.reader.level_dimensions[self.level]
+        vw, vh = min(VIEW, w), min(VIEW, h)
+        x = int(min(self.x, w - vw))
+        y = int(min(self.y, h - vh))
+        # sliders move in level-local pixels; the reader takes (y, x) in
+        # LEVEL-0 coordinates (foreground_segmentation.py:89-92) with the
+        # size in level pixels — scale by the level's downsample
+        ds = self.reader.level_downsamples[self.level]
+        arr = self.reader.read_region(
+            (int(y * ds), int(x * ds)), self.level, (vh, vw)
+        )
+        return np.moveaxis(arr, 0, -1)
+
+    def _save(self, out_path: str):
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        plt.figure(figsize=(10, 8))
+        plt.imshow(self._view())
+        plt.title(f"{self.filename} — level {self.level} @ ({self.x}, {self.y})")
+        plt.axis("off")
+        plt.savefig(out_path, bbox_inches="tight")
+        print("saved", out_path)
+
+    def _run_interactive(self):
+        import matplotlib.pyplot as plt
+        from matplotlib.widgets import Slider
+
+        self.fig, self.ax = plt.subplots(figsize=(10, 8))
+        plt.subplots_adjust(bottom=0.25)
+        self.image = self.ax.imshow(self._view())
+        self.ax.set_title(self.filename)
+        self.ax.axis("off")
+
+        ax_level = plt.axes([0.25, 0.15, 0.65, 0.03])
+        ax_x = plt.axes([0.25, 0.10, 0.65, 0.03])
+        ax_y = plt.axes([0.25, 0.05, 0.65, 0.03])
+        w0, h0 = self.reader.level_dimensions[self.level]
+        self.s_level = Slider(
+            ax_level, "level", 0, self.reader.level_count - 1,
+            valinit=self.level, valstep=1,
+        )
+        self.s_x = Slider(ax_x, "x", 0, max(1, w0 - VIEW), valinit=0, valstep=1)
+        self.s_y = Slider(ax_y, "y", 0, max(1, h0 - VIEW), valinit=0, valstep=1)
+
+        def update(_):
+            level = int(self.s_level.val)
+            if level != self.level:
+                self.level = level
+                w, h = self.reader.level_dimensions[level]
+                # re-range the position sliders for the new level
+                self.s_x.valmax = max(1, w - VIEW)
+                self.s_y.valmax = max(1, h - VIEW)
+                self.s_x.ax.set_xlim(0, self.s_x.valmax)
+                self.s_y.ax.set_xlim(0, self.s_y.valmax)
+            self.x = int(self.s_x.val)
+            self.y = int(self.s_y.val)
+            self.image.set_data(self._view())
+            self.fig.canvas.draw_idle()
+
+        self.s_level.on_changed(update)
+        self.s_x.on_changed(update)
+        self.s_y.on_changed(update)
+        plt.show()
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("slide", help="path to a WSI (.ndpi/.svs/.tiff) or image")
+    ap.add_argument("--headless", metavar="OUT", default=None,
+                    help="render one view to OUT instead of opening a window")
+    args = ap.parse_args()
+    NDPIViewer(args.slide, headless_out=args.headless)

@@ -8,6 +8,8 @@ import glob
 import os
 import sys
 
+import _bootstrap  # noqa: F401  (repo-checkout sys.path setup)
+
 from gigapath_tpu.pipeline import (
     load_tile_slide_encoder,
     run_inference_with_slide_encoder,

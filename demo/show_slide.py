@@ -5,6 +5,8 @@ import sys
 
 import numpy as np
 
+import _bootstrap  # noqa: F401  (repo-checkout sys.path setup)
+
 from gigapath_tpu.preprocessing.foreground_segmentation import open_slide
 
 if __name__ == "__main__":

@@ -14,6 +14,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import _bootstrap  # noqa: F401  (repo-checkout sys.path setup)
+
 from gigapath_tpu.pipeline import (
     load_tile_slide_encoder,
     run_inference_with_slide_encoder,

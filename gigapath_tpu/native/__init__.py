@@ -141,6 +141,15 @@ def pad_sequences(seqs: Sequence[np.ndarray], max_len: int) -> np.ndarray:
     """List of float32 [len_i, dim] -> zero-padded [n, max_len, dim]."""
     n = len(seqs)
     dim = seqs[0].shape[1]
+    seqs = [np.ascontiguousarray(s, np.float32) for s in seqs]
+    for s in seqs:
+        # validated for BOTH paths: the C kernel trusts `dim` (a mismatch
+        # would read past the buffer) and the numpy fallback would silently
+        # broadcast
+        if s.ndim != 2 or s.shape[1] != dim:
+            raise ValueError(
+                f"pad_sequences: expected [len, {dim}] sequences, got {s.shape}"
+            )
     lib = _build()
     if lib is None:
         out = np.zeros((n, max_len, dim), np.float32)
@@ -150,14 +159,6 @@ def pad_sequences(seqs: Sequence[np.ndarray], max_len: int) -> np.ndarray:
         return out
     # per-sequence pointers: no concatenate (which would copy every row an
     # extra time before the kernel copies it again)
-    seqs = [np.ascontiguousarray(s, np.float32) for s in seqs]
-    for s in seqs:
-        if s.ndim != 2 or s.shape[1] != dim:
-            # the C kernel trusts `dim`; a mismatched sequence would read
-            # past its buffer (the numpy fallback raises on this too)
-            raise ValueError(
-                f"pad_sequences: expected [len, {dim}] sequences, got {s.shape}"
-            )
     ptrs = (ctypes.c_void_p * n)(*[s.ctypes.data for s in seqs])
     lengths = np.asarray([len(s) for s in seqs], np.int64)
     out = np.empty((n, max_len, dim), np.float32)
