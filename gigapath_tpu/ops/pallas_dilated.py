@@ -54,13 +54,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 from gigapath_tpu.ops.pallas_flash import (  # shared kernel numerics
     LANES,
+    LN2,
+    LOG2E,
     M_FLOOR,
     NEG_INF,
     round_up as _round_up,
 )
-
-LOG2E = 1.4426950408889634
-LN2 = 0.6931471805599453
 
 
 # ---------------------------------------------------------------------------

@@ -21,10 +21,14 @@ Performance notes (v5e measurements in scripts/profile_slide.py):
 - the softmax scale is folded into the small q block (``block_q x D``
   elements) instead of the ``block_q x block_k`` logits — the inner loop is
   VPU-bound, so per-logit ops are what matter;
-- masked slots rely on exp underflow instead of a second ``where``: the
-  running max is floored at ``M_FLOOR`` so ``exp(NEG_INF - m)`` is exactly
-  0.0 in fp32, which also makes fully-masked rows produce out=0 and
-  lse ~ -1e20 (ignored by the branch fusion) without extra per-element work;
+- the online softmax runs in base-2 units (log2(e) folded into the q
+  scale, ``exp2`` in the hot loop — one fewer VPU pass per logit than
+  ``exp``); the emitted lse is converted back to natural log;
+- masked slots rely on exp2 underflow instead of a second ``where``: the
+  running max is floored at ``M_FLOOR`` so ``exp2(NEG_INF - m)`` is exactly
+  0.0 in fp32, which also makes fully-masked rows produce out=0 and an lse
+  sentinel of ~ -7e19 (ignored by the branch fusion) without extra
+  per-element work;
 - head_dim is NOT padded: a block whose last dim equals the full array dim
   satisfies TPU tiling, and padding 64 -> 128 lanes would waste 2x MXU
   work on the contractions;
@@ -49,6 +53,8 @@ NEG_INF = -1e30
 # high enough that exp(NEG_INF - M_FLOOR) == 0.0 exactly in fp32.
 M_FLOOR = -1e20
 LANES = 128
+LOG2E = 1.4426950408889634
+LN2 = 0.6931471805599453
 # 1024x1024 blocks measured ~2.3x faster than 512x1024 on the LongNet branch
 # shapes (v5e, head_dim 48): fewer K/V restreams per q row and fuller MXU
 # rows; fp32 logits block = 4 MB, comfortably under the 16 MB VMEM budget.
@@ -74,12 +80,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref, m_ref, l_ref, ac
 
     @pl.when(j * block_k < kvlen_ref[b, h, sg])
     def _compute():
-        # scale folded into q: block_q*D elements instead of block_q*block_k
-        q = (q_ref[0, 0, 0].astype(jnp.float32) * scale).astype(q_ref.dtype)
+        # scale (with log2(e) folded in: the hot loop runs exp2, one fewer
+        # VPU pass per logit than exp) applied to q: block_q*D elements
+        # instead of block_q*block_k
+        q = (q_ref[0, 0, 0].astype(jnp.float32) * (scale * LOG2E)).astype(q_ref.dtype)
         k = k_ref[0, 0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (BQ, BK)
+        )  # (BQ, BK), in log2 units
 
         if causal:
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
@@ -95,7 +103,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref, m_ref, l_ref, ac
         # becomes nonzero after the first residual layer), so they must hit
         # NEG_INF *before* the running max — a post-hoc p multiply would let
         # them raise m_new and underflow valid rows. M_FLOOR keeps m_new
-        # finite even for fully-masked rows, so exp(NEG_INF - m_new)
+        # finite even for fully-masked rows, so exp2(NEG_INF - m_new)
         # underflows to exactly 0.
         col_bias = jnp.where(
             jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1) + j * block_k
@@ -105,8 +113,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref, m_ref, l_ref, ac
         )
         s = s + col_bias
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp2(s - m_new)
+        alpha = jnp.exp2(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0, 0, 0], (((1,), (0,)), ((), ())),
@@ -120,10 +128,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref, m_ref, l_ref, ac
         l = l_ref[:, :1]
         safe_l = jnp.maximum(l, 1e-30)
         o_ref[0, 0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
-        # lse carried at LANES width (TPU tiling needs a 128-lane last dim);
-        # the wrapper slices lane 0
+        # natural-log lse recovered from the base-2 running stats; carried
+        # at LANES width (TPU tiling needs a 128-lane last dim); the
+        # wrapper slices lane 0
         lse_ref[0, 0, 0] = jnp.broadcast_to(
-            m_ref[:, :1] + jnp.log(safe_l), (block_q, LANES)
+            (m_ref[:, :1] + jnp.log2(safe_l)) * LN2, (block_q, LANES)
         )
 
 
@@ -140,9 +149,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dq_re
     def _compute():
         q = q_ref[0, 0, 0]
         k = k_ref[0, 0, 0]
+        # log2-units recompute (exp2 is one fewer VPU pass than exp); the
+        # natural-log lse is rescaled on its [bq, 1] column, not per logit
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
+        ) * (scale * LOG2E)
         # column-bias masking BEFORE the exp (see the forward kernel): a
         # post-hoc zero-multiply would compute exp of unbounded masked
         # logits — inf * 0 = NaN in the gradients
@@ -152,7 +163,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dq_re
             0.0,
             NEG_INF,
         )
-        p = jnp.exp(s + col_bias - lse_ref[0, 0, 0][:, :1])
+        p = jnp.exp2(s + col_bias - lse_ref[0, 0, 0][:, :1] * LOG2E)
         if causal:
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
             rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
@@ -189,14 +200,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dk_r
         k = k_ref[0, 0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # (BQ, BK)
+        ) * (scale * LOG2E)  # (BQ, BK), log2 units (see _dq_kernel)
         col_bias = jnp.where(
             jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1) + j * block_k
             < kvlen_ref[b, h, sg],
             0.0,
             NEG_INF,
         )
-        p = jnp.exp(s + col_bias - lse_ref[0, 0, 0][:, :1])  # (BQ, BK)
+        p = jnp.exp2(s + col_bias - lse_ref[0, 0, 0][:, :1] * LOG2E)  # (BQ, BK)
         if causal:
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
             rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
