@@ -36,7 +36,7 @@ the attention math. This kernel removes that glue by construction:
 Same numerics as ``pallas_flash.py``: fp32 online softmax (base-2 in the
 forward: log2(e) folds into the q scale so the hot loop runs ``exp2``),
 running max floored at ``M_FLOOR`` so masked/padded slots underflow to
-exactly 0 and fully-masked rows produce out=0 / lse ~ -1e20, ragged tails
+exactly 0 and fully-masked rows produce out=0 / lse ~ -7e19, ragged tails
 masked from an SMEM table of per-(segment, phase) valid counts with
 fully-masked key blocks skipped.
 """

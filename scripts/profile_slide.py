@@ -6,7 +6,12 @@ Times (with the chained-fori_loop recipe from utils/timing.py):
   3. each dilated branch alone
   4. a matmul-only proxy of one encoder layer's GEMMs (qkvo + ffn)
 
-Usage: python scripts/profile_slide.py [N]
+With ``--attr``, instead traces a depth-2 model with jax.profiler and
+prints the critical-path time per HLO op kind (summing only the
+``XLA Ops`` trace line — the async line double-counts overlapped DMA).
+This is the attribution recipe PERFORMANCE.md's numbers come from.
+
+Usage: python scripts/profile_slide.py [N] [--attr]
 """
 
 import os
@@ -20,8 +25,11 @@ import numpy as np
 
 from gigapath_tpu.utils.timing import chained_seconds_per_iter
 
-N = int(sys.argv[1]) if len(sys.argv) > 1 else 10240
-D, H, HD, FFN = 768, 12, 64, 3072
+ARGS = [a for a in sys.argv[1:] if not a.startswith("-")]
+ATTR = "--attr" in sys.argv[1:]
+N = int(ARGS[0]) if ARGS else 10240
+# flagship gigapath_slide_enc12l768d geometry: 16 heads x 48 head-dim
+D, H, HD, FFN = 768, 16, 48, 3072
 SEGS = [1024, 5792, 32768, 185363, 1048576]
 RATIOS = [1, 2, 4, 8, 16]
 
@@ -32,6 +40,57 @@ def timeit(name, step, x0, args=(), lo=4, hi=24):
     )
     print(f"{name:40s} {sec*1e3:9.3f} ms")
     return sec
+
+
+def attribute():
+    """Critical-path ms per HLO op kind for a depth-2 model at N tokens."""
+    import collections
+    import glob
+    import re
+    import tempfile
+
+    from jax.profiler import ProfileData
+
+    from gigapath_tpu.models.slide_encoder import LongNetViT
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(1, N, 1536)), jnp.bfloat16)
+    coords = jnp.asarray(rng.uniform(0, 250000, (1, N, 2)), jnp.float32)
+    model = LongNetViT(depth=2, embed_dim=768, dtype=jnp.bfloat16)
+    params = model.init(jax.random.PRNGKey(0), x, coords)["params"]
+    f = jax.jit(lambda x, c: model.apply({"params": params}, x, c)[0])
+    f(x, coords).block_until_ready()
+    d = tempfile.mkdtemp()
+    iters = 3
+    with jax.profiler.trace(d):
+        for _ in range(iters):
+            out = f(x, coords)
+        out.block_until_ready()
+    traces = sorted(glob.glob(d + "/**/*.xplane.pb", recursive=True))
+    if not traces:
+        raise RuntimeError(f"jax.profiler wrote no .xplane.pb under {d}")
+    pd = ProfileData.from_file(traces[-1])
+    found = False
+    for plane in pd.planes:
+        if "TPU" not in plane.name:
+            continue
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            tot = collections.Counter()
+            for ev in line.events:
+                nm = ev.name.split("=")[0].strip().lstrip("%")
+                tot[re.sub(r"[.\d]+$", "", nm.split(" ")[0])] += ev.duration_ns
+            print(f"depth-2 critical path at N={N} (ms/iter by op kind):")
+            for name, ns in tot.most_common(15):
+                print(f"  {ns/1e6/iters:9.4f} ms  {name}")
+            found = True
+        break
+    if not found:
+        raise RuntimeError(
+            "no TPU 'XLA Ops' line in the trace — is a TPU backend active? "
+            f"(jax.default_backend() = {jax.default_backend()})"
+        )
 
 
 def main():
@@ -96,4 +155,4 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    attribute() if ATTR else main()
