@@ -33,11 +33,13 @@ import numpy as np
 
 N = 10240
 
-# flagship gigapath_slide_enc12l768d geometry (slide_encoder.py / LongNet
-# config LongNet_12_layers_768_dim): reference slide_encoder.py:137-154
-DEPTH, E, HEADS, FFN, IN_CHANS = 12, 768, 16, 3072, 1536
-SEGS = [1024, 5792, 32768, 185363, 1048576]
-RATIOS = [1, 2, 4, 8, 16]
+# flagship gigapath_slide_enc12l768d geometry, from the single source of
+# truth (reference slide_encoder.py:137-154)
+from gigapath_tpu.models.longnet_config import flagship_geometry
+
+_G = flagship_geometry()
+DEPTH, E, FFN, IN_CHANS = _G["depth"], _G["embed_dim"], _G["ffn_dim"], _G["in_chans"]
+SEGS, RATIOS = _G["segment_lengths"], _G["dilated_ratios"]
 A100_FP16_FLOPS = 312e12
 A100_MFU = 0.35
 

@@ -92,3 +92,24 @@ def get_config(name: str) -> dict:
 
 def list_configs() -> List[str]:
     return sorted(REGISTRY)
+
+
+def flagship_geometry() -> dict:
+    """Single source of truth for the flagship slide encoder's geometry
+    (gigapath_slide_enc12l768d): benchmark/profiling scripts derive shapes
+    from here instead of re-hardcoding them (bench.py, scripts/)."""
+    from gigapath_tpu.models.slide_encoder import get_optimal_segment_length
+
+    cfg = get_config("LongNet_12_layers_768_dim")
+    heads = cfg["encoder_attention_heads"]
+    dim = cfg["encoder_embed_dim"]
+    return {
+        "depth": cfg["encoder_layers"],
+        "embed_dim": dim,
+        "heads": heads,
+        "head_dim": dim // heads,
+        "ffn_dim": cfg["encoder_ffn_embed_dim"],
+        "in_chans": 1536,
+        "segment_lengths": get_optimal_segment_length(),
+        "dilated_ratios": [1, 2, 4, 8, 16],
+    }

@@ -28,10 +28,11 @@ from gigapath_tpu.utils.timing import chained_seconds_per_iter
 ARGS = [a for a in sys.argv[1:] if not a.startswith("-")]
 ATTR = "--attr" in sys.argv[1:]
 N = int(ARGS[0]) if ARGS else 10240
-# flagship gigapath_slide_enc12l768d geometry: 16 heads x 48 head-dim
-D, H, HD, FFN = 768, 16, 48, 3072
-SEGS = [1024, 5792, 32768, 185363, 1048576]
-RATIOS = [1, 2, 4, 8, 16]
+from gigapath_tpu.models.longnet_config import flagship_geometry  # noqa: E402
+
+_G = flagship_geometry()
+D, H, HD, FFN = _G["embed_dim"], _G["heads"], _G["head_dim"], _G["ffn_dim"]
+SEGS, RATIOS = _G["segment_lengths"], _G["dilated_ratios"]
 
 
 def timeit(name, step, x0, args=(), lo=4, hi=24):
@@ -80,12 +81,13 @@ def attribute():
             tot = collections.Counter()
             for ev in line.events:
                 nm = ev.name.split("=")[0].strip().lstrip("%")
-                tot[re.sub(r"[.\d]+$", "", nm.split(" ")[0])] += ev.duration_ns
+                tot[re.sub(r"(\.\d+)+$", "", nm.split(" ")[0])] += ev.duration_ns
             print(f"depth-2 critical path at N={N} (ms/iter by op kind):")
             for name, ns in tot.most_common(15):
                 print(f"  {ns/1e6/iters:9.4f} ms  {name}")
             found = True
-        break
+        if found:
+            break
     if not found:
         raise RuntimeError(
             "no TPU 'XLA Ops' line in the trace — is a TPU backend active? "
