@@ -1,9 +1,15 @@
-"""Headline benchmark: PANDA-scale slide embedding throughput on one chip.
+"""Headline benchmark: PANDA-scale slide embedding + ViT-G tile encoding.
 
-Runs the flagship slide encoder (gigapath_slide_enc12l768d, 86M params,
-5-branch dilated attention) forward over N=10240 tile embeddings — the
-"PANDA slide-embed wallclock" north star from BASELINE.md — in bf16 under
-jit, and reports tokens/sec.
+Two workloads, one JSON line:
+
+1. **Slide encoder** (gigapath_slide_enc12l768d, 86M params, 5-branch
+   dilated attention) forward + train step over N=10240 tile embeddings —
+   the "PANDA slide-embed wallclock" north star from BASELINE.md — in bf16
+   under jit, reported as tokens/sec.
+2. **Tile encoder** (ViT-G/14, 1.13B params) batch-128 bf16 jitted forward
+   — the literal tiles/sec/chip north-star metric, mirroring the
+   reference's inference recipe (``gigapath/pipeline.py:141-161``: batches
+   of 128 tiles under fp16 autocast).
 
 Timing: iterations are chained inside one jitted fori_loop with a forced
 data dependency and two loop counts are differenced, because the axon tunnel
@@ -21,17 +27,29 @@ H heads runs m = ceil(g/r) queries x m keys per segment: branch cost =
 A100 fp16 at a generous 35% end-to-end MFU => ~109 TFLOPS =>
 ~27.6 ms/slide => ~3.7e5 tokens/s. Generous because the reference's
 dilated gather/scatter/recombination runs in eager torch between
-flash-attn calls.
+flash-attn calls. The baseline value + version ride in the JSON line so
+rounds computed under different denominators stay comparable
+(``baseline_version`` history: v1 = per-branch cost 4*E*L*m, v2 = the
+corrected 4*E*L*m/r used since round 2).
+
+``mfu`` / ``tile_mfu`` ground the numbers in hardware terms: measured
+FLOP/s over the chip's peak bf16 FLOP/s. Denominator bases differ by
+design: ``mfu`` always uses the analytic slide workload count (the same
+count the baseline is computed from, so the two stay comparable);
+``tile_mfu`` prefers compiled-HLO cost analysis and falls back to the
+analytic ViT count.
 
 Prints exactly one JSON line.
 """
 
 import json
+import os
 
 import jax.numpy as jnp
 import numpy as np
 
 N = 10240
+TILE_BATCH = 128  # reference pipeline.py:141
 
 # flagship gigapath_slide_enc12l768d geometry, from the single source of
 # truth (reference slide_encoder.py:137-154)
@@ -42,6 +60,30 @@ DEPTH, E, FFN, IN_CHANS = _G["depth"], _G["embed_dim"], _G["ffn_dim"], _G["in_ch
 SEGS, RATIOS = _G["segment_lengths"], _G["dilated_ratios"]
 A100_FP16_FLOPS = 312e12
 A100_MFU = 0.35
+BASELINE_VERSION = "analytic-a100-v2-perbranch"
+
+# peak dense bf16 FLOP/s by TPU generation (public spec sheets); override
+# with TPU_PEAK_FLOPS for unlisted hardware
+_PEAK_BY_KIND = {
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6": 918e12,
+}
+
+
+def chip_peak_flops() -> float:
+    env = os.environ.get("TPU_PEAK_FLOPS")
+    if env:
+        return float(env)
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    for key, val in _PEAK_BY_KIND.items():
+        if key in kind:
+            return val
+    return 197e12  # default to v5e
 
 
 def workload_flops(n_tokens: int) -> float:
@@ -61,11 +103,57 @@ def workload_flops(n_tokens: int) -> float:
 A100_REF_TOKENS_PER_SEC = N / (workload_flops(N) / (A100_FP16_FLOPS * A100_MFU))
 
 
+def bench_tile_encoder(peak_flops: float):
+    """Batch-128 bf16 ViT-G/14 forward: (tiles/sec, mfu)."""
+    import jax
+
+    from gigapath_tpu.models.tile_encoder import gigapath_tile_enc
+    from gigapath_tpu.utils.profiling import compiled_flops
+    from gigapath_tpu.utils.timing import chained_seconds_per_iter
+
+    model = gigapath_tile_enc(dtype=jnp.bfloat16)
+    rng = jax.random.PRNGKey(0)
+    x0 = jnp.zeros((1, 224, 224, 3), jnp.float32)
+    # init on-device under jit: a host-side 4.5 GB fp32 init + transfer is
+    # both slow and needless for a throughput measurement
+    params = jax.jit(lambda r: model.init(r, x0)["params"])(rng)
+    imgs = jnp.asarray(
+        np.random.default_rng(0).normal(size=(TILE_BATCH, 224, 224, 3)),
+        jnp.bfloat16,
+    )
+
+    def step(x, params):
+        out = model.apply({"params": params}, x)  # [B, 1536]
+        return x + (out.sum() * 1e-30).astype(x.dtype)
+
+    sec_per_iter, _ = chained_seconds_per_iter(
+        step, imgs, args=(params,), iters_low=2, iters_high=8
+    )
+    tiles_per_sec = TILE_BATCH / sec_per_iter
+
+    flops = compiled_flops(lambda x: model.apply({"params": params}, x), imgs)
+    if not flops or not np.isfinite(flops):
+        # analytic fallback. SwiGLU MLP: packed fc1 is [d -> hidden] where
+        # hidden = 8192 already counts both gate+value mats (2 x 4096), and
+        # fc2 is [hidden/2 -> d]: per token 2*d*hidden + 2*d*hidden/2
+        # = 3*d*hidden FLOPs
+        L = model.num_patches + 1
+        hidden = model.mlp_hidden_dim
+        d = model.embed_dim
+        per_layer = 4 * 2 * L * d * d + 3 * L * d * hidden + 4 * L * L * d
+        flops = TILE_BATCH * (model.depth * per_layer + 2 * L * 3 * 16 * 16 * d)
+    mfu = (flops / sec_per_iter) / peak_flops
+    return tiles_per_sec, mfu
+
+
 def main():
     import jax
 
     from gigapath_tpu.models import slide_encoder
+    from gigapath_tpu.utils.profiling import compiled_memory
     from gigapath_tpu.utils.timing import chained_seconds_per_iter
+
+    peak = chip_peak_flops()
 
     model, params = slide_encoder.create_model(
         "", "gigapath_slide_enc12l768d", in_chans=1536, dtype=jnp.bfloat16
@@ -82,6 +170,14 @@ def main():
 
     sec_per_iter, overhead = chained_seconds_per_iter(step, x, args=(params, coords))
     tokens_per_sec = N / sec_per_iter
+    mfu = (workload_flops(N) / sec_per_iter) / peak
+
+    mem = compiled_memory(
+        lambda x: model.apply({"params": params}, x, coords)[0], x
+    )
+    peak_hbm_gb = None
+    if mem and np.isfinite(mem["temp_bytes"]) and np.isfinite(mem["argument_bytes"]):
+        peak_hbm_gb = round((mem["temp_bytes"] + mem["argument_bytes"]) / 2**30, 2)
 
     # train-step variant (fwd+bwd, the reference's actual hot loop —
     # finetune/training.py:223-282): grad of a scalar readout wrt params
@@ -100,6 +196,17 @@ def main():
     )
     train_tokens_per_sec = N / sec_train
 
+    try:
+        tile_tiles_per_sec, tile_mfu = bench_tile_encoder(peak)
+        tile_tiles_per_sec = round(tile_tiles_per_sec, 1)
+        tile_mfu = round(tile_mfu, 3)
+    except Exception as e:  # the headline metric must survive a tile failure
+        # stderr: stdout is contractually exactly one JSON line
+        import sys
+
+        print(f"tile-encoder bench failed: {type(e).__name__}: {e}", file=sys.stderr)
+        tile_tiles_per_sec, tile_mfu = None, None
+
     print(
         json.dumps(
             {
@@ -108,6 +215,12 @@ def main():
                 "unit": "tokens/s",
                 "vs_baseline": round(tokens_per_sec / A100_REF_TOKENS_PER_SEC, 3),
                 "train_tokens_per_sec": round(train_tokens_per_sec, 1),
+                "mfu": round(mfu, 3),
+                "peak_hbm_gb": peak_hbm_gb,
+                "tile_tiles_per_sec": tile_tiles_per_sec,
+                "tile_mfu": tile_mfu,
+                "baseline_tokens_per_sec": round(A100_REF_TOKENS_PER_SEC, 1),
+                "baseline_version": BASELINE_VERSION,
             }
         )
     )

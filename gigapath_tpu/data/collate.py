@@ -37,6 +37,14 @@ def pad_tensors(
     Returns ``(padded [B, L, D], padded_coords [B, L, 2], mask [B, L])``;
     mask True = valid token.
     """
+    assert len(imgs) == len(coords), (len(imgs), len(coords))
+    for i, (tensor, coord) in enumerate(zip(imgs, coords)):
+        # features are padded by their own lengths (native.pad_sequences)
+        # while mask/coords are keyed on coord lengths: a per-item mismatch
+        # would silently produce a mask claiming rows that hold no features
+        assert tensor.shape[0] == coord.shape[0], (
+            f"item {i}: {tensor.shape[0]} feature rows != {coord.shape[0]} coords"
+        )
     max_len = max(t.shape[0] for t in imgs)
     if bucket_fn is not None:
         max_len = bucket_fn(max_len)

@@ -153,11 +153,13 @@ def train(dataloader, fold: int, args):
     rng = jax.random.PRNGKey(args.seed)
     val_records, test_records = None, None
 
+    compile_log = BucketCompileLog("train_step")
     for epoch in range(args.epochs):
         print(f"Epoch: {epoch}")
         rng, epoch_rng = jax.random.split(rng)
         params, opt_state, train_records = train_one_epoch(
-            train_loader, train_step, params, opt_state, epoch, epoch_rng, args
+            train_loader, train_step, params, opt_state, epoch, epoch_rng, args,
+            compile_log=compile_log,
         )
 
         if val_loader is not None:
@@ -196,7 +198,54 @@ def train(dataloader, fold: int, args):
     return val_records, test_records
 
 
-def train_one_epoch(train_loader, train_step, params, opt_state, epoch, rng, args):
+class BucketCompileLog:
+    """Tracks jit retraces per padded-bucket length.
+
+    Bucketed collate bounds retraces to O(log L), but each new bucket's
+    first step silently pays a full XLA compile — a PANDA epoch's first
+    pass looks mysteriously slow without this (observability the
+    reference's ``sec/it`` print effectively had, since eager torch never
+    pauses to compile). Logs the first-call cost per bucket and keeps
+    per-bucket step-time running means for the epoch summary.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self.first_call_sec: Dict[tuple, float] = {}
+        self.step_sec: Dict[tuple, list] = {}
+
+    def is_new(self, bucket: tuple) -> bool:
+        return bucket not in self.first_call_sec
+
+    def record(self, bucket: tuple, seconds: float) -> None:
+        # bucket = (batch, padded_len): a short last batch retraces too, and
+        # must not be filed as a steady step of the full-batch bucket
+        if self.is_new(bucket):
+            self.first_call_sec[bucket] = seconds
+            print(
+                f"[compile] {self.name} bucket B x L={bucket}: first call "
+                f"{seconds:.2f}s (compile+run); "
+                f"{len(self.first_call_sec)} bucket(s) compiled"
+            )
+        else:
+            self.step_sec.setdefault(bucket, []).append(seconds)
+
+    def summary(self) -> str:
+        parts = []
+        for bucket in sorted(self.first_call_sec):
+            steps = self.step_sec.get(bucket, [])
+            mean = sum(steps) / len(steps) if steps else float("nan")
+            parts.append(
+                f"BxL={bucket}: compile {self.first_call_sec[bucket]:.2f}s, "
+                f"{len(steps)} steady steps @ {mean:.3f}s"
+            )
+        return f"[compile] {self.name} buckets — " + "; ".join(parts)
+
+
+def train_one_epoch(
+    train_loader, train_step, params, opt_state, epoch, rng, args,
+    compile_log: Optional[BucketCompileLog] = None,
+):
     """One epoch (reference ``train_one_epoch:223``); per-iteration LR rides
     inside the optimizer schedule."""
     start_time = time.time()
@@ -208,10 +257,13 @@ def train_one_epoch(train_loader, train_step, params, opt_state, epoch, rng, arg
         images, coords, labels, pad_mask = _batch_to_device(batch)
         seq_len += images.shape[1]
         rng, step_rng = jax.random.split(rng)
+        t0 = time.time()
         params, opt_state, loss = train_step(
             params, opt_state, images, coords, labels, pad_mask, step_rng
         )
-        records["loss"] += float(loss)
+        records["loss"] += float(loss)  # blocks on the step
+        if compile_log is not None:
+            compile_log.record(tuple(images.shape[:2]), time.time() - t0)
         n_batches += 1
 
         if (batch_idx + 1) % 20 == 0:
@@ -230,6 +282,8 @@ def train_one_epoch(train_loader, train_step, params, opt_state, epoch, rng, arg
 
     records["loss"] = records["loss"] / max(n_batches, 1)
     print("Epoch: {}, Loss: {:.4f}".format(epoch, records["loss"]))
+    if compile_log is not None and compile_log.first_call_sec:
+        print(compile_log.summary())
     return params, opt_state, records
 
 

@@ -13,9 +13,45 @@ from typing import Any, Optional, Tuple, Union
 from flax import linen as nn
 
 from gigapath_tpu.architecture.config import EncoderConfig
+from gigapath_tpu.architecture.decoder import Decoder, DecoderLayer
 from gigapath_tpu.architecture.encoder import Encoder, EncoderLayer
 from gigapath_tpu.models import longnet_config
 from gigapath_tpu.ops.dilated_attention import DilatedAttention
+
+
+class LongNetDecoderLayer(DecoderLayer):
+    """Decoder block with dilated self-attention (reference ``LongNet.py:17-28``)."""
+
+    def build_self_attention(self) -> nn.Module:
+        args = self.args
+        assert args.segment_length and args.dilated_ratio, (
+            "LongNet requires a segment_length/dilated_ratio schedule"
+        )
+        return DilatedAttention(
+            embed_dim=args.decoder_embed_dim,
+            num_heads=args.decoder_attention_heads,
+            dropout=args.attention_dropout,
+            self_attention=True,
+            subln=args.subln,
+            layernorm_eps=args.layernorm_eps,
+            xpos_rel_pos=args.xpos_rel_pos,
+            xpos_scale_base=args.xpos_scale_base,
+            segment_length=tuple(args.segment_length),
+            dilated_ratio=tuple(args.dilated_ratio),
+            seq_parallel=args.seq_parallel,
+            seq_axis_name=args.extras.get("seq_axis_name"),
+            seq_axis_size=args.extras.get("seq_axis_size", 1),
+            dtype=self.dtype,
+            name="self_attn",
+        )
+
+
+class LongNetDecoder(Decoder):
+    """Causal LongNet (reference ``LongNet.py:30-45``): supports full-sequence
+    forward and eager incremental generation (``decode=True`` + a concrete
+    cache index; see ``DilatedAttention._cached_attend_inputs``)."""
+
+    layer_cls = LongNetDecoderLayer
 
 
 class LongNetEncoderLayer(EncoderLayer):

@@ -136,12 +136,16 @@ class MultiheadAttention(nn.Module):
         rel_pos=None,
         is_causal: bool = False,
         deterministic: bool = True,
+        offset: int = 0,
     ) -> jnp.ndarray:
         """Inner attention on [B, L, H, D] tensors -> [B, Lq, H*D].
 
         Subclasses (DilatedAttention) override this to restructure the
-        sequence around the core op.
+        sequence around the core op. ``offset`` is the decode position of
+        the first query row — only produced by subclasses that opt into
+        positional cache handling (see ``_cached_attend_inputs``).
         """
+        assert offset == 0, "base attention consumes the cache via its bias"
         bias = None
         if attn_mask is not None:
             bias = attn_mask
@@ -162,6 +166,24 @@ class MultiheadAttention(nn.Module):
             dropout_rng=rng,
         )
         return out.reshape(out.shape[0], out.shape[1], self.embed_dim)
+
+    def _cached_attend_inputs(self, k, v, cur, Lq, attn_mask, is_causal):
+        """Turn the updated KV cache into inputs for ``_attend``.
+
+        Returns ``(k, v, attn_mask, is_causal, offset)``. The base class
+        attends the whole static cache buffer with future rows masked by a
+        per-query bias: query row i (absolute position cur+i) may attend
+        keys <= cur+i — correct for single-token steps AND multi-token
+        chunked prefill. DilatedAttention overrides this with positional
+        (offset-based) handling, because its segment structure needs real
+        positions rather than a dense mask.
+        """
+        max_len = k.shape[1]
+        qi = jnp.arange(Lq)[:, None]
+        ki = jnp.arange(max_len)[None, :]
+        cache_bias = jnp.where(ki <= (cur + qi), 0.0, NEG_INF)[None, None]
+        attn_mask = cache_bias if attn_mask is None else attn_mask + cache_bias
+        return k, v, attn_mask, False, 0  # the cache bias supersedes the triangle
 
     @nn.compact
     def __call__(
@@ -207,12 +229,13 @@ class MultiheadAttention(nn.Module):
             k = apply_xpos(k, scale_base=self.xpos_scale_base, downscale=True)
             q = apply_xpos(q, scale_base=self.xpos_scale_base, downscale=False)
 
+        decode_offset = 0
         if decode and self.self_attention:
             # flax-style KV cache: the incremental-state counterpart of the
             # reference (multihead_attention.py:129-144 stores prev_key/
             # prev_value dicts). Cache shape is fixed by the first (init)
             # call; subsequent calls write the new rows at cache_index and
-            # attend the whole buffer with future rows masked.
+            # attend the buffer through the subclass-selected mechanism.
             is_initialized = self.has_variable("cache", "cached_key")
             cached_key = self.variable("cache", "cached_key", jnp.zeros, k.shape, k.dtype)
             cached_value = self.variable("cache", "cached_value", jnp.zeros, v.shape, v.dtype)
@@ -225,17 +248,9 @@ class MultiheadAttention(nn.Module):
                 v = jax.lax.dynamic_update_slice(cached_value.value, v, (0, cur, 0, 0))
                 cached_key.value, cached_value.value = k, v
                 cache_index.value = cur + Lq
-                max_len = k.shape[1]
-                # per-query causal cache mask: query row i (absolute position
-                # cur+i) may attend keys <= cur+i — correct for single-token
-                # steps AND multi-token chunked prefill
-                qi = jnp.arange(Lq)[:, None]
-                ki = jnp.arange(max_len)[None, :]
-                cache_bias = jnp.where(ki <= (cur + qi), 0.0, NEG_INF)[None, None]
-                attn_mask = (
-                    cache_bias if attn_mask is None else attn_mask + cache_bias
+                k, v, attn_mask, is_causal, decode_offset = (
+                    self._cached_attend_inputs(k, v, cur, Lq, attn_mask, is_causal)
                 )
-                is_causal = False  # the cache bias supersedes the triangle
 
         attn = self._attend(
             q,
@@ -246,6 +261,7 @@ class MultiheadAttention(nn.Module):
             rel_pos=rel_pos,
             is_causal=is_causal,
             deterministic=deterministic,
+            offset=decode_offset,
         )
 
         if self.subln and self.self_attention:

@@ -277,10 +277,16 @@ def _branch_bhld(
     if use_pallas:
         from gigapath_tpu.ops.pallas_flash import pallas_segment_flash
 
-        block = min(1024, _round_up(m, 128))
+        # Single-block-if-it-fits: a sparse length like m=1281 under fixed
+        # 1024 blocks pads both q and k to 2048 (2.6x the intrinsic MXU
+        # work, b3 profile); one 1408-square block wastes 10% per side and
+        # streams K/V exactly once. The 1408 cap keeps the fp32 logits tile
+        # (block^2 = 7.9 MB) plus stats/blocks inside the 16 MB VMEM.
+        single = _round_up(m, 128)
+        block_q = block_k = single if single <= 1408 else min(1024, single)
         out_s, lse_s = pallas_segment_flash(
             q5, k5, v5, is_causal=is_causal, kv_len=kvlen,
-            block_q=block, block_k=block, interpret=interpret,
+            block_q=block_q, block_k=block_k, interpret=interpret,
         )
     else:
         out_s, lse_s = _segment_attention_jnp(q5, k5, v5, kvlen, is_causal)
@@ -485,12 +491,13 @@ def dilated_attention(
                 *a, dropout_rate=dropout_rate, dropout_rng=branch_rng, **kw
             )
     assert len(segment_lengths) == len(dilated_ratios)
-    if offset > 0 and q.shape[1] != k.shape[1]:
-        # queries and keys are segmented independently, so Lq != Lk with a
-        # nonzero offset produces mismatched segment counts inside attn_fn
-        raise NotImplementedError(
-            "incremental decoding (offset > 0) requires Lq == Lk; pad q/k to "
-            "a common length (the encoder path uses offset=0)"
+    if offset > 0 and k.shape[1] != offset + q.shape[1]:
+        # incremental decoding contract (reference gathering:78-82): q holds
+        # the new rows at global positions [offset, offset+Lq) and k/v hold
+        # the full prefix-inclusive cache
+        raise ValueError(
+            f"offset={offset} decoding requires Lk == offset + Lq (full KV "
+            f"cache); got Lq={q.shape[1]}, Lk={k.shape[1]}"
         )
     B, L, H, Dh = q.shape
 
@@ -507,9 +514,15 @@ def dilated_attention(
         and q.shape == k.shape == v.shape
         and valid_len_is_static
     ):
+        import os
+
         from gigapath_tpu.ops.flash_attention import _on_tpu
 
-        if _on_tpu():
+        # escape hatch: GIGAPATH_FORCE_GENERIC_ATTN=1 re-routes the default
+        # TPU dispatch to the generic jnp path (compiled-kernel triage aid;
+        # the compiled kernels are otherwise validated by
+        # scripts/tpu_selfcheck.py rather than the CPU/interpret CI tier)
+        if _on_tpu() and not os.environ.get("GIGAPATH_FORCE_GENERIC_ATTN"):
             # Head-major fast path. The phase-major dilated_attention_fused
             # kernels (pallas_dilated.py) have faster attention cells but
             # their per-branch packing relayouts currently cost more than
@@ -565,18 +578,28 @@ def _dilated_branch(
     """One (segment_length, ratio) branch -> (out [B,L,H,D], lse [B,H,L])."""
     B, L, H, Dh = q.shape
 
-    if offset > 0:  # incremental decoding: align the query into its segment
+    if offset > 0:
+        # Incremental decoding (reference gathering:78-82 / scattering:113):
+        # in the full forward, a query at global position t only attends keys
+        # inside its own segment t//sl — so earlier key segments are
+        # invisible and can be dropped. Slicing K/V to the query's segment
+        # start and front-padding q by offset % sl realigns both to a common
+        # within-segment coordinate system with Lq == Lk, after which the
+        # standard equal-length path (incl. its causal mask and real-length
+        # tail masks on the *sliced* cache) is exactly the decode math.
+        assert seq_axis_name is None or seq_axis_size <= 1, (
+            "offset decoding + sequence parallelism are not supported together"
+        )
+        s0 = (offset // sl) * sl
+        if s0 > 0:
+            k = k[:, s0:]
+            v = v[:, s0:]
         q = jnp.pad(q, ((0, 0), (offset % sl, 0), (0, 0), (0, 0)))
     Lq = q.shape[1]
 
     gather_kv = (
         seq_axis_name is not None and seq_axis_size > 1 and sl > k.shape[1]
     )
-    if gather_kv and is_causal:
-        raise NotImplementedError(
-            "causal sequence-parallel dilated attention is not supported yet "
-            "(the encoder path is non-causal; reference ships this dormant)"
-        )
 
     g_q = min(sl, Lq)
     qp = _pad_to_multiple(q, g_q, axis=1)
@@ -591,14 +614,37 @@ def _dilated_branch(
     vs = dense_to_sparse(vp, r)
 
     kv_valid_len = None
+    sp_causal_bias = None
     if gather_kv:
         if valid_len is not None:
             raise NotImplementedError(
                 "dynamic padding masks + sequence parallelism are not "
                 "supported together yet"
             )
-        ks = _gather_kv_seq_parallel(ks, sl, k.shape[1], seq_axis_name)
-        vs = _gather_kv_seq_parallel(vs, sl, k.shape[1], seq_axis_name)
+        local_len = k.shape[1]
+        ks = _gather_kv_seq_parallel(ks, sl, local_len, seq_axis_name)
+        vs = _gather_kv_seq_parallel(vs, sl, local_len, seq_axis_name)
+        if is_causal:
+            # Causal sequence parallelism (reference gather_kv:64-68): ranks
+            # of my segment *ahead* of me must be invisible, earlier ranks
+            # fully visible, my own rank causally visible. Key slot j of rank
+            # block w' and query slot i share a head phase p, so global order
+            # reduces to block-and-slot order: key (w', j) <= query (w, i)
+            # iff j_cat <= w_rel*m + i in the concatenated key axis. The
+            # reference's literal dormant code instead drops the current
+            # rank's own keys and zero-stubs rank 0 (`x[:1] * 0`), which
+            # breaks self-attention; this implements the evident intent (see
+            # PARITY.md). The rank index is traced, so the mask rides as an
+            # additive bias instead of the static causal flag.
+            rps = sl // local_len
+            m_loc = ks.shape[1] // rps
+            w_rel = jax.lax.axis_index(seq_axis_name) % rps
+            qi = jnp.arange(qs.shape[1])[:, None]
+            kj = jnp.arange(ks.shape[1])[None, :]
+            sp_causal_bias = jnp.where(
+                kj <= qi + w_rel * m_loc, 0.0, NEG_INF
+            )[None, None]  # [1, 1, Lq_sparse, Lk_cat]
+            is_causal = False  # superseded by the bias
     else:
         static_len = k.shape[1]
         if isinstance(valid_len, int):
@@ -631,7 +677,14 @@ def _dilated_branch(
                 else jnp.minimum(counts, jnp.asarray(kv_valid_len, jnp.int32))
             )
 
-    out_s, lse_s = attn_fn(qs, ks, vs, is_causal=is_causal, kv_valid_len=kv_valid_len)
+    if sp_causal_bias is not None:
+        out_s, lse_s = attn_fn(
+            qs, ks, vs, is_causal=False, kv_valid_len=None, bias=sp_causal_bias
+        )
+    else:
+        out_s, lse_s = attn_fn(
+            qs, ks, vs, is_causal=is_causal, kv_valid_len=kv_valid_len
+        )
 
     out_d, lse_d = sparse_to_dense(out_s, lse_s, r, g_q)
     out = out_d.reshape(B, n_seg * g_q, H, Dh)
@@ -656,6 +709,28 @@ class DilatedAttention(MultiheadAttention):
     seq_axis_size: int = 1
     attn_fn: Optional[AttnFn] = None
 
+    def _cached_attend_inputs(self, k, v, cur, Lq, attn_mask, is_causal):
+        """Positional (offset-based) incremental decode.
+
+        The segment/dilation structure depends on absolute positions, so the
+        cache is consumed as ``offset = cur`` plus the live prefix of the
+        buffer — not as a dense mask over the full static buffer (the base
+        class mechanism), which dilated attention cannot honor. The cache
+        index must be concrete (eager generation loop, as in the reference's
+        fairseq-style decoding); a traced index raises with guidance.
+        """
+        try:
+            off = int(cur)
+        except jax.errors.TracerIntegerConversionError as e:
+            raise NotImplementedError(
+                "DilatedAttention incremental decode requires a concrete "
+                "cache index (run the generation loop eagerly, outside jit): "
+                "segment boundaries are position-dependent static shapes"
+            ) from e
+        k = k[:, : off + Lq]
+        v = v[:, : off + Lq]
+        return k, v, attn_mask, is_causal, off
+
     def _attend(
         self,
         q,
@@ -667,6 +742,7 @@ class DilatedAttention(MultiheadAttention):
         rel_pos=None,
         is_causal: bool = False,
         deterministic: bool = True,
+        offset: int = 0,
     ):
         assert rel_pos is None, "dilated attention does not support rel_pos bias"
         assert attn_mask is None, "dilated attention does not support attn_mask"
@@ -697,6 +773,7 @@ class DilatedAttention(MultiheadAttention):
             tuple(self.segment_length),
             tuple(self.dilated_ratio),
             is_causal=is_causal,
+            offset=offset,
             attn_fn=self.attn_fn,
             seq_axis_name=self.seq_axis_name if self.seq_parallel else None,
             seq_axis_size=self.seq_axis_size if self.seq_parallel else 1,

@@ -78,17 +78,28 @@ def generate_tiles(
     tile_size: int,
     foreground_threshold: float,
     occupancy_threshold: float,
+    strict_parity: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
     """Tile the ROI and drop background tiles (reference
     ``generate_tiles:87-124``). Returns (tiles [N,C,h,w], locations [N,2],
-    occupancies [N], n_discarded)."""
+    occupancies [N], n_discarded).
+
+    ``strict_parity`` forces the reference's fp16-*accumulated* occupancy
+    mean (``select_tiles:38``) instead of the native kernel's exact integer
+    count cast to fp16 afterwards — tile selection can differ at threshold
+    boundaries between the two (documented in PARITY.md).
+    """
     image_tiles, tile_locations = tiling.tile_array_2d(
         slide_image, tile_size=tile_size, constant_values=255
     )
     logging.info(f"Tiled {slide_image.shape} to {image_tiles.shape}")
     if occupancy_threshold < 0.0 or occupancy_threshold > 1.0:
         raise ValueError("Tile occupancy threshold must be between 0 and 1")
-    if isinstance(foreground_threshold, (int, float)) and image_tiles.dtype == np.uint8:
+    if (
+        not strict_parity
+        and isinstance(foreground_threshold, (int, float))
+        and image_tiles.dtype == np.uint8
+    ):
         # fixed threshold (Otsu already ran at ROI load): the luminance +
         # compare + occupancy mean collapses into one pass through the
         # native C++ kernel. Exact integer luminance counts (the kernel and
@@ -226,9 +237,11 @@ def process_slide(
     output_dir: Path,
     thumbnail_dir: Path,
     tile_progress: bool = False,
+    strict_parity: bool = False,
 ) -> Path:
     """Tile one slide end-to-end, writing PNGs + csv ledgers
-    (reference ``process_slide:237-354``)."""
+    (reference ``process_slide:237-354``). ``strict_parity``: see
+    :func:`generate_tiles`."""
     output_dir, thumbnail_dir = Path(output_dir), Path(thumbnail_dir)
     output_dir.mkdir(parents=True, exist_ok=True)
     thumbnail_dir.mkdir(parents=True, exist_ok=True)
@@ -279,6 +292,7 @@ def process_slide(
             tile_size,
             sample["foreground_threshold"],
             occupancy_threshold,
+            strict_parity=strict_parity,
         )
         # tile locations: level coords -> level-0 coords; origin is (y, x)
         # while locations are (x, y) (reference process_slide:314-318)

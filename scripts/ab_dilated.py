@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""A/B microbench for the dilated-attention op on the real chip.
+
+Interleaves variants in ONE process (the chip is shared; cross-process
+numbers are incomparable) and prints ms per 5-branch op plus effective
+TFLOPS on the intrinsic branch FLOPs. Variants via --variants, e.g.::
+
+    python scripts/ab_dilated.py --variants bhld,fused
+    python scripts/ab_dilated.py --variants bhld --branches 0,1,2,3,4
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--variants", default="bhld,fused")
+    ap.add_argument("--branches", default="", help="comma indices; empty = all 5")
+    ap.add_argument("--n", type=int, default=10241)
+    ap.add_argument("--iters", type=int, default=24)
+    args = ap.parse_args()
+
+    from gigapath_tpu.models.longnet_config import flagship_geometry
+    from gigapath_tpu.ops import dilated_attention as da
+    from gigapath_tpu.utils.timing import chained_seconds_per_iter
+
+    G = flagship_geometry()
+    H, Dh = G["heads"], G["head_dim"]
+    SEGS, RATIOS = list(G["segment_lengths"]), list(G["dilated_ratios"])
+    if args.branches:
+        idx = [int(i) for i in args.branches.split(",")]
+        SEGS = [SEGS[i] for i in idx]
+        RATIOS = [RATIOS[i] for i in idx]
+    L = args.n
+    print(f"L={L} H={H} Dh={Dh} branches={list(zip(SEGS, RATIOS))}")
+
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(1, L, H, Dh)), jnp.bfloat16) for _ in range(3)
+    )
+
+    # intrinsic branch FLOPs: per branch 4 * E * L * m / r (bench.py docstring)
+    E = H * Dh
+    flops = sum(4 * E * L * (-(-min(sl, L) // r)) / r for sl, r in zip(SEGS, RATIOS))
+
+    variants = {}
+    if "bhld" in args.variants:
+        variants["bhld"] = lambda q, k, v: da.dilated_attention_bhld(
+            q, k, v, SEGS, RATIOS
+        )
+    if "fused" in args.variants:
+        variants["fused"] = lambda q, k, v: da.dilated_attention_fused(
+            q, k, v, SEGS, RATIOS
+        )
+
+    def make_step(fn):
+        def step(x, k, v):
+            out = fn(x, k, v)
+            return x + (out.astype(jnp.float32).sum() * 1e-30).astype(x.dtype)
+
+        return step
+
+    # two interleaved rounds per variant to defeat chip drift
+    results = {name: [] for name in variants}
+    for _round in range(2):
+        for name, fn in variants.items():
+            sec, _ = chained_seconds_per_iter(
+                make_step(fn), q, args=(k, v), iters_low=2, iters_high=2 + args.iters
+            )
+            results[name].append(sec)
+    for name, secs in results.items():
+        best = min(secs)
+        print(
+            f"{name:8s} {best * 1e3:8.3f} ms/op   {flops / best / 1e12:6.1f} TFLOPS"
+            f"   (rounds: {', '.join(f'{s * 1e3:.3f}' for s in secs)})"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""On-chip experiment: cost of phase-major packing variants (XLA side).
+
+Times, for one branch geometry, the pure packing transform per tensor:
+  T7: reshape -> 7-D transpose with Dh=48 minor (current _to_phase_major)
+  T6: reshape -> 6-D transpose with W = E/r minor (chunk variant)
+  PAD: contiguous dense pad only (lower bound)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--branch", type=int, default=3)
+    ap.add_argument("--n", type=int, default=10241)
+    args = ap.parse_args()
+
+    from gigapath_tpu.models.longnet_config import flagship_geometry
+    from gigapath_tpu.ops.pallas_dilated import _branch_geometry
+    from gigapath_tpu.utils.timing import chained_seconds_per_iter
+
+    G = flagship_geometry()
+    H, Dh = G["heads"], G["head_dim"]
+    E = H * Dh
+    sl, r = G["segment_lengths"][args.branch], G["dilated_ratios"][args.branch]
+    L = args.n
+    g, S, gp, m, Mp, block = _branch_geometry(L, E, sl, r)
+    hb, W = H // r, E // r
+    print(f"branch {args.branch}: sl={sl} r={r} g={g} S={S} m={m} Mp={Mp} block={block} W={W}")
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(1, L, E)), jnp.bfloat16)
+    B = 1
+
+    def prep(xx):
+        if S * g != L:
+            xx = jnp.pad(xx, ((0, 0), (0, S * g - L), (0, 0)))
+        return xx.reshape(B, S, g, E)
+
+    def t7(xx):
+        xx = prep(xx)
+        if gp != g:
+            xx = jnp.pad(xx, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
+        x7 = xx.reshape(B, S, m, r, r, hb, Dh).transpose(0, 1, 3, 4, 5, 2, 6)
+        if Mp != m:
+            x7 = jnp.pad(x7, ((0, 0),) * 5 + ((0, Mp - m), (0, 0)))
+        return x7
+
+    def t6(xx):
+        xx = prep(xx)
+        gp2 = Mp * r
+        if gp2 != g:
+            xx = jnp.pad(xx, ((0, 0), (0, 0), (0, gp2 - g), (0, 0)))
+        return xx.reshape(B, S, Mp, r, r, W).transpose(0, 1, 3, 4, 2, 5)
+
+    def padonly(xx):
+        xx = prep(xx)
+        gp2 = Mp * r
+        if gp2 != g:
+            xx = jnp.pad(xx, ((0, 0), (0, 0), (0, gp2 - g), (0, 0)))
+        return xx
+
+    variants = {"T7": t7, "T6": t6, "PAD": padonly}
+
+    def make_step(fn):
+        def step(x):
+            y = fn(x)
+            return x + (y.astype(jnp.float32).sum() * 1e-30).astype(x.dtype)
+
+        return step
+
+    results = {name: [] for name in variants}
+    for _round in range(2):
+        for name, fn in variants.items():
+            sec, _ = chained_seconds_per_iter(make_step(fn), x, iters_low=2, iters_high=22)
+            results[name].append(sec)
+    for name, secs in results.items():
+        print(f"{name:4s} {min(secs) * 1e6:9.1f} us/tensor")
+
+
+if __name__ == "__main__":
+    main()

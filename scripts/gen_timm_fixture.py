@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Generate tests/fixtures/timm_vitg_keys.json — the timm ViT-G key schema.
+
+Names + shapes only (no weights): the state-dict surface of
+``timm.create_model("hf_hub:prov-gigapath/prov-gigapath")`` — a DINOv2-style
+``vit_giant_patch14_224`` with SwiGLUPacked MLP and LayerScale, embed 1536 /
+depth 40 / heads 24 / packed-SwiGLU hidden 8192 (param count
+1,134,953,984, derived + tested in tests/test_tile_encoder.py). timm itself
+is unavailable in this environment (zero egress), so the schema is derived
+from the same architecture derivation; regenerate with this script if the
+derivation changes, and cross-check against a real checkpoint with
+``python -c "import timm, json; m = timm.create_model('hf_hub:prov-gigapath/prov-gigapath'); print(json.dumps({k: list(v.shape) for k, v in m.state_dict().items()}))"``
+in a weights-capable environment (README "Verifying tile-encoder parity").
+"""
+
+import json
+import os
+
+D, DEPTH, P = 1536, 40, 16
+HIDDEN = int(D * 5.33334)  # 8192, SwiGLUPacked fc1 output (2 x 4096)
+N_TOK = (224 // P) ** 2 + 1
+
+schema = {
+    "cls_token": [1, 1, D],
+    "pos_embed": [1, N_TOK, D],
+    "patch_embed.proj.weight": [D, 3, P, P],
+    "patch_embed.proj.bias": [D],
+    "norm.weight": [D],
+    "norm.bias": [D],
+}
+for i in range(DEPTH):
+    b = f"blocks.{i}."
+    schema.update(
+        {
+            b + "norm1.weight": [D],
+            b + "norm1.bias": [D],
+            b + "attn.qkv.weight": [3 * D, D],
+            b + "attn.qkv.bias": [3 * D],
+            b + "attn.proj.weight": [D, D],
+            b + "attn.proj.bias": [D],
+            b + "ls1.gamma": [D],
+            b + "norm2.weight": [D],
+            b + "norm2.bias": [D],
+            b + "mlp.fc1.weight": [HIDDEN, D],
+            b + "mlp.fc1.bias": [HIDDEN],
+            b + "mlp.fc2.weight": [D, HIDDEN // 2],
+            b + "mlp.fc2.bias": [D],
+            b + "ls2.gamma": [D],
+        }
+    )
+
+out = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "fixtures", "timm_vitg_keys.json",
+)
+os.makedirs(os.path.dirname(out), exist_ok=True)
+with open(out, "w") as f:
+    json.dump(schema, f, indent=0, sort_keys=True)
+total = sum(
+    __import__("math").prod(s) for s in schema.values()
+)
+print(f"{len(schema)} keys, {total:,} params -> {out}")

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Per-op attribution for ONE dilated branch on the real chip.
+
+Traces N iterations of the branch op and prints the XLA-op time breakdown
+(jax.profiler ProfileData, 'XLA Ops' line only — the async line
+double-counts overlapped DMA).
+
+    python scripts/profile_branch.py --branch 3 --variant bhld
+"""
+
+import argparse
+import glob
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--branch", type=int, default=3)
+    ap.add_argument("--variant", default="bhld")
+    ap.add_argument("--n", type=int, default=10241)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--top", type=int, default=18)
+    args = ap.parse_args()
+
+    from gigapath_tpu.models.longnet_config import flagship_geometry
+    from gigapath_tpu.ops import dilated_attention as da
+
+    G = flagship_geometry()
+    H, Dh = G["heads"], G["head_dim"]
+    sl, r = G["segment_lengths"][args.branch], G["dilated_ratios"][args.branch]
+    L = args.n
+    print(f"branch {args.branch}: sl={sl} r={r} L={L} variant={args.variant}")
+
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(1, L, H, Dh)), jnp.bfloat16) for _ in range(3)
+    )
+
+    if args.variant == "bhld":
+        fn = lambda q, k, v: da.dilated_attention_bhld(q, k, v, [sl], [r])
+    else:
+        fn = lambda q, k, v: da.dilated_attention_fused(q, k, v, [sl], [r])
+
+    @jax.jit
+    def step(x, k, v):
+        out = fn(x, k, v)
+        return x + (out.astype(jnp.float32).sum() * 1e-30).astype(x.dtype)
+
+    x = step(q, k, v)  # compile
+    x.block_until_ready()
+
+    tmp = tempfile.mkdtemp(prefix="branchprof_")
+    with jax.profiler.trace(tmp):
+        for _ in range(args.iters):
+            x = step(x, k, v)
+        x.block_until_ready()
+
+    from jax.profiler import ProfileData
+
+    traces = sorted(
+        glob.glob(os.path.join(tmp, "**", "*.xplane.pb"), recursive=True)
+    )
+    pd = ProfileData.from_file(traces[-1])
+    totals = {}
+    async_totals = {}
+    for plane in pd.planes:
+        if "TPU" not in plane.name:
+            continue
+        for line in plane.lines:
+            if line.name == "XLA Ops":
+                for ev in line.events:
+                    totals[ev.name] = totals.get(ev.name, 0.0) + ev.duration_ns / 1e3
+            elif "Async" in line.name:
+                for ev in line.events:
+                    async_totals[ev.name] = (
+                        async_totals.get(ev.name, 0.0) + ev.duration_ns / 1e3
+                    )
+    total_us = sum(totals.values())
+    print(f"total XLA-op time: {total_us / args.iters / 1e3:.3f} ms/iter")
+    for name, us in sorted(totals.items(), key=lambda kv: -kv[1])[: args.top]:
+        print(f"  {us / args.iters:9.1f} us/iter  {100 * us / total_us:5.1f}%  {name[:110]}")
+    if async_totals:
+        atot = sum(async_totals.values())
+        print(f"async line total (overlap-capable DMA): {atot / args.iters / 1e3:.3f} ms/iter")
+        for name, us in sorted(async_totals.items(), key=lambda kv: -kv[1])[:8]:
+            print(f"  A {us / args.iters:9.1f} us/iter  {name[:100]}")
+
+
+if __name__ == "__main__":
+    main()

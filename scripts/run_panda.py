@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Blessed PANDA fine-tune recipe — reference ``scripts/run_panda.sh`` pinned.
+
+Every hyperparameter below is the reference's value verbatim
+(``run_panda.sh:6,14-20`` and the flags it passes at ``:28-50``): the
+shell script is the reference's de-facto hyperparameter registry (SURVEY
+§5.6 #5), so this file is its executable counterpart.
+
+Usage::
+
+    python scripts/run_panda.py --root_path /path/to/h5_files \
+        --dataset_csv /path/to/PANDA.csv --pre_split_dir /path/to/splits
+    python scripts/run_panda.py --dry       # resolve + print config only
+
+``--dry`` resolves the exact reference effective learning rate
+(``lr = blr * batch_size * gc / 256`` — finetune/main.py:39-42) and the
+full flag set without touching data. Any extra flags are forwarded to
+``finetune/main.py`` and override the recipe.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# reference scripts/run_panda.sh:6,14-20 — verbatim
+PANDA_RECIPE = {
+    "task_cfg_path": os.path.join(_REPO, "gigapath_tpu/finetune/task_configs/panda.yaml"),
+    "max_wsi_size": "250000",  # MAX_WSI_SIZE
+    "tile_size": "256",        # TILE_SIZE
+    "model_arch": "gigapath_slide_enc12l768d",
+    "input_dim": "1536",       # TILEEMBEDSIZE
+    "latent_dim": "768",       # LATENTDIM
+    "epochs": "5",             # EPOCH
+    "gc": "32",                # GC
+    "blr": "0.002",            # BLR
+    "optim_wd": "0.05",        # WD
+    "layer_decay": "0.95",     # LD
+    "feat_layer": "11",        # FEATLAYER
+    "dropout": "0.1",          # DROPOUT
+    "drop_path_rate": "0.0",
+    "val_r": "0.1",
+    "warmup_epochs": "1",
+    "model_select": "last_epoch",
+    "lr_scheduler": "cosine",
+    "folds": "1",
+    "report_to": "tensorboard",
+    "save_dir": "outputs/PANDA",
+    "exp_name": "run_epoch-5_blr-0.002_wd-0.05_ld-0.95_feat-11",
+}
+
+
+def build_argv(recipe: dict, extra: list) -> list:
+    """Recipe dict -> CLI argv, with user-supplied extra flags overriding."""
+    overridden = {a.lstrip("-") for a in extra if a.startswith("--")}
+    argv = []
+    for key, val in recipe.items():
+        if key in overridden:
+            continue
+        argv += [f"--{key}", val]
+    return argv + extra
+
+
+def main() -> None:
+    extra = sys.argv[1:]
+    dry = "--dry" in extra
+    if dry:
+        extra = [a for a in extra if a != "--dry"]
+    argv = build_argv(PANDA_RECIPE, extra)
+
+    if dry:
+        from gigapath_tpu.finetune.params import get_finetune_params
+
+        args = get_finetune_params(argv)
+        eff_batch_size = args.batch_size * args.gc
+        lr = args.lr if (args.lr is not None and args.lr > 0) else args.blr * eff_batch_size / 256
+        print("PANDA recipe (reference scripts/run_panda.sh):")
+        for key in sorted(vars(args)):
+            print(f"  {key} = {getattr(args, key)}")
+        print(f"effective batch size: {eff_batch_size}")
+        print(f"actual lr (blr * bs * gc / 256): {lr:.6g}")
+        return
+
+    from gigapath_tpu.finetune.main import main as finetune_main
+
+    finetune_main(argv)
+
+
+if __name__ == "__main__":
+    main()

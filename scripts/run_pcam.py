@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Blessed PCam linear-probe recipe — reference ``scripts/run_pcam.sh`` pinned.
+
+Hyperparameters verbatim from ``run_pcam.sh:5-14``. Usage::
+
+    python scripts/run_pcam.py --input_path data/GigaPath_PCam_embeddings.zip
+    python scripts/run_pcam.py --dry        # resolve + print config only
+
+Extra flags are forwarded to ``linear_probe/main.py`` and override the
+recipe.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# reference scripts/run_pcam.sh:5-14 — verbatim
+PCAM_RECIPE = {
+    "batch_size": "128",
+    "lr": "0.02",
+    "min_lr": "0.0",
+    "train_iters": "4000",
+    "eval_interval": "100",
+    "optim": "sgd",
+    "weight_decay": "0.01",
+    "output_dir": "outputs/pcam",
+}
+
+
+def main() -> None:
+    from scripts.run_panda import build_argv
+
+    extra = sys.argv[1:]
+    dry = "--dry" in extra
+    if dry:
+        extra = [a for a in extra if a != "--dry"]
+    argv = build_argv(PCAM_RECIPE, extra)
+
+    if dry:
+        from gigapath_tpu.linear_probe.main import build_argparser
+
+        args = build_argparser().parse_args(argv)
+        print("PCam linear-probe recipe (reference scripts/run_pcam.sh):")
+        for key in sorted(vars(args)):
+            print(f"  {key} = {getattr(args, key)}")
+        return
+
+    from gigapath_tpu.linear_probe.main import main as probe_main
+
+    probe_main(argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -13,17 +13,20 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 FAILED = []
+_T0 = time.time()
 
 
 def check(name, got, ref, atol):
     err = float(jnp.abs(jnp.asarray(got, jnp.float32) - jnp.asarray(ref, jnp.float32)).max())
     status = "ok" if err <= atol else "FAIL"
-    print(f"{name:55s} max_err={err:.4e} (atol {atol:g})  {status}")
+    print(f"[{time.time() - _T0:6.1f}s] {name:55s} max_err={err:.4e} (atol {atol:g})  {status}")
     if err > atol:
         FAILED.append(name)
 
@@ -65,32 +68,45 @@ def main():
     out_f = da.dilated_attention_fused(q, k, v, SEGS, RATIOS, valid_len=4001)
     check("dilated fused (flagship schedule, valid_len)", out_f[:, :4001], ref[:, :4001], 5e-2)
 
-    # gradients through the compiled backward kernels (short schedule)
-    segs, ratios = [512, 1024], [1, 2]
+    # Gradients through the compiled backward kernels. dq/dk/dv ride ONE
+    # jax.grad(argnums=(0,1,2)) per path — one XLA compile covers all three
+    # (three separate grads tripled the compile bill and previously pushed
+    # the dK/dV checks past a 10-minute budget). Short schedule + L=1024
+    # keeps each backward compile small.
+    segs, ratios = [256, 512], [1, 2]
+    Lb = 1024
+    qb, kb, vb = q[:, :Lb], k[:, :Lb], v[:, :Lb]
+    qbf, kbf, vbf = qf[:, :Lb], kf[:, :Lb], vf[:, :Lb]
 
-    def loss_pallas(x):
-        return da.dilated_attention_bhld(x, k[:, :2048], v[:, :2048], segs, ratios).astype(jnp.float32).var()
+    def loss_pallas(x, y, z):
+        return da.dilated_attention_bhld(x, y, z, segs, ratios).astype(jnp.float32).var()
 
-    def loss_jnp(x):
+    def loss_jnp(x, y, z):
         return da.dilated_attention_bhld(
-            x.astype(jnp.float32), kf[:, :2048], vf[:, :2048], segs, ratios, use_pallas=False
+            x, y, z, segs, ratios, use_pallas=False
         ).var()
 
-    g_p = jax.grad(loss_pallas)(q[:, :2048]).astype(jnp.float32)
-    g_j = jax.grad(loss_jnp)(qf[:, :2048])
-    scale = float(jnp.abs(g_j).max())
-    check(f"dilated bhld dq (rel to {scale:.2e})", g_p / scale, g_j / scale, 6e-2)
+    def loss_fused(x, y, z):
+        return da.dilated_attention_fused(x, y, z, segs, ratios).astype(jnp.float32).var()
 
-    def loss_fused(x):
-        return da.dilated_attention_fused(x, k[:, :2048], v[:, :2048], segs, ratios).astype(jnp.float32).var()
-
-    g_f = jax.grad(loss_fused)(q[:, :2048]).astype(jnp.float32)
-    check(f"dilated fused dq (rel to {scale:.2e})", g_f / scale, g_j / scale, 6e-2)
+    grads_p = jax.jit(jax.grad(loss_pallas, argnums=(0, 1, 2)))(qb, kb, vb)
+    grads_j = jax.jit(jax.grad(loss_jnp, argnums=(0, 1, 2)))(qbf, kbf, vbf)
+    grads_f = jax.jit(jax.grad(loss_fused, argnums=(0, 1, 2)))(qb, kb, vb)
+    for name, g_p, g_f, g_j in zip("qkv", grads_p, grads_f, grads_j):
+        scale = float(jnp.abs(g_j).max())
+        check(
+            f"dilated bhld d{name} (rel to {scale:.2e})",
+            g_p.astype(jnp.float32) / scale, g_j / scale, 6e-2,
+        )
+        check(
+            f"dilated fused d{name} (rel to {scale:.2e})",
+            g_f.astype(jnp.float32) / scale, g_j / scale, 6e-2,
+        )
 
     if FAILED:
         print("FAILED:", FAILED)
         sys.exit(1)
-    print("all on-chip checks passed")
+    print(f"all on-chip checks passed in {time.time() - _T0:.1f}s")
 
 
 if __name__ == "__main__":

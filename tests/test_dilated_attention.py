@@ -160,6 +160,119 @@ def test_seq_parallel_matches_single_device(rng):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4)
 
 
+def test_seq_parallel_causal_matches_single_device(rng):
+    """Causal shard_map SP == single-device causal dilated attention.
+
+    Covers reference ``gather_kv``'s causal branch (dilated_attention.py:64-68)
+    with the corrected semantics (own-rank keys kept, causal across rank
+    blocks) — see PARITY.md for the deviation note.
+    """
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ("seq",))
+    N, H, D = 32, 4, 8
+    q, k, v = (jnp.asarray(rng.normal(size=(1, N, H, D)), jnp.float32) for _ in range(3))
+    sls, drs = [4, 16, 32], [1, 2, 4]  # 16 and 32 exceed the 8-token local shard
+
+    ref = dilated_attention(q, k, v, sls, drs, is_causal=True)
+
+    fn = shard_map(
+        lambda q, k, v: dilated_attention(
+            q, k, v, sls, drs, is_causal=True, seq_axis_name="seq", seq_axis_size=4
+        ),
+        mesh=mesh,
+        in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+        out_specs=P(None, "seq"),
+    )
+    out = fn(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4)
+
+
+class TestOffsetDecode:
+    """Incremental decoding (offset > 0, Lq != Lk) == rows of the full
+    causal forward — the contract of reference ``gathering``/``scattering``
+    with ``offset`` (dilated_attention.py:78-82,113)."""
+
+    SLS, DRS = [4, 16], [1, 2]
+
+    def test_stepwise_matches_full(self, rng):
+        N, H, D = 24, 4, 8  # N > 16: caches longer than the largest segment
+        q, k, v = (jnp.asarray(rng.normal(size=(2, N, H, D)), jnp.float32) for _ in range(3))
+        full = dilated_attention(q, k, v, self.SLS, self.DRS, is_causal=True)
+        for t in [0, 1, 3, 4, 7, 15, 16, 17, 23]:
+            step = dilated_attention(
+                q[:, t : t + 1], k[:, : t + 1], v[:, : t + 1],
+                self.SLS, self.DRS, is_causal=True, offset=t,
+            )
+            np.testing.assert_allclose(
+                np.asarray(step[:, 0]), np.asarray(full[:, t]),
+                atol=2e-5, rtol=1e-4, err_msg=f"step {t}",
+            )
+
+    def test_chunked_matches_full(self, rng):
+        """Multi-token chunks, including chunks crossing segment boundaries."""
+        N, H, D = 24, 4, 8
+        q, k, v = (jnp.asarray(rng.normal(size=(1, N, H, D)), jnp.float32) for _ in range(3))
+        full = dilated_attention(q, k, v, self.SLS, self.DRS, is_causal=True)
+        for t0, t1 in [(0, 3), (3, 9), (9, 24)]:  # (3,9) crosses the sl=4 boundary
+            chunk = dilated_attention(
+                q[:, t0:t1], k[:, :t1], v[:, :t1],
+                self.SLS, self.DRS, is_causal=True, offset=t0,
+            )
+            np.testing.assert_allclose(
+                np.asarray(chunk), np.asarray(full[:, t0:t1]),
+                atol=2e-5, rtol=1e-4, err_msg=f"chunk [{t0}, {t1})",
+            )
+
+    def test_bad_cache_length_raises(self, rng):
+        q, k, v = (jnp.asarray(rng.normal(size=(1, 8, 2, 4)), jnp.float32) for _ in range(3))
+        with pytest.raises(ValueError, match="offset"):
+            dilated_attention(
+                q[:, :1], k, v, self.SLS, self.DRS, is_causal=True, offset=3
+            )
+
+
+def test_longnet_decoder_incremental_matches_full(rng):
+    """LongNetDecoder eager stepwise generation == full-sequence forward
+    (reference ``LongNetDecoder``, model/LongNet.py:30-45)."""
+    from gigapath_tpu.architecture.config import DecoderConfig
+    from gigapath_tpu.models.longnet import LongNetDecoder
+
+    cfg = DecoderConfig(
+        decoder_embed_dim=32,
+        decoder_attention_heads=4,
+        decoder_ffn_embed_dim=64,
+        decoder_layers=2,
+        vocab_size=50,
+        dropout=0.0,
+        drop_path_rate=0.0,
+        segment_length=[4, 16],
+        dilated_ratio=[1, 2],
+        flash_attention=True,
+    )
+    dec = LongNetDecoder(cfg)
+    T = 9
+    tokens = jnp.asarray(rng.integers(0, 50, (2, T)), jnp.int32)
+    variables = dec.init(jax.random.PRNGKey(0), tokens, decode=True)
+    params, cache = variables["params"], variables["cache"]
+    full = dec.apply({"params": params}, tokens)["decoder_out"]
+
+    step_outs = []
+    for t in range(T):
+        out, mods = dec.apply(
+            {"params": params, "cache": cache},
+            tokens[:, t : t + 1],
+            decode=True,
+            mutable=["cache"],
+        )
+        cache = mods["cache"]
+        step_outs.append(out["decoder_out"][:, 0])
+    stepped = jnp.stack(step_outs, axis=1)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(stepped), atol=2e-4)
+
+
 class TestBHLDFastPath:
     """Head-major (BHLD) fast path == generic path / numpy oracle.
 

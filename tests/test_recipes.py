@@ -1,0 +1,65 @@
+"""Blessed-recipe entry points resolve the reference's exact hyperparameters.
+
+Reference registry: ``scripts/run_panda.sh:6,14-20`` and
+``scripts/run_pcam.sh:5-14`` (the shell scripts are the reference's de-facto
+hyperparameter store, SURVEY §5.6 #5).
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _dry_run(script):
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", script), "--dry"],
+        capture_output=True, text=True, check=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    return out.stdout
+
+
+def test_run_panda_resolves_reference_recipe():
+    out = _dry_run("run_panda.py")
+    # effective LR = blr * batch_size * gc / 256 = 0.002 * 1 * 32 / 256
+    assert "actual lr (blr * bs * gc / 256): 0.00025" in out
+    assert "effective batch size: 32" in out
+    for line in [
+        "max_wsi_size = 250000",
+        "epochs = 5",
+        "gc = 32",
+        "blr = 0.002",
+        "optim_wd = 0.05",
+        "layer_decay = 0.95",
+        "feat_layer = 11",
+        "dropout = 0.1",
+        "model_select = last_epoch",
+        "model_arch = gigapath_slide_enc12l768d",
+    ]:
+        assert line in out, line
+
+
+def test_run_panda_cli_override_wins():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "run_panda.py"),
+         "--dry", "--epochs", "2"],
+        capture_output=True, text=True, check=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    ).stdout
+    assert "epochs = 2" in out
+
+
+def test_run_pcam_resolves_reference_recipe():
+    out = _dry_run("run_pcam.py")
+    for line in [
+        "batch_size = 128",
+        "lr = 0.02",
+        "min_lr = 0.0",
+        "train_iters = 4000",
+        "eval_interval = 100",
+        "optim = sgd",
+        "weight_decay = 0.01",
+    ]:
+        assert line in out, line

@@ -190,6 +190,51 @@ def test_pos_embed_resize_on_grid_mismatch(tmp_path):
     assert missing == [] and unexpected == []
 
 
+def test_vendored_timm_key_schema_maps_bijectively():
+    """The full-size ViT-G timm key schema (vendored fixture, names+shapes
+    only — regenerate with scripts/gen_timm_fixture.py) maps one-to-one onto
+    the flax param tree with exact shapes, covering every parameter.
+
+    This is the strongest converter evidence available in a zero-egress
+    environment; the weights-level golden check is ``test_golden_tile_parity``
+    below (README "Verifying tile-encoder parity").
+    """
+    import json
+
+    fixture = os.path.join(os.path.dirname(__file__), "fixtures", "timm_vitg_keys.json")
+    with open(fixture) as f:
+        schema = {k: tuple(v) for k, v in json.load(f).items()}
+
+    # param count of the schema == the derived timm model size
+    assert sum(int(np.prod(s)) for s in schema.values()) == 1_134_953_984
+
+    from gigapath_tpu.models.tile_encoder import gigapath_tile_enc
+
+    model = gigapath_tile_enc()
+    x = jax.ShapeDtypeStruct((1, 224, 224, 3), jnp.float32)
+    tree = jax.eval_shape(model.init, jax.random.PRNGKey(0), x)["params"]
+    flat = {
+        tuple(getattr(p, "key", str(p)) for p in path): leaf.shape
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]
+    }
+
+    # stream keys one at a time (full zero tensors would cost ~4.5 GB)
+    converted: dict = {}
+    for key, shape in schema.items():
+        (path, arr), = convert_timm_state_dict(
+            {key: np.zeros(shape, np.float32)}
+        ).items()
+        assert path not in converted, f"{key} collides at {path}"
+        converted[path] = arr.shape
+
+    assert set(converted) == set(flat), (
+        sorted(set(flat) - set(converted))[:5],
+        sorted(set(converted) - set(flat))[:5],
+    )
+    for path, shape in converted.items():
+        assert tuple(flat[path]) == tuple(shape), (path, flat[path], shape)
+
+
 GOLDEN_CKPT = os.environ.get("GIGAPATH_TILE_ENCODER_CKPT", "")
 GOLDEN_PNG = "/root/reference/images/prov_normal_000_1.png"
 GOLDEN_PT = "/root/reference/images/prov_normal_000_1.pt"
