@@ -131,7 +131,11 @@ def bench_tile_encoder(peak_flops: float):
     )
     tiles_per_sec = TILE_BATCH / sec_per_iter
 
-    flops = compiled_flops(lambda x: model.apply({"params": params}, x), imgs)
+    # params as an ARG: closed-over params become 4.5 GB of inline constants
+    # in the lowered HLO (and overflow the remote-compile request)
+    flops = compiled_flops(
+        lambda x, p: model.apply({"params": p}, x), imgs, params
+    )
     if not flops or not np.isfinite(flops):
         # analytic fallback. SwiGLU MLP: packed fc1 is [d -> hidden] where
         # hidden = 8192 already counts both gate+value mats (2 x 4096), and
@@ -173,7 +177,7 @@ def main():
     mfu = (workload_flops(N) / sec_per_iter) / peak
 
     mem = compiled_memory(
-        lambda x: model.apply({"params": params}, x, coords)[0], x
+        lambda x, p: model.apply({"params": p}, x, coords)[0], x, params
     )
     peak_hbm_gb = None
     if mem and np.isfinite(mem["temp_bytes"]) and np.isfinite(mem["argument_bytes"]):

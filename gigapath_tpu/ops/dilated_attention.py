@@ -47,6 +47,13 @@ from gigapath_tpu.ops.common import round_up as _round_up
 AttnFn = Callable[..., Tuple[jnp.ndarray, jnp.ndarray]]
 
 
+def _env_flag(name: str) -> bool:
+    """Truthy env flag; '0'/'false'/'no'/'' all mean OFF."""
+    import os
+
+    return os.environ.get(name, "").strip().lower() not in ("", "0", "false", "no")
+
+
 def _kv_valid_lengths(
     batch: int, n_seg: int, seg_len: int, ratio: int, m: int, num_heads: int, real_len: int
 ) -> Optional[np.ndarray]:
@@ -257,6 +264,25 @@ def _branch_bhld(
     gp = _round_up(g, r)
     m = gp // r
 
+    if use_pallas is None:
+        from gigapath_tpu.ops.flash_attention import PALLAS_MIN_SEQ, _on_tpu
+
+        use_pallas = (interpret or _on_tpu()) and m >= PALLAS_MIN_SEQ
+
+    if use_pallas and r == 1:
+        from gigapath_tpu.ops.pallas_flash import FLAT_MAX_SEGMENT, flat_segment_flash
+
+        if g % 8 == 0 and g <= FLAT_MAX_SEGMENT:
+            # undilated branch on the FLAT arrays: no pads, reshapes,
+            # dilation, or scatter-back — the ragged tail rides Pallas OOB
+            # auto-masking + the per-segment kvlen select. This removes the
+            # branch's entire XLA glue (the L -> round_up(L, g) pad alone
+            # copied the whole tensor, ~0.12 ms each for q/k/v at L=10k).
+            return flat_segment_flash(
+                qh, kh, vh, segment_len=g, real_len=real_len,
+                is_causal=is_causal, interpret=interpret,
+            )
+
     def seg(x):
         if Lp != L:
             x = jnp.pad(x, ((0, 0), (0, 0), (0, Lp - L), (0, 0)))
@@ -270,10 +296,6 @@ def _branch_bhld(
     if kvlen is not None:
         kvlen = np.broadcast_to(kvlen[None], (B, H, n))
 
-    if use_pallas is None:
-        from gigapath_tpu.ops.flash_attention import PALLAS_MIN_SEQ, _on_tpu
-
-        use_pallas = (interpret or _on_tpu()) and m >= PALLAS_MIN_SEQ
     if use_pallas:
         from gigapath_tpu.ops.pallas_flash import pallas_segment_flash
 
@@ -369,6 +391,7 @@ def dilated_attention_bhld(
     valid_len: Optional[int] = None,
     interpret: bool = False,
     use_pallas: Optional[bool] = None,
+    streaming_fusion: bool = False,
 ) -> jnp.ndarray:
     """Head-major fast path for multi-branch dilated attention.
 
@@ -391,6 +414,39 @@ def dilated_attention_bhld(
     qh = q.transpose(0, 2, 1, 3)
     kh = k.transpose(0, 2, 1, 3)
     vh = v.transpose(0, 2, 1, 3)
+
+    if streaming_fusion and len(segment_lengths) > 1:
+        # Online softmax over the BRANCH axis: each branch's (out, lse) is
+        # folded into running (acc, m, l) and its buffers die before the
+        # next branch computes — the stacked fusion below keeps all
+        # n_branch dense outputs live simultaneously, which dominates peak
+        # HBM at PANDA-scale N (the 1M-token operating point). Identical
+        # math: final = sum_b softmax_b(lse)[b] * out_b, weights constant
+        # in backward (stop_gradient, parity with reference torch.no_grad).
+        acc = m_run = l_run = None
+        for sl, r in zip(segment_lengths, dilated_ratios):
+            o, l = _branch_bhld(
+                qh, kh, vh, int(sl), int(r),
+                is_causal=is_causal, real_len=real_len,
+                interpret=interpret, use_pallas=use_pallas,
+            )
+            l = jax.lax.stop_gradient(l)[..., None]  # [B, H, L, 1]
+            if acc is None:
+                m_run = l
+                l_run = jnp.ones_like(l)
+                acc = o.astype(jnp.float32)
+            else:
+                m_new = jnp.maximum(m_run, l)
+                a = jnp.exp(m_run - m_new)
+                b_ = jnp.exp(l - m_new)
+                l_run = l_run * a + b_
+                acc = acc * a + o.astype(jnp.float32) * b_
+                m_run = m_new
+        out = acc / l_run
+        return jax.lax.optimization_barrier(
+            out.astype(q.dtype).transpose(0, 2, 1, 3)
+        )
+
     outs, lses = [], []
     for sl, r in zip(segment_lengths, dilated_ratios):
         o, l = _branch_bhld(
@@ -514,23 +570,25 @@ def dilated_attention(
         and q.shape == k.shape == v.shape
         and valid_len_is_static
     ):
-        import os
-
         from gigapath_tpu.ops.flash_attention import _on_tpu
 
         # escape hatch: GIGAPATH_FORCE_GENERIC_ATTN=1 re-routes the default
         # TPU dispatch to the generic jnp path (compiled-kernel triage aid;
         # the compiled kernels are otherwise validated by
         # scripts/tpu_selfcheck.py rather than the CPU/interpret CI tier)
-        if _on_tpu() and not os.environ.get("GIGAPATH_FORCE_GENERIC_ATTN"):
+        if _on_tpu() and not _env_flag("GIGAPATH_FORCE_GENERIC_ATTN"):
             # Head-major fast path. The phase-major dilated_attention_fused
             # kernels (pallas_dilated.py) have faster attention cells but
             # their per-branch packing relayouts currently cost more than
             # they save end-to-end (v5e traces: reshape+pad dominate); keep
             # them opt-in until the packing is kernel-side.
+            # GIGAPATH_STREAMING_FUSION=1: fold branches into running
+            # (acc, m, l) instead of stacking all branch outputs — ~2x
+            # lower peak HBM, the enabler for the 1M-token operating point.
             return dilated_attention_bhld(
                 q, k, v, segment_lengths, dilated_ratios,
                 is_causal=is_causal, valid_len=valid_len,
+                streaming_fusion=_env_flag("GIGAPATH_STREAMING_FUSION"),
             )
 
     outs, lses = [], []
@@ -721,7 +779,7 @@ class DilatedAttention(MultiheadAttention):
         """
         try:
             off = int(cur)
-        except jax.errors.TracerIntegerConversionError as e:
+        except jax.errors.ConcretizationTypeError as e:
             raise NotImplementedError(
                 "DilatedAttention incremental decode requires a concrete "
                 "cache index (run the generation loop eagerly, outside jit): "

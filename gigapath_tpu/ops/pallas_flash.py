@@ -78,8 +78,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref, m_ref, l_ref, ac
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    @pl.when(j * block_k < kvlen_ref[b, h, sg])
-    def _compute():
+    def _online_step(masked: bool):
         # scale (with log2(e) folded in: the hot loop runs exp2, one fewer
         # VPU pass per logit than exp) applied to q: block_q*D elements
         # instead of block_q*block_k
@@ -96,32 +95,67 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref, m_ref, l_ref, ac
 
         m_prev = m_ref[:, :1]
         l_prev = l_ref[:, :1]
-        # kv-length masking as a per-COLUMN bias row broadcast-added into s
-        # (the mask depends only on the column): 1-D compare + 1 broadcast
-        # add beats the 2-D iota+compare+where of the naive formulation on
-        # the VPU. Masked keys can be REAL activations (alignment padding
-        # becomes nonzero after the first residual layer), so they must hit
-        # NEG_INF *before* the running max — a post-hoc p multiply would let
-        # them raise m_new and underflow valid rows. M_FLOOR keeps m_new
-        # finite even for fully-masked rows, so exp2(NEG_INF - m_new)
-        # underflows to exactly 0.
-        col_bias = jnp.where(
-            jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1) + j * block_k
-            < kvlen_ref[b, h, sg],
-            0.0,
-            NEG_INF,
-        )
-        s = s + col_bias
+        if masked:
+            # kv-length masking as a per-COLUMN select (the mask depends
+            # only on the column, so it broadcasts from one [1, bk] row).
+            # A select, not an additive bias: masked keys can be REAL
+            # activations (alignment padding becomes nonzero after the
+            # first residual layer) or — on the flat path — out-of-bounds
+            # DMA garbage that may be non-finite, and NaN + NEG_INF stays
+            # NaN where the select yields exactly NEG_INF. Masking must
+            # precede the running max; M_FLOOR keeps m_new finite even for
+            # fully-masked rows, so exp2(NEG_INF - m_new) underflows to 0.
+            col_ok = (
+                jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1) + j * block_k
+                < kvlen_ref[b, h, sg]
+            )
+            s = jnp.where(col_ok, s, NEG_INF)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp2(s - m_new)
-        alpha = jnp.exp2(m_prev - m_new)
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0, 0, 0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+        v = v_ref[0, 0, 0]
+        if masked:
+            # masked key rows of V can be OOB garbage on the flat path
+            # (non-finite bits); p is exactly 0 there but 0 * NaN = NaN in
+            # the PV contraction, so V itself must be zeroed
+            row_ok = (
+                jax.lax.broadcasted_iota(jnp.int32, (block_k, 1), 0) + j * block_k
+                < kvlen_ref[b, h, sg]
+            )
+            v = jnp.where(row_ok, v, 0)
+        if pl.num_programs(4) == 1:
+            # single k block: no online carry — skip the acc rescale and
+            # write the stats once (saves two [bq, 1] scratch stores and an
+            # alpha pass on every single-segment branch)
+            l_new = jnp.sum(p, axis=-1, keepdims=True)
+            acc_ref[:] = jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            alpha = jnp.exp2(m_prev - m_new)
+            l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        # single-lane stats stores (the broadcast-to-128-lane form wrote
+        # 128x the bytes per step)
+        m_ref[:, :1] = m_new
+        l_ref[:, :1] = l_new
+
+    # full key blocks skip the col-bias pass entirely (one fewer VPU pass
+    # over the [bq, bk] tile — the inner loop is VPU-bound); only the block
+    # straddling the valid-key boundary pays for masking
+    @pl.when((j + 1) * block_k <= kvlen_ref[b, h, sg])
+    def _compute_full():
+        _online_step(masked=False)
+
+    @pl.when(
+        (j * block_k < kvlen_ref[b, h, sg])
+        & ((j + 1) * block_k > kvlen_ref[b, h, sg])
+    )
+    def _compute_partial():
+        _online_step(masked=True)
 
     @pl.when(j == pl.num_programs(4) - 1)
     def _finalize():
@@ -137,7 +171,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref, m_ref, l_ref, ac
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dq_ref, dq_acc,
-               *, scale, causal, block_q, block_k):
+               *, scale, causal, block_q, block_k, flat=False):
     b, h, sg = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     i, j = pl.program_id(3), pl.program_id(4)
 
@@ -149,28 +183,39 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dq_re
     def _compute():
         q = q_ref[0, 0, 0]
         k = k_ref[0, 0, 0]
+        v = v_ref[0, 0, 0]
+        col_ok = (
+            jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1) + j * block_k
+            < kvlen_ref[b, h, sg]
+        )
+        if flat:
+            # flat mode reads the unpadded arrays: masked key rows can be
+            # OOB garbage (possibly non-finite), and 0 * NaN = NaN inside
+            # the contractions — zero K/V rows before any matmul touches
+            # them (padded mode's masked rows are provably zero already)
+            krow_ok = (
+                jax.lax.broadcasted_iota(jnp.int32, (block_k, 1), 0) + j * block_k
+                < kvlen_ref[b, h, sg]
+            )
+            k = jnp.where(krow_ok, k, 0)
+            v = jnp.where(krow_ok, v, 0)
         # log2-units recompute (exp2 is one fewer VPU pass than exp); the
         # natural-log lse is rescaled on its [bq, 1] column, not per logit
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * (scale * LOG2E)
-        # column-bias masking BEFORE the exp (see the forward kernel): a
-        # post-hoc zero-multiply would compute exp of unbounded masked
-        # logits — inf * 0 = NaN in the gradients
-        col_bias = jnp.where(
-            jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1) + j * block_k
-            < kvlen_ref[b, h, sg],
-            0.0,
-            NEG_INF,
+        # masking BEFORE the exp: a post-hoc zero-multiply would compute
+        # exp of unbounded masked logits — inf * 0 = NaN in the gradients
+        p = jnp.exp2(
+            jnp.where(col_ok, s, NEG_INF) - lse_ref[0, 0, 0][:, :1] * LOG2E
         )
-        p = jnp.exp2(s + col_bias - lse_ref[0, 0, 0][:, :1] * LOG2E)
         if causal:
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
             rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
             p = jnp.where(cols > rows, 0.0, p)
 
         dp = jax.lax.dot_general(
-            do_ref[0, 0, 0].astype(jnp.float32), v_ref[0, 0, 0].astype(jnp.float32),
+            do_ref[0, 0, 0].astype(jnp.float32), v.astype(jnp.float32),
             (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta_ref[0, 0, 0][:, :1])
@@ -185,7 +230,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dq_re
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dk_ref, dv_ref,
-                dk_acc, dv_acc, *, scale, causal, block_q, block_k):
+                dk_acc, dv_acc, *, scale, causal, block_q, block_k, flat=False):
     b, h, sg = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     j, i = pl.program_id(3), pl.program_id(4)  # grid: (B, H, S, nk, nq)
 
@@ -198,22 +243,35 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dk_r
     def _compute():
         q = q_ref[0, 0, 0]
         k = k_ref[0, 0, 0]
+        do = do_ref[0, 0, 0].astype(jnp.float32)
+        if flat:
+            # flat self-attention: valid q rows == valid key rows per
+            # segment; OOB q/do rows are garbage and would pollute the
+            # dk/dv row-sums through the transposed contractions
+            qrow_ok = (
+                jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0) + i * block_q
+                < kvlen_ref[b, h, sg]
+            )
+            q = jnp.where(qrow_ok, q, 0)
+            do = jnp.where(qrow_ok, do, 0)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * (scale * LOG2E)  # (BQ, BK), log2 units (see _dq_kernel)
-        col_bias = jnp.where(
+        col_ok = (
             jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1) + j * block_k
-            < kvlen_ref[b, h, sg],
-            0.0,
-            NEG_INF,
+            < kvlen_ref[b, h, sg]
         )
-        p = jnp.exp2(s + col_bias - lse_ref[0, 0, 0][:, :1] * LOG2E)  # (BQ, BK)
+        p = jnp.exp2(
+            jnp.where(col_ok, s, NEG_INF) - lse_ref[0, 0, 0][:, :1] * LOG2E
+        )  # (BQ, BK)
+        if flat:
+            # OOB q rows carry garbage lse — their p rows must be exact 0
+            p = jnp.where(qrow_ok, p, 0.0)
         if causal:
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
             rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
             p = jnp.where(cols > rows, 0.0, p)
 
-        do = do_ref[0, 0, 0].astype(jnp.float32)
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )  # (BK, D)
@@ -222,6 +280,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dk_r
             preferred_element_type=jnp.float32,
         )  # (BQ, BK)
         ds = p * (dp - delta_ref[0, 0, 0][:, :1])
+        if flat:
+            ds = jnp.where(qrow_ok, ds, 0.0)
         dk_acc[:] += jax.lax.dot_general(
             ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -360,6 +420,158 @@ def _bwd_impl(q, k, v, lse, delta, do, kv_lens, causal, scale, block_q, block_k,
         dk[:, :, :, :Mk],
         dv[:, :, :, :Mk],
     )
+
+
+# ---------------------------------------------------------------------------
+# flat (zero-pad) segment path
+# ---------------------------------------------------------------------------
+
+
+def _flat_specs(g, D):
+    """Specs over flat [B, H, 1, L, D] views: segment s = row block s
+    (block size g) on the L axis, exploiting Pallas auto-masking for the
+    non-divisible tail — the branch needs NO pads, reshapes, or slices at
+    all (OOB reads are masked in-kernel, OOB writes dropped). The size-1
+    third dim keeps the block rank identical to the segmented path so the
+    kernels are shared verbatim."""
+    q_spec = pl.BlockSpec(
+        (1, 1, 1, g, D), lambda b, h, s, i, j: (b, h, 0, s, 0),
+        memory_space=pltpu.VMEM,
+    )
+    lse_spec = pl.BlockSpec(
+        (1, 1, 1, g, LANES), lambda b, h, s, i, j: (b, h, 0, s, 0),
+        memory_space=pltpu.VMEM,
+    )
+    return q_spec, lse_spec
+
+
+def _flat_fwd_impl(q, k, v, g, real_len, causal, interpret):
+    """Flat segment flash: [B, H, L, D] -> (out [B, H, L, D], lse [B, H, L]).
+
+    Segment s attends within itself; g is the segment length (one q and one
+    k block per segment — requires g small enough for a single block)."""
+    B, H, L, D = q.shape
+    S = _round_up(L, g) // g
+    kvlen = np.clip(real_len - np.arange(S) * g, 0, g).astype(np.int32)
+    kvlen = jnp.asarray(np.broadcast_to(kvlen[None, None], (B, H, S)))
+    q_spec, lse_spec = _flat_specs(g, D)
+    q5, k5, v5 = q[:, :, None], k[:, :, None], v[:, :, None]
+    kernel = functools.partial(
+        _fwd_kernel, scale=D ** -0.5, causal=causal, block_q=g, block_k=g
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(B, H, S, 1, 1),
+        in_specs=[q_spec, q_spec, q_spec, pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=[q_spec, lse_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, 1, L, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, 1, L, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((g, LANES), jnp.float32),
+            pltpu.VMEM((g, LANES), jnp.float32),
+            pltpu.VMEM((g, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q5, k5, v5, kvlen)
+    return out[:, :, 0], lse[:, :, 0, :, 0]
+
+
+def _flat_bwd_impl(q, k, v, lse, delta, do, g, real_len, causal, interpret):
+    B, H, L, D = q.shape
+    S = _round_up(L, g) // g
+    kvlen = np.clip(real_len - np.arange(S) * g, 0, g).astype(np.int32)
+    kvlen = jnp.asarray(np.broadcast_to(kvlen[None, None], (B, H, S)))
+    # lse/delta carried at LANES width for TPU tiling
+    lseL = jnp.broadcast_to(lse[:, :, None, :, None], (B, H, 1, L, LANES))
+    deltaL = jnp.broadcast_to(delta[:, :, None, :, None], (B, H, 1, L, LANES))
+    q_spec, lse_spec = _flat_specs(g, D)
+    q5, k5, v5, do5 = q[:, :, None], k[:, :, None], v[:, :, None], do[:, :, None]
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    scale = D ** -0.5
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal, block_q=g, block_k=g,
+            flat=True,
+        ),
+        grid=(B, H, S, 1, 1),
+        in_specs=[q_spec, q_spec, q_spec, q_spec, lse_spec, lse_spec, smem],
+        out_specs=[q_spec],
+        out_shape=[jax.ShapeDtypeStruct((B, H, 1, L, D), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((g, D), jnp.float32)],
+        interpret=interpret,
+    )(q5, k5, v5, do5, lseL, deltaL, kvlen)[0]
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal, block_q=g, block_k=g,
+            flat=True,
+        ),
+        grid=(B, H, S, 1, 1),
+        in_specs=[q_spec, q_spec, q_spec, q_spec, lse_spec, lse_spec, smem],
+        out_specs=[q_spec, q_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, 1, L, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, 1, L, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((g, D), jnp.float32),
+            pltpu.VMEM((g, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q5, k5, v5, do5, lseL, deltaL, kvlen)
+    return dq[:, :, 0], dk[:, :, 0], dv[:, :, 0]
+
+
+def _flat_fwd_rule(g, real_len, causal, interpret, q, k, v):
+    out, lse = _flat_fwd_impl(q, k, v, g, real_len, causal, interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flat_bwd_rule(g, real_len, causal, interpret, res, cotangents):
+    q, k, v, out, lse = res
+    do, _dlse = cotangents
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    return _flat_bwd_impl(
+        q, k, v, lse, delta, do, g, real_len, causal, interpret
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _flat_with_lse(g, real_len, causal, interpret, q, k, v):
+    return _flat_fwd_impl(q, k, v, g, real_len, causal, interpret)
+
+
+_flat_with_lse.defvjp(_flat_fwd_rule, _flat_bwd_rule)
+
+# g (= block) beyond this exceeds the per-cell VMEM budget (fp32 logits
+# tile g^2 plus blocks and stats)
+FLAT_MAX_SEGMENT = 1408
+
+
+def flat_segment_flash(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    segment_len: int,
+    real_len: Optional[int] = None,
+    is_causal: bool = False,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Zero-glue segmented flash on flat [B, H, L, D] (undilated branches).
+
+    Each ``segment_len`` chunk attends within itself; the ragged tail rides
+    Pallas OOB auto-masking + the kvlen select, so the caller needs no
+    pads/reshapes — the dominant XLA glue of short-segment branches.
+    Requires ``segment_len % 8 == 0`` and ``segment_len <= FLAT_MAX_SEGMENT``.
+    """
+    B, H, L, D = q.shape
+    assert segment_len % 8 == 0 and segment_len <= FLAT_MAX_SEGMENT
+    rl = L if real_len is None else min(int(real_len), L)
+    return _flat_with_lse(segment_len, rl, is_causal, interpret, q, k, v)
 
 
 def _flash_fwd_rule(kv_lens, causal, interpret, block_q, block_k, q, k, v):

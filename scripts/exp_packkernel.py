@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""E1: can a Pallas copy kernel do the phase-major pack faster than XLA?
+
+Packs dense [B, S*g, E] into the 7-D kernel layout [B, S, r, r, hb, Mp, Dh]
+(diagonal blocks only) two ways:
+
+  xla:    reshape + 7-D transpose (what _to_phase_major did in round 2)
+  pallas: r static-phase pallas_call copy kernels, each reading dense
+          [rows, E] blocks and writing [hb, Mp-block, Dh] head-split blocks
+          via static strided row extraction + static lane slices
+
+Prints us/tensor for one branch geometry.
+"""
+
+import argparse
+import functools
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def pack_xla(x, B, S, g, gp, r, m, Mp, H, Dh, hb):
+    L = x.shape[1]
+    if S * g != L:
+        x = jnp.pad(x, ((0, 0), (0, S * g - L), (0, 0)))
+    x = x.reshape(B, S, g, -1)
+    if gp != g:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
+    x = x.reshape(B, S, m, r, r, hb, Dh)
+    x = x.transpose(0, 1, 3, 4, 5, 2, 6)
+    if Mp != m:
+        x = jnp.pad(x, ((0, 0),) * 5 + ((0, Mp - m), (0, 0)))
+    return x
+
+
+def _pack_kernel(x_ref, o_ref, *, p, r, hb, Dh, bt):
+    # x_ref block [1, 1, bt*r, E]; o_ref block [1, 1, 1, hb, bt, Dh]
+    x = x_ref[0, 0]  # [bt*r, E]
+    rows = x.reshape(bt, r, -1)[:, p, :]  # [bt, E] static strided row extract
+    W = hb * Dh
+    band = rows[:, p * W : (p + 1) * W]  # [bt, W] static lane slice
+    for t in range(hb):
+        o_ref[0, 0, 0, t] = band[:, t * Dh : (t + 1) * Dh]
+
+
+def pack_pallas(x, B, S, g, gp2, r, m, Mp, H, Dh, hb, bt, interpret=False):
+    L = x.shape[1]
+    E = x.shape[2]
+    if S * g != L:
+        x = jnp.pad(x, ((0, 0), (0, S * g - L), (0, 0)))
+    x = x.reshape(B, S, g, E)
+    if gp2 != g:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, gp2 - g), (0, 0)))
+    nq = Mp // bt
+    outs = []
+    for p in range(r):
+        out = pl.pallas_call(
+            functools.partial(_pack_kernel, p=p, r=r, hb=hb, Dh=Dh, bt=bt),
+            grid=(B, S, nq),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, bt * r, E), lambda b, s, i: (b, s, i, 0),
+                    memory_space=pltpu.VMEM,
+                )
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, 1, hb, bt, Dh), lambda b, s, i: (b, s, 0, 0, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            out_shape=jax.ShapeDtypeStruct((B, S, 1, hb, Mp, Dh), x.dtype),
+            interpret=interpret,
+        )(x)
+        outs.append(out)
+    return jnp.concatenate(outs, axis=2)  # [B, S, r(band==phase here), hb, Mp, Dh]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--branch", type=int, default=3)
+    ap.add_argument("--n", type=int, default=10241)
+    ap.add_argument("--check", action="store_true")
+    args = ap.parse_args()
+
+    from gigapath_tpu.models.longnet_config import flagship_geometry
+    from gigapath_tpu.ops.common import round_up
+    from gigapath_tpu.utils.timing import chained_seconds_per_iter
+
+    G = flagship_geometry()
+    H, Dh = G["heads"], G["head_dim"]
+    E = H * Dh
+    sl, r = G["segment_lengths"][args.branch], G["dilated_ratios"][args.branch]
+    L = args.n
+    g = min(sl, L)
+    S = round_up(L, g) // g
+    gp = round_up(g, r)
+    m = gp // r
+    hb = H // r
+    bt = min(512, round_up(m, 8))
+    Mp = round_up(m, bt)
+    gp2 = Mp * r
+    print(f"branch {args.branch}: r={r} g={g} S={S} m={m} Mp={Mp} hb={hb} bt={bt}")
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(1, L, E)), jnp.bfloat16)
+
+    if args.check:
+        a = pack_xla(x.astype(jnp.float32), 1, S, g, gp, r, m, Mp, H, Dh, hb)
+        bnd = pack_pallas(
+            x.astype(jnp.float32), 1, S, g, gp2, r, m, Mp, H, Dh, hb, bt,
+            interpret=True,
+        )
+        # compare diagonal blocks of xla pack vs pallas pack
+        diag = jnp.stack([a[:, :, p, p] for p in range(r)], axis=2)
+        np.testing.assert_allclose(
+            np.asarray(diag), np.asarray(bnd), atol=0, rtol=0
+        )
+        print("pack check OK")
+        return
+
+    def step_xla(x):
+        y = pack_xla(x, 1, S, g, gp, r, m, Mp, H, Dh, hb)
+        return x + (y.astype(jnp.float32).sum() * 1e-30).astype(x.dtype)
+
+    def step_pal(x):
+        y = pack_pallas(x, 1, S, g, gp2, r, m, Mp, H, Dh, hb, bt)
+        return x + (y.astype(jnp.float32).sum() * 1e-30).astype(x.dtype)
+
+    results = {}
+    for name, fn in [("xla", step_xla), ("pallas", step_pal)]:
+        secs = []
+        for _ in range(3):
+            sec, _o = chained_seconds_per_iter(fn, x, iters_low=2, iters_high=22)
+            secs.append(sec)
+        results[name] = min(secs)
+        print(f"{name:7s} {min(secs) * 1e6:9.1f} us/tensor")
+
+
+if __name__ == "__main__":
+    main()

@@ -63,26 +63,10 @@ def main():
             x = step(x, k, v)
         x.block_until_ready()
 
-    from jax.profiler import ProfileData
+    from gigapath_tpu.utils.profiling import xla_op_totals
 
-    traces = sorted(
-        glob.glob(os.path.join(tmp, "**", "*.xplane.pb"), recursive=True)
-    )
-    pd = ProfileData.from_file(traces[-1])
-    totals = {}
-    async_totals = {}
-    for plane in pd.planes:
-        if "TPU" not in plane.name:
-            continue
-        for line in plane.lines:
-            if line.name == "XLA Ops":
-                for ev in line.events:
-                    totals[ev.name] = totals.get(ev.name, 0.0) + ev.duration_ns / 1e3
-            elif "Async" in line.name:
-                for ev in line.events:
-                    async_totals[ev.name] = (
-                        async_totals.get(ev.name, 0.0) + ev.duration_ns / 1e3
-                    )
+    agg = xla_op_totals(tmp)
+    totals, async_totals = agg["ops"], agg["async"]
     total_us = sum(totals.values())
     print(f"total XLA-op time: {total_us / args.iters / 1e3:.3f} ms/iter")
     for name, us in sorted(totals.items(), key=lambda kv: -kv[1])[: args.top]:

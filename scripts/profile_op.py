@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""XLA-op-time attribution for the full 5-branch dilated op + summary.
+
+Chip wall-clock on the shared axon chip includes co-tenant interference;
+the 'XLA Ops' line sums only this process's device ops, giving a
+contention-independent (if DMA-stall-blind) cost measure.
+"""
+
+import glob
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    from gigapath_tpu.models.longnet_config import flagship_geometry
+    from gigapath_tpu.ops import dilated_attention as da
+
+    G = flagship_geometry()
+    H, Dh = G["heads"], G["head_dim"]
+    SEGS, RATIOS = G["segment_lengths"], G["dilated_ratios"]
+    L = 10241
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(1, L, H, Dh)), jnp.bfloat16) for _ in range(3)
+    )
+
+    @jax.jit
+    def step(x, k, v):
+        out = da.dilated_attention_bhld(x, k, v, SEGS, RATIOS)
+        return x + (out.astype(jnp.float32).sum() * 1e-30).astype(x.dtype)
+
+    x = step(q, k, v)
+    x.block_until_ready()
+    iters = 10
+    tmp = tempfile.mkdtemp(prefix="opprof_")
+    with jax.profiler.trace(tmp):
+        for _ in range(iters):
+            x = step(x, k, v)
+        x.block_until_ready()
+
+    from gigapath_tpu.utils.profiling import xla_op_totals
+
+    totals = xla_op_totals(tmp)["ops"]
+    kernels = sum(
+        us for name, us in totals.items()
+        if "custom" in name or "step." in name.split(" = ")[0]
+    )
+    glue = sum(totals.values()) - kernels
+    total = sum(totals.values())
+    print(f"total XLA-op time: {total / iters / 1e3:.3f} ms/op over {iters} iters")
+    print(f"  pallas kernels:  {kernels / iters / 1e3:.3f} ms/op")
+    print(f"  XLA glue:        {glue / iters / 1e3:.3f} ms/op")
+    for name, us in sorted(totals.items(), key=lambda kv: -kv[1])[:12]:
+        print(f"  {us / iters:9.1f} us  {100 * us / total:5.1f}%  {name[:100]}")
+
+
+if __name__ == "__main__":
+    main()

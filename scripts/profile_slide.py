@@ -67,32 +67,21 @@ def attribute():
         for _ in range(iters):
             out = f(x, coords)
         out.block_until_ready()
-    traces = sorted(glob.glob(d + "/**/*.xplane.pb", recursive=True))
-    if not traces:
-        raise RuntimeError(f"jax.profiler wrote no .xplane.pb under {d}")
-    pd = ProfileData.from_file(traces[-1])
-    found = False
-    for plane in pd.planes:
-        if "TPU" not in plane.name:
-            continue
-        for line in plane.lines:
-            if line.name != "XLA Ops":
-                continue
-            tot = collections.Counter()
-            for ev in line.events:
-                nm = ev.name.split("=")[0].strip().lstrip("%")
-                tot[re.sub(r"(\.\d+)+$", "", nm.split(" ")[0])] += ev.duration_ns
-            print(f"depth-2 critical path at N={N} (ms/iter by op kind):")
-            for name, ns in tot.most_common(15):
-                print(f"  {ns/1e6/iters:9.4f} ms  {name}")
-            found = True
-        if found:
-            break
-    if not found:
+    from gigapath_tpu.utils.profiling import xla_op_totals
+
+    ops = xla_op_totals(d)["ops"]
+    if not ops:
         raise RuntimeError(
             "no TPU 'XLA Ops' line in the trace — is a TPU backend active? "
             f"(jax.default_backend() = {jax.default_backend()})"
         )
+    tot = collections.Counter()
+    for name, us in ops.items():
+        nm = name.split("=")[0].strip().lstrip("%")
+        tot[re.sub(r"(\.\d+)+$", "", nm.split(" ")[0])] += us
+    print(f"depth-2 critical path at N={N} (ms/iter by op kind):")
+    for name, us in tot.most_common(15):
+        print(f"  {us/1e3/iters:9.4f} ms  {name}")
 
 
 def main():
