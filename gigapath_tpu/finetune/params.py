@@ -51,6 +51,7 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--optim", type=str, default="adamw", choices=["adam", "adamw"])
     parser.add_argument("--optim_wd", type=float, default=1e-5, help="Weight decay")
     parser.add_argument("--layer_decay", type=float, default=0.95, help="Layer-wise learning rate decay")
+    parser.add_argument("--checkpoint_activations", action="store_true", default=False, help="Remat each encoder layer (trade recompute for memory; needed for >8k-tile slides on 16 GB chips)")
     parser.add_argument("--dropout", type=float, default=0.1, help="Dropout rate")
     parser.add_argument("--drop_path_rate", type=float, default=0.1, help="Drop path rate")
     parser.add_argument("--val_r", type=float, default=0.1, help="Ratio of data used for validation")

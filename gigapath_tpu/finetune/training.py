@@ -80,6 +80,7 @@ def train(dataloader, fold: int, args):
         drop_path_rate=args.drop_path_rate,
         max_wsi_size=args.max_wsi_size,
         tile_size=args.tile_size,
+        checkpoint_activations=getattr(args, "checkpoint_activations", False),
     )
     stats = count_model_statistics(model, params)
     print(f"Model statistics: {stats['total_params']:,} params")
