@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""A/B: train-step cost with/without per-layer remat (and remat policies).
+
+The PANDA-subset bench showed the remat'd 8k-bucket train step ~7x slower
+per token than the unremat'd 10k step from an earlier session — more than
+the ~1.5x recompute factor explains. This interleaves variants in one
+process on identical shapes.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    import optax
+
+    from gigapath_tpu.models import slide_encoder
+    from gigapath_tpu.utils.timing import chained_seconds_per_iter
+
+    N = 8192
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(1, N, 1536)), jnp.bfloat16)
+    coords = jnp.asarray(rng.uniform(0, 250000, (1, N, 2)), jnp.float32)
+
+    results = {}
+    for name, kwargs in [
+        ("plain", {}),
+        ("remat", {"checkpoint_activations": True}),
+    ]:
+        model, params = slide_encoder.create_model(
+            "", "gigapath_slide_enc12l768d", in_chans=1536,
+            dtype=jnp.bfloat16, **kwargs,
+        )
+        opt = optax.adamw(1e-4)
+        opt_state = opt.init(params)
+
+        def train_step(x, params, opt_state, coords):
+            def loss_fn(p):
+                out = model.apply({"params": p}, x, coords)[0]
+                return out.astype(jnp.float32).var()
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state2 = opt.update(grads, opt_state, params)
+            params2 = jax.tree.map(lambda p, u: p + u, params, updates)
+            leaves = sum(g.sum().astype(jnp.float32) for g in jax.tree.leaves(params2))
+            return x + (leaves * 1e-30).astype(x.dtype)
+
+        sec, _ = chained_seconds_per_iter(
+            train_step, x, args=(params, opt_state, coords),
+            iters_low=2, iters_high=8,
+        )
+        results[name] = sec
+        print(f"{name:6s} {sec * 1e3:9.2f} ms/step  {N / sec:9.0f} tokens/s")
+    print(f"remat/plain ratio: {results['remat'] / results['plain']:.2f}x")
+
+
+if __name__ == "__main__":
+    main()

@@ -47,26 +47,27 @@ def main():
     _G = flagship_geometry()
     H, Dh = _G["heads"], _G["head_dim"]
     SEGS, RATIOS = _G["segment_lengths"], _G["dilated_ratios"]
-    # L=4096 keeps the jnp reference tractable on-chip while still
+    # L=2048 keeps the on-chip jnp reference (the slow part: dense [L, L]
+    # logits per branch) under the ~3-minute per-round budget while still
     # exercising multi-segment branch 1 and every dilation ratio
-    L = 4096
+    L = 2048
     q, k, v = (jnp.asarray(rng.normal(size=(1, L, H, Dh)), jnp.bfloat16) for _ in range(3))
     qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
 
     # plain flash kernel vs jnp (bf16 inputs; fp32 softmax both sides)
-    o_p, l_p = pallas_flash_attention(q[:, :2048], k[:, :2048], v[:, :2048])
-    o_j, l_j = attention_with_lse(q[:, :2048], k[:, :2048], v[:, :2048])
+    o_p, l_p = pallas_flash_attention(q, k, v)
+    o_j, l_j = attention_with_lse(q, k, v)
     check("pallas flash fwd (L=2048)", o_p, o_j, 3e-2)
     check("pallas flash lse (L=2048)", l_p, l_j, 3e-2)
 
     # head-major dilated path (the model default) vs generic jnp path
-    ref = da.dilated_attention_bhld(qf, kf, vf, SEGS, RATIOS, valid_len=4001, use_pallas=False)
-    out = da.dilated_attention_bhld(q, k, v, SEGS, RATIOS, valid_len=4001)
-    check("dilated bhld (flagship schedule, valid_len)", out[:, :4001], ref[:, :4001], 5e-2)
+    ref = da.dilated_attention_bhld(qf, kf, vf, SEGS, RATIOS, valid_len=2001, use_pallas=False)
+    out = da.dilated_attention_bhld(q, k, v, SEGS, RATIOS, valid_len=2001)
+    check("dilated bhld (flagship schedule, valid_len)", out[:, :2001], ref[:, :2001], 5e-2)
 
     # phase-major fused kernels vs the same reference
-    out_f = da.dilated_attention_fused(q, k, v, SEGS, RATIOS, valid_len=4001)
-    check("dilated fused (flagship schedule, valid_len)", out_f[:, :4001], ref[:, :4001], 5e-2)
+    out_f = da.dilated_attention_fused(q, k, v, SEGS, RATIOS, valid_len=2001)
+    check("dilated fused (flagship schedule, valid_len)", out_f[:, :2001], ref[:, :2001], 5e-2)
 
     # Gradients through the compiled backward kernels. dq/dk/dv ride ONE
     # jax.grad(argnums=(0,1,2)) per path — one XLA compile covers all three
