@@ -34,6 +34,7 @@ tuple, so ``jit`` unrolls it (5 branches in the flagship configs).
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
@@ -224,7 +225,7 @@ def _segment_attention_jnp(
     ).astype(jnp.float32) * scale
     mask = None
     if kvlen is not None:
-        lens = jnp.asarray(np.asarray(kvlen, np.int32).reshape(-1, H, S))
+        lens = jnp.asarray(kvlen, jnp.int32).reshape(-1, H, S)
         mask = jnp.arange(k5.shape[3])[None, None, None, None, :] >= lens[..., None, None]
         s = jnp.where(mask, NEG_INF, s)
     if is_causal:
@@ -242,6 +243,152 @@ def _segment_attention_jnp(
     return out, lse
 
 
+def _bhld_geom(L: int, sl: int, r: int) -> Tuple[int, int, int, int, int, int]:
+    """(g, Lp, n, gp, m, block) for one head-major branch."""
+    g = min(sl, L)
+    Lp = _round_up(L, g)
+    n = Lp // g
+    gp = _round_up(g, r)
+    m = gp // r
+    # Single-block-if-it-fits: a sparse length like m=1281 under fixed
+    # 1024 blocks pads both q and k to 2048 (2.6x the intrinsic MXU work);
+    # one 1408-square block wastes 10% per side and streams K/V exactly
+    # once. The 1408 cap keeps the fp32 logits tile (block^2 = 7.9 MB)
+    # plus stats/blocks inside the 16 MB VMEM.
+    single = _round_up(m, 128)
+    block = single if single <= 1408 else min(1024, single)
+    return g, Lp, n, gp, m, block
+
+
+def _seg_dilate(x: jnp.ndarray, g: int, Lp: int, n: int, gp: int, r: int) -> jnp.ndarray:
+    """[B, H, L, D] -> dilated segment view [B, H, n, m, D] (static slices)."""
+    B, H, L, Dh = x.shape
+    if Lp != L:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, Lp - L), (0, 0)))
+    x = x.reshape(B, H, n, g, Dh)
+    if gp != g:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, gp - g), (0, 0)))
+    return _dilate_bhld(x, r)
+
+
+def _undilate_to_dense(out_s, lse_s, r, g, Lp, L):
+    B, H = out_s.shape[:2]
+    Dh = out_s.shape[-1]
+    out_d, lse_d = _undilate_bhld(out_s, lse_s, r)  # [B, H, n, gp, D]
+    out = out_d[:, :, :, :g].reshape(B, H, Lp, Dh)[:, :, :L]
+    lse = lse_d[:, :, :, :g].reshape(B, H, Lp)[:, :, :L]
+    return out, lse
+
+
+def _bhld_kvlen(
+    B: int, H: int, n: int, g: int, r: int, m: int, real_len: int,
+    valid_len_dyn: Optional[jnp.ndarray],
+) -> Optional[jnp.ndarray]:
+    """[B, H, n] int32 valid sparse-key counts, or None when every slot is
+    valid: static tail masks (alignment padding, ``real_len``) combined
+    with the optional *traced* per-batch suffix valid lengths (collate pad
+    masks) by minimum. Traced counts keep the Pallas path: the kernels
+    read them from SMEM at runtime.
+
+    The traced block mirrors the numpy formula of
+    :func:`_branch_kvlen_bhld` (sparse slot j of head phase p is valid iff
+    dense position ``p + r*j`` lies inside both the segment and the valid
+    prefix) — keep the two in lockstep;
+    ``test_traced_valid_len_matches_generic`` guards the equivalence."""
+    static = _branch_kvlen_bhld(H, n, g, r, m, real_len)
+    if static is None and valid_len_dyn is None:
+        return None  # all slots valid: lets the jnp tier skip masking
+    if static is None:
+        static = np.full((H, n), m, np.int32)
+    kv = jnp.asarray(np.broadcast_to(static[None], (B, H, n)))
+    if valid_len_dyn is not None:
+        heads_per_group = -(-H // r)
+        phases = jnp.arange(H) // heads_per_group  # [H]
+        seg = jnp.arange(n)  # [n]
+        in_seg = jnp.clip(
+            valid_len_dyn.reshape(B)[:, None] - seg[None] * g, 0, g
+        )  # [B, n]
+        counts = jnp.ceil((in_seg[:, None, :] - phases[None, :, None]) / r)
+        kv = jnp.minimum(kv, jnp.clip(counts, 0, m).astype(jnp.int32))
+    return kv
+
+
+def _branch_pallas_fwd_impl(qh, kh, vh, kvlen, sl, r, is_causal, interpret):
+    from gigapath_tpu.ops import pallas_flash as pf
+
+    B, H, L, Dh = qh.shape
+    g, Lp, n, gp, m, block = _bhld_geom(L, sl, r)
+    q5 = _seg_dilate(qh, g, Lp, n, gp, r)
+    k5 = _seg_dilate(kh, g, Lp, n, gp, r)
+    v5 = _seg_dilate(vh, g, Lp, n, gp, r)
+    out_s, lse_s = pf._fwd_impl(
+        q5, k5, v5, kvlen, is_causal, Dh ** -0.5, block, block, interpret
+    )
+    return _undilate_to_dense(out_s, lse_s, r, g, Lp, L)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _branch_pallas(qh, kh, vh, kvlen, sl, r, is_causal, interpret):
+    """One head-major dilated branch -> dense (out [B,H,L,D], lse [B,H,L]).
+
+    The custom VJP sits at the BRANCH level, above the dilation: residuals
+    are the UNDILATED q/k/v (shared buffers across every branch of the
+    multi-branch op — XLA stores one copy) plus this branch's dense
+    (out, lse). The flash-level VJP instead saved per-branch dilated
+    q5/k5/v5 copies: on the flagship's 5-branch schedule that is ~15 extra
+    [B, H, L, 48]-sized residual tensors per layer, the dominant train-step
+    memory at PANDA-scale N (measured 53 GB at the 16k bucket; 12.4 GB
+    here). Backward re-dilates with the same static slices — a bandwidth
+    pass, no extra kernel work. ``kvlen`` [B, H, n] may be traced.
+    """
+    out, lse = _branch_pallas_fwd_impl(
+        qh, kh, vh, kvlen, sl, r, is_causal, interpret
+    )
+    return out, lse
+
+
+def _branch_pallas_fwd(qh, kh, vh, kvlen, sl, r, is_causal, interpret):
+    out, lse = _branch_pallas_fwd_impl(
+        qh, kh, vh, kvlen, sl, r, is_causal, interpret
+    )
+    return (out, lse), (qh, kh, vh, kvlen, out, lse)
+
+
+def _branch_pallas_bwd(sl, r, is_causal, interpret, res, cots):
+    from gigapath_tpu.ops import pallas_flash as pf
+
+    qh, kh, vh, kvlen, out, lse = res
+    do, _dlse = cots  # dense [B, H, L, D]; no gradient through the lse
+    B, H, L, Dh = qh.shape
+    g, Lp, n, gp, m, block = _bhld_geom(L, sl, r)
+    # re-dilate the inputs + the dense cotangent/out/lse into the kernel
+    # layout (static slices; the rank-3 lse/delta ride a trailing unit dim)
+    q5 = _seg_dilate(qh, g, Lp, n, gp, r)
+    k5 = _seg_dilate(kh, g, Lp, n, gp, r)
+    v5 = _seg_dilate(vh, g, Lp, n, gp, r)
+    do5 = _seg_dilate(do, g, Lp, n, gp, r)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta5 = _seg_dilate(delta[..., None], g, Lp, n, gp, r)[..., 0]
+    lse5 = _seg_dilate(lse[..., None], g, Lp, n, gp, r)[..., 0]
+    dq5, dk5, dv5 = pf._bwd_impl(
+        q5, k5, v5, lse5, delta5, do5, kvlen, is_causal, Dh ** -0.5,
+        block, block, interpret,
+    )
+
+    def undo(g5):
+        dense, _ = _undilate_to_dense(g5, jnp.zeros(g5.shape[:-1], jnp.float32),
+                                      r, g, Lp, L)
+        return dense
+
+    kvlen_ct = (
+        None if kvlen is None else np.zeros(kvlen.shape, dtype=jax.dtypes.float0)
+    )
+    return undo(dq5), undo(dk5), undo(dv5), kvlen_ct
+
+
+_branch_pallas.defvjp(_branch_pallas_fwd, _branch_pallas_bwd)
+
+
 def _branch_bhld(
     qh: jnp.ndarray,
     kh: jnp.ndarray,
@@ -253,23 +400,20 @@ def _branch_bhld(
     real_len: int,
     interpret: bool,
     use_pallas: Optional[bool],
+    valid_len_dyn: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """One dilated branch, entirely in [B, H, L, D]: segment via a free
     reshape, dilate via static phase slices, run the segment-grid flash
     kernel, and undo — no batch-axis reshuffling or relayouts anywhere."""
     B, H, L, Dh = qh.shape
-    g = min(sl, L)
-    Lp = _round_up(L, g)
-    n = Lp // g
-    gp = _round_up(g, r)
-    m = gp // r
+    g, Lp, n, gp, m, block = _bhld_geom(L, sl, r)
 
     if use_pallas is None:
         from gigapath_tpu.ops.flash_attention import PALLAS_MIN_SEQ, _on_tpu
 
         use_pallas = (interpret or _on_tpu()) and m >= PALLAS_MIN_SEQ
 
-    if use_pallas and r == 1:
+    if use_pallas and r == 1 and valid_len_dyn is None:
         from gigapath_tpu.ops.pallas_flash import FLAT_MAX_SEGMENT, flat_segment_flash
 
         if g % 8 == 0 and g <= FLAT_MAX_SEGMENT:
@@ -283,40 +427,15 @@ def _branch_bhld(
                 is_causal=is_causal, interpret=interpret,
             )
 
-    def seg(x):
-        if Lp != L:
-            x = jnp.pad(x, ((0, 0), (0, 0), (0, Lp - L), (0, 0)))
-        x = x.reshape(B, H, n, g, Dh)
-        if gp != g:
-            x = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, gp - g), (0, 0)))
-        return _dilate_bhld(x, r)
-
-    q5, k5, v5 = seg(qh), seg(kh), seg(vh)
-    kvlen = _branch_kvlen_bhld(H, n, g, r, m, real_len)
-    if kvlen is not None:
-        kvlen = np.broadcast_to(kvlen[None], (B, H, n))
-
+    kvlen = _bhld_kvlen(B, H, n, g, r, m, real_len, valid_len_dyn)
     if use_pallas:
-        from gigapath_tpu.ops.pallas_flash import pallas_segment_flash
+        return _branch_pallas(qh, kh, vh, kvlen, sl, r, is_causal, interpret)
 
-        # Single-block-if-it-fits: a sparse length like m=1281 under fixed
-        # 1024 blocks pads both q and k to 2048 (2.6x the intrinsic MXU
-        # work, b3 profile); one 1408-square block wastes 10% per side and
-        # streams K/V exactly once. The 1408 cap keeps the fp32 logits tile
-        # (block^2 = 7.9 MB) plus stats/blocks inside the 16 MB VMEM.
-        single = _round_up(m, 128)
-        block_q = block_k = single if single <= 1408 else min(1024, single)
-        out_s, lse_s = pallas_segment_flash(
-            q5, k5, v5, is_causal=is_causal, kv_len=kvlen,
-            block_q=block_q, block_k=block_k, interpret=interpret,
-        )
-    else:
-        out_s, lse_s = _segment_attention_jnp(q5, k5, v5, kvlen, is_causal)
-
-    out_d, lse_d = _undilate_bhld(out_s, lse_s, r)  # [B, H, n, gp, D]
-    out = out_d[:, :, :, :g].reshape(B, H, Lp, Dh)[:, :, :L]
-    lse = lse_d[:, :, :, :g].reshape(B, H, Lp)[:, :, :L]
-    return out, lse
+    q5 = _seg_dilate(qh, g, Lp, n, gp, r)
+    k5 = _seg_dilate(kh, g, Lp, n, gp, r)
+    v5 = _seg_dilate(vh, g, Lp, n, gp, r)
+    out_s, lse_s = _segment_attention_jnp(q5, k5, v5, kvlen, is_causal)
+    return _undilate_to_dense(out_s, lse_s, r, g, Lp, L)
 
 
 def dilated_attention_fused(
@@ -388,7 +507,7 @@ def dilated_attention_bhld(
     dilated_ratios: Sequence[int],
     *,
     is_causal: bool = False,
-    valid_len: Optional[int] = None,
+    valid_len=None,
     interpret: bool = False,
     use_pallas: Optional[bool] = None,
     streaming_fusion: bool = False,
@@ -402,10 +521,20 @@ def dilated_attention_bhld(
     attention, scatter-back, fusion — is a free reshape, a static slice, or
     a segment-grid Pallas kernel. The per-branch transposes of the generic
     path (3 inputs + out + lse per branch, 5 branches in the flagship) are
-    gone. ``valid_len``: static suffix-padding bound (alignment padding).
+    gone. ``valid_len``: suffix-padding bound — a static int (alignment
+    padding) folds into trace-time masks; a *traced* [B] array (collate pad
+    masks) rides the kernels' SMEM valid-count tables at runtime, keeping
+    the Pallas path for masked batches.
     """
     B, L, H, Dh = q.shape
-    real_len = L if valid_len is None else min(int(valid_len), L)
+    valid_dyn = None
+    if valid_len is None:
+        real_len = L
+    elif isinstance(valid_len, (int, np.integer)):
+        real_len = min(int(valid_len), L)
+    else:
+        real_len = L
+        valid_dyn = jnp.asarray(valid_len).reshape(B)
     # optimization barriers pin the op's boundaries: without them XLA fuses
     # the entry/exit relayouts into the surrounding layernorm/projection
     # fusions, which then read the 48-lane-minor head-major layout strided
@@ -429,6 +558,7 @@ def dilated_attention_bhld(
                 qh, kh, vh, int(sl), int(r),
                 is_causal=is_causal, real_len=real_len,
                 interpret=interpret, use_pallas=use_pallas,
+                valid_len_dyn=valid_dyn,
             )
             l = jax.lax.stop_gradient(l)[..., None]  # [B, H, L, 1]
             if acc is None:
@@ -453,6 +583,7 @@ def dilated_attention_bhld(
             qh, kh, vh, int(sl), int(r),
             is_causal=is_causal, real_len=real_len,
             interpret=interpret, use_pallas=use_pallas,
+            valid_len_dyn=valid_dyn,
         )
         outs.append(o)
         lses.append(l)
@@ -520,9 +651,9 @@ def dilated_attention(
     ``>= valid_len`` are excluded from every branch's keys (the
     masked-batching extension the reference only sketches in its dead
     ``custom_*`` files). A static Python int (same for every row) folds into
-    the existing trace-time tail masks and keeps the Pallas path; a traced
-    [B] array (ragged batches) forces the jnp attention path (dynamic counts
-    can't bake into the Pallas grid).
+    the trace-time tail masks; a traced [B] array (ragged batches) rides the
+    Pallas kernels' runtime SMEM valid-count tables — both keep the compiled
+    fast path.
     """
     attn_fn_was_default = attn_fn is None
     if attn_fn_was_default:
@@ -559,16 +690,17 @@ def dilated_attention(
 
     # Head-major fast path (TPU): see dilated_attention_bhld. Taken whenever
     # nothing forces the generic layout — no custom attn_fn, no dropout, no
-    # sequence parallelism, no decoding offset, and a static (or absent)
-    # padding bound.
-    valid_len_is_static = valid_len is None or isinstance(valid_len, int)
+    # sequence parallelism, no decoding offset. Both static AND traced
+    # valid_len ride this path (traced counts live in the kernels' SMEM
+    # tables) — routing traced masks to the generic jnp tier previously
+    # put the ENTIRE fine-tune train path on dense-probability attention
+    # (53 GB at the 16k bucket).
     if (
         attn_fn_was_default
         and not (dropout_rate > 0.0 and dropout_rng is not None)
         and (seq_axis_name is None or seq_axis_size <= 1)
         and offset == 0
         and q.shape == k.shape == v.shape
-        and valid_len_is_static
     ):
         from gigapath_tpu.ops.flash_attention import _on_tpu
 

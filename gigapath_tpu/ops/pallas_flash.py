@@ -294,12 +294,17 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref, dk_r
 
 
 def _kvlen_array(kv_lens, B: int, H: int, S: int, Lk: int) -> jnp.ndarray:
-    """[B, H, S] int32 valid-key counts from a static tuple (None = all valid)."""
+    """[B, H, S] int32 valid-key counts (None = all valid).
+
+    Accepts a static tuple/np array OR a *traced* jnp array: the kernels
+    read the counts from SMEM at runtime (``pl.when`` on SMEM scalars), so
+    dynamic per-batch padding needs no retrace — only the shapes are
+    static."""
     if kv_lens is None:
-        arr = np.full((B, H, S), Lk, np.int32)
-    else:
-        arr = np.asarray(kv_lens, np.int32).reshape(B, H, S)
-    return jnp.asarray(arr)
+        return jnp.asarray(np.full((B, H, S), Lk, np.int32))
+    if isinstance(kv_lens, (jax.Array, jax.core.Tracer)):
+        return kv_lens.reshape(B, H, S).astype(jnp.int32)
+    return jnp.asarray(np.asarray(kv_lens, np.int32).reshape(B, H, S))
 
 
 def _pad_seg(x: jnp.ndarray, M: int) -> jnp.ndarray:
@@ -608,29 +613,10 @@ def _flash_with_lse(kv_lens, causal, interpret, block_q, block_k, q, k, v):
 _flash_with_lse.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def pallas_segment_flash(
-    q: jnp.ndarray,
-    k: jnp.ndarray,
-    v: jnp.ndarray,
-    *,
-    is_causal: bool = False,
-    kv_len=None,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
-    interpret: bool = False,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Segment-batched flash attention on [B, H, S, M, D] (head-major layout).
-
-    Returns ``(out [B,H,S,M,D], lse [B,H,S,M])``. Segment ``s`` of batch/head
-    ``(b, h)`` attends only within itself. ``kv_len``: optional static
-    [B, H, S] array-like of valid key counts per segment (numpy, trace-time
-    constant); fully-padded key *blocks* are skipped entirely, so generous
-    segment padding costs DMA but no MXU work.
-    """
-    kv_lens = None
-    if kv_len is not None:
-        kv_lens = tuple(int(x) for x in np.asarray(kv_len).reshape(-1))
-    return _flash_with_lse(kv_lens, is_causal, interpret, block_q, block_k, q, k, v)
+# NOTE: the segment-batched entry point for dilated attention is the
+# branch-level custom VJP in ops/dilated_attention.py (_branch_pallas),
+# which calls _fwd_impl/_bwd_impl directly with (possibly traced) kvlen
+# arrays — there is deliberately no second segment-level wrapper here.
 
 
 def pallas_flash_attention(
@@ -644,14 +630,15 @@ def pallas_flash_attention(
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Flash attention on [B, L, H, D] -> (out [B,L,H,D], lse [B,H,L]).
 
-    ``kv_len``: optional static [B, H] array-like of per-(batch, head) valid
-    key counts (ragged masking for dilated-attention tail segments); must be
-    trace-time constants (numpy, not traced arrays).
+    ``kv_len``: optional static [B, H] array-like of per-(batch, head)
+    valid key counts (trace-time constants — this wrapper's custom VJP
+    carries them as nondiff args; for TRACED counts use the branch-level
+    VJP in ops/dilated_attention.py, whose kvlen is a runtime argument).
 
-    Thin wrapper over :func:`pallas_segment_flash` with a single segment:
-    kernels run on ``[B, H, S, M, D]`` blocks — the head-major layout whose
-    trailing block dims satisfy Mosaic's (8, 128) tiling rule — and the
-    wrapper transposes (XLA folds the relayout into surrounding reshapes).
+    Kernels run on ``[B, H, S, M, D]`` blocks with a single segment — the
+    head-major layout whose trailing block dims satisfy Mosaic's (8, 128)
+    tiling rule — and the wrapper transposes (XLA folds the relayout into
+    surrounding reshapes).
     """
     B, Lq, H, D = q.shape
     kv_lens = None

@@ -94,11 +94,10 @@ def main():
             "--folds", "1",
             "--val_r", "0.2",
             "--max_wsi_size", "250000",
-            # the reference runs these lengths on an 80 GB A100 without
-            # activation checkpointing; a 16 GB v5e needs remat above the
-            # 8k bucket (measured: the 16k-bucket train step wants 53 GB
-            # unremat'd)
-            "--checkpoint_activations",
+            # no --checkpoint_activations: the branch-level custom VJP
+            # (residuals = undilated q/k/v, re-dilated in backward) fits the
+            # 16k-bucket train step in 12.4 GB unremat'd (was 53.2 GB under
+            # the flash-level VJP, which forced remat + its 2.4x slowdown)
             "--report_to", "jsonl",
         ]
     )
