@@ -313,6 +313,16 @@ def _bhld_kvlen(
     return kv
 
 
+def _flat_eligible(g: int, r: int) -> bool:
+    """True when an undilated branch takes the flat zero-glue kernel path
+    instead of the segmented one. The single dispatch predicate — also
+    consumed by scripts/tpu_selfcheck.py's kernel-coverage dedup key, which
+    must compile exactly the kernel variants this choice selects."""
+    from gigapath_tpu.ops.pallas_flash import FLAT_MAX_SEGMENT
+
+    return r == 1 and g % 8 == 0 and g <= FLAT_MAX_SEGMENT
+
+
 def _branch_pallas_fwd_impl(qh, kh, vh, kvlen, sl, r, is_causal, interpret):
     from gigapath_tpu.ops import pallas_flash as pf
 
@@ -370,9 +380,15 @@ def _branch_pallas_bwd(sl, r, is_causal, interpret, res, cots):
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     delta5 = _seg_dilate(delta[..., None], g, Lp, n, gp, r)[..., 0]
     lse5 = _seg_dilate(lse[..., None], g, Lp, n, gp, r)[..., 0]
+    # Backward blocks are chosen independently of the forward single block:
+    # the bwd kernels hold ~2.5 live fp32 logits tiles (vs the forward's
+    # ~2), so the forward's 1408 choice overflows scoped vmem in the
+    # backward (the BENCH_r03 crash). bwd_blocks keeps block_q = the
+    # forward block (q side stays unpadded) and shrinks block_k to fit.
+    bq, bk = pf.bwd_blocks(block)
     dq5, dk5, dv5 = pf._bwd_impl(
         q5, k5, v5, lse5, delta5, do5, kvlen, is_causal, Dh ** -0.5,
-        block, block, interpret,
+        bq, bk, interpret,
     )
 
     def undo(g5):
@@ -413,19 +429,18 @@ def _branch_bhld(
 
         use_pallas = (interpret or _on_tpu()) and m >= PALLAS_MIN_SEQ
 
-    if use_pallas and r == 1 and valid_len_dyn is None:
-        from gigapath_tpu.ops.pallas_flash import FLAT_MAX_SEGMENT, flat_segment_flash
+    if use_pallas and valid_len_dyn is None and _flat_eligible(g, r):
+        from gigapath_tpu.ops.pallas_flash import flat_segment_flash
 
-        if g % 8 == 0 and g <= FLAT_MAX_SEGMENT:
-            # undilated branch on the FLAT arrays: no pads, reshapes,
-            # dilation, or scatter-back — the ragged tail rides Pallas OOB
-            # auto-masking + the per-segment kvlen select. This removes the
-            # branch's entire XLA glue (the L -> round_up(L, g) pad alone
-            # copied the whole tensor, ~0.12 ms each for q/k/v at L=10k).
-            return flat_segment_flash(
-                qh, kh, vh, segment_len=g, real_len=real_len,
-                is_causal=is_causal, interpret=interpret,
-            )
+        # undilated branch on the FLAT arrays: no pads, reshapes,
+        # dilation, or scatter-back — the ragged tail rides Pallas OOB
+        # auto-masking + the per-segment kvlen select. This removes the
+        # branch's entire XLA glue (the L -> round_up(L, g) pad alone
+        # copied the whole tensor, ~0.12 ms each for q/k/v at L=10k).
+        return flat_segment_flash(
+            qh, kh, vh, segment_len=g, real_len=real_len,
+            is_causal=is_causal, interpret=interpret,
+        )
 
     kvlen = _bhld_kvlen(B, H, n, g, r, m, real_len, valid_len_dyn)
     if use_pallas:

@@ -61,6 +61,33 @@ LN2 = 0.6931471805599453
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 
+# Backward-pass logits budget, in block_q*block_k ELEMENTS. The backward
+# kernels keep ~2.5 live fp32 (block_q, block_k) tiles on the scoped-vmem
+# stack (s, p/dp, ds — measured: 20.12 MB scoped at 1408x1408, i.e. 2.54
+# tiles), vs the forward's ~2. A block pair is bwd-safe when ~2.6 live
+# tiles fit under the 16 MB limit with headroom: 2.6 * 4 B * budget
+# <= 14 MB  =>  budget <= ~1.35M elements. 1024x1024 (1.05M, the default)
+# passes; 1408x1408 (1.98M, round 3's single-block choice) does not — that
+# exact overflow shipped a HEAD whose own benchmark crashed (BENCH_r03).
+_BWD_LOGITS_BUDGET = 1_350_000
+
+
+def bwd_blocks(fwd_block: int) -> Tuple[int, int]:
+    """Backward block sizes (block_q, block_k) given the forward's block.
+
+    Keeps block_q = the forward block (so the q/do/lse/delta arrays need no
+    extra padding beyond the forward's), then shrinks block_k until the
+    backward's live fp32 logits tiles fit the scoped-vmem budget — the two
+    kernels take block_q/block_k independently, and nothing forces the
+    backward to share the forward's block (the branch VJP re-dilates
+    anyway)."""
+    if fwd_block * fwd_block <= _BWD_LOGITS_BUDGET:
+        return fwd_block, fwd_block
+    # contract is total: even the thinnest k block must fit the budget
+    assert fwd_block * LANES <= _BWD_LOGITS_BUDGET, fwd_block
+    bk = _BWD_LOGITS_BUDGET // fwd_block // LANES * LANES
+    return fwd_block, bk
+
 
 from gigapath_tpu.ops.common import round_up  # noqa: E402  (re-export)
 
@@ -488,6 +515,35 @@ def _flat_bwd_impl(q, k, v, lse, delta, do, g, real_len, causal, interpret):
     S = _round_up(L, g) // g
     kvlen = np.clip(real_len - np.arange(S) * g, 0, g).astype(np.int32)
     kvlen = jnp.asarray(np.broadcast_to(kvlen[None, None], (B, H, S)))
+    if g * g > _BWD_LOGITS_BUDGET:
+        # The forward's zero-glue single block is bwd-unsafe above ~1161
+        # (see _BWD_LOGITS_BUDGET): re-segment into the padded [B,H,S,g,D]
+        # layout and run the generic backward with a bwd-safe asymmetric
+        # block pair. Glue (one pad + reshape per array) only ever runs in
+        # training, where the backward's 2x FLOPs dominate it anyway.
+        # Zeroing do/delta rows beyond real_len reproduces the flat=True
+        # kernels' qrow masking: those rows' out is garbage by contract, so
+        # they must contribute nothing to dk/dv (and get dq = 0) — without
+        # this, gradient semantics would flip across the budget threshold
+        # for callers whose cotangent touches rows in [real_len, L).
+        if real_len < L:
+            row_ok = (jnp.arange(L) < real_len)[None, None, :]
+            do = jnp.where(row_ok[..., None], do, 0)
+            delta = jnp.where(row_ok, delta, 0)
+        Lp = S * g
+
+        def seg(x):
+            if Lp != L:
+                x = jnp.pad(x, ((0, 0), (0, 0), (0, Lp - L)) + ((0, 0),) * (x.ndim - 3))
+            return x.reshape(B, H, S, g, *x.shape[3:])
+
+        bq, bk = bwd_blocks(g)
+        dq5, dk5, dv5 = _bwd_impl(
+            seg(q), seg(k), seg(v), seg(lse), seg(delta), seg(do),
+            kvlen, causal, D ** -0.5, bq, bk, interpret,
+        )
+        undo = lambda x5: x5.reshape(B, H, Lp, D)[:, :, :L]
+        return undo(dq5), undo(dk5), undo(dv5)
     # lse/delta carried at LANES width for TPU tiling
     lseL = jnp.broadcast_to(lse[:, :, None, :, None], (B, H, 1, L, LANES))
     deltaL = jnp.broadcast_to(delta[:, :, None, :, None], (B, H, 1, L, LANES))

@@ -25,9 +25,11 @@ _T0 = time.time()
 
 def check(name, got, ref, atol):
     err = float(jnp.abs(jnp.asarray(got, jnp.float32) - jnp.asarray(ref, jnp.float32)).max())
-    status = "ok" if err <= atol else "FAIL"
-    print(f"[{time.time() - _T0:6.1f}s] {name:55s} max_err={err:.4e} (atol {atol:g})  {status}")
-    if err > atol:
+    # NaN must fail: `err <= atol` is False for NaN, but so would `err >
+    # atol` be — gate on NOT-ok, or a NaN-producing kernel passes silently
+    ok = err <= atol
+    print(f"[{time.time() - _T0:6.1f}s] {name:55s} max_err={err:.4e} (atol {atol:g})  {'ok' if ok else 'FAIL'}")
+    if not ok:
         FAILED.append(name)
 
 
@@ -103,6 +105,73 @@ def main():
             f"dilated fused d{name} (rel to {scale:.2e})",
             g_f.astype(jnp.float32) / scale, g_j / scale, 6e-2,
         )
+
+    # --- bench-geometry block coverage (fwd AND bwd) -------------------
+    # Every distinct (fwd block, bwd block pair) the adaptive dispatcher
+    # can choose at the driver's bench geometry must compile + run in BOTH
+    # directions on chip before the driver runs bench.py. Round-3
+    # regression this guards: the selfcheck shapes produced no block
+    # > 1024, so the 1408 single-block branch was never compiled on
+    # hardware, and its backward scoped-vmem OOM shipped to the driver
+    # (BENCH_r03 rc=1).
+    from gigapath_tpu.ops import pallas_flash as pf
+
+    from bench import N as _BENCH_N  # stay in lockstep with the driver's bench
+
+    N_BENCH = _BENCH_N + 1  # + the model's cls token
+    seen = {}
+    for sl, r in zip(SEGS, RATIOS):
+        _g, _Lp, _n, _gp, m, block = da._bhld_geom(N_BENCH, sl, r)
+        bq, bk = pf.bwd_blocks(block)
+        # the flat (zero-glue) path and the segmented path are DIFFERENT
+        # kernels even at the same block triple — the dedup key uses the
+        # shared dispatch predicate so both variants get compiled
+        flat = da._flat_eligible(_g, r)
+        seen.setdefault((block, bq, bk, flat), (sl, r))
+    qN = jnp.asarray(rng.normal(size=(1, H, N_BENCH, Dh)), jnp.bfloat16)
+    kN = jnp.asarray(rng.normal(size=(1, H, N_BENCH, Dh)), jnp.bfloat16)
+    vN = jnp.asarray(rng.normal(size=(1, H, N_BENCH, Dh)), jnp.bfloat16)
+
+    for (block, bq, bk, flat), (sl, r) in sorted(seen.items()):
+        tag = f"sl={sl} r={r} blk={block} bwd=({bq},{bk})" + (" flat" if flat else "")
+        g_seg = min(sl, N_BENCH)
+        # A near-empty tail segment (e.g. the r=1 branch's 1-token tail at
+        # 10241 = 10x1024 + 1) has analytically-zero dq/dk — softmax over
+        # one key — so both paths produce only rounding noise there
+        # (measured ~7e-8 abs vs a 5e-7 global max: 14% under max-relative
+        # scaling). Exclude such tails from the dq/dk comparison; their
+        # values still must be finite.
+        tail = N_BENCH % g_seg
+        cmp_len = N_BENCH - tail if 0 < tail < 8 else N_BENCH
+
+        def branch_loss(x, y, z, use_pallas):
+            o, _ = da._branch_bhld(
+                x, y, z, sl, r, is_causal=False, real_len=N_BENCH,
+                interpret=False, use_pallas=use_pallas,
+            )
+            return (o.astype(jnp.float32) ** 2).mean()
+
+        val_and_grads = jax.jit(
+            jax.value_and_grad(branch_loss, argnums=(0, 1, 2)),
+            static_argnums=3,
+        )
+        loss_p, grads_p = val_and_grads(qN, kN, vN, True)
+        loss_j, grads_j = val_and_grads(qN, kN, vN, False)
+        check(f"bench-geom fwd {tag}", loss_p, loss_j, 1e-3)
+        for name, g_p, g_j in zip("qkv", grads_p, grads_j):
+            g_p = g_p.astype(jnp.float32)
+            g_j = g_j.astype(jnp.float32)
+            if not bool(jnp.isfinite(g_p).all()):
+                check(f"bench-geom d{name} {tag} finite", 1.0, 0.0, 0.0)
+                continue
+            cut = N_BENCH if name == "v" else cmp_len  # dv exact on 1-key segs
+            scale = max(float(jnp.abs(g_j[:, :, :cut]).max()), 1e-12)
+            check(
+                f"bench-geom d{name} {tag}",
+                g_p[:, :, :cut] / scale,
+                g_j[:, :, :cut] / scale,
+                6e-2,
+            )
 
     if FAILED:
         print("FAILED:", FAILED)

@@ -131,3 +131,114 @@ def test_unaligned_lengths(rng):
     ref_out, ref_lse = attention_with_lse(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out), atol=2e-5, rtol=1e-4)
     np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), atol=2e-5, rtol=1e-4)
+
+
+def test_bwd_blocks_fit_budget():
+    """The backward block pair must fit the backward's scoped-vmem budget.
+
+    Regression for the BENCH_r03 crash: m=1281 (flagship r=8 branch at
+    N=10241) picks a 1408 forward single block, and reusing it squared in
+    the backward overflowed scoped vmem (20.12 MB vs the 16 MB limit)."""
+    from gigapath_tpu.ops.dilated_attention import _bhld_geom
+    from gigapath_tpu.ops.pallas_flash import _BWD_LOGITS_BUDGET, bwd_blocks
+
+    # the exact crash geometry: flagship r=8 branch at N=10241
+    *_rest, m, fwd_block = _bhld_geom(10241, 185363, 8)
+    assert (m, fwd_block) == (1281, 1408)
+    bq, bk = bwd_blocks(fwd_block)
+    assert bq == 1408, "q side should keep the forward block (stays unpadded)"
+    assert bq * bk <= _BWD_LOGITS_BUDGET
+    # every forward block the adaptive dispatcher can emit stays in budget
+    for fb in (128, 640, 768, 1024, 1280, 1408):
+        bq, bk = bwd_blocks(fb)
+        assert bq == fb and bk % 128 == 0
+        assert bq * bk <= _BWD_LOGITS_BUDGET, (fb, bq, bk)
+
+
+def test_bwd_impl_asymmetric_blocks_match(rng):
+    """dq/dk/dv must be invariant to the (block_q, block_k) choice."""
+    from gigapath_tpu.ops import pallas_flash as pf
+
+    B, H, S, M, D = 1, 2, 2, 320, 16
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(B, H, S, M, D)), jnp.float32)
+        for _ in range(3)
+    )
+    do = jnp.asarray(rng.normal(size=(B, H, S, M, D)), jnp.float32)
+    out, lse = pf._fwd_impl(q, k, v, None, False, D ** -0.5, 128, 128, True)
+    delta = jnp.sum(do * out, axis=-1)
+
+    ref = pf._bwd_impl(q, k, v, lse, delta, do, None, False, D ** -0.5, 128, 128, True)
+    for bq, bk in ((256, 128), (128, 256), (320, 128)):
+        got = pf._bwd_impl(
+            q, k, v, lse, delta, do, None, False, D ** -0.5, bq, bk, True
+        )
+        for a, b, name in zip(got, ref, ("dq", "dk", "dv")):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4,
+                err_msg=f"{name} differs at blocks ({bq}, {bk})",
+            )
+
+
+def test_flat_bwd_resegment_fallback_matches(rng, monkeypatch):
+    """The oversized-g flat backward (re-segment + generic kernels) must
+    match the single-block flat backward on the valid region."""
+    from gigapath_tpu.ops import pallas_flash as pf
+
+    B, H, L, D, g, rl = 1, 2, 600, 16, 256, 580
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(B, H, L, D)), jnp.float32)
+        for _ in range(3)
+    )
+
+    def loss(q, k, v):
+        out, _ = pf.flat_segment_flash(
+            q, k, v, segment_len=g, real_len=rl, interpret=True
+        )
+        return (out[:, :, :rl] ** 2).sum()
+
+    g_normal = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    # smallest legal budget that still forces the fallback at this g
+    monkeypatch.setattr(pf, "_BWD_LOGITS_BUDGET", g * 128)
+    g_fallback = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_fallback, g_normal, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4,
+            err_msg=f"{name} differs between fallback and flat backward",
+        )
+
+
+def test_flat_bwd_fallback_masks_invalid_row_cotangents(rng, monkeypatch):
+    """A cotangent touching rows beyond real_len (out is garbage there by
+    contract) must contribute nothing to dk/dv in the fallback — matching
+    the flat=True kernels' qrow zeroing, so gradient semantics don't flip
+    across the budget threshold."""
+    from gigapath_tpu.ops import pallas_flash as pf
+
+    B, H, L, D, g, rl = 1, 2, 600, 16, 256, 580
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(B, H, L, D)), jnp.float32)
+        for _ in range(3)
+    )
+
+    def loss(q, k, v):
+        out, _ = pf.flat_segment_flash(
+            q, k, v, segment_len=g, real_len=rl, interpret=True
+        )
+        return (out ** 2).sum()  # deliberately touches rows in [rl, L)
+
+    g_normal = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    # smallest legal budget that still forces the fallback at this g
+    monkeypatch.setattr(pf, "_BWD_LOGITS_BUDGET", g * 128)
+    g_fallback = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    # dk/dv must agree everywhere; dq only on the valid region (invalid
+    # rows' dq is garbage-on-garbage in the flat path, zero in the fallback)
+    for a, b, name in zip(g_fallback[1:], g_normal[1:], ("dk", "dv")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4,
+            err_msg=f"{name} differs with invalid-row cotangents",
+        )
+    np.testing.assert_allclose(
+        np.asarray(g_fallback[0][:, :, :rl]), np.asarray(g_normal[0][:, :, :rl]),
+        atol=1e-5, rtol=1e-4, err_msg="dq differs on the valid region",
+    )
