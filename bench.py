@@ -103,6 +103,24 @@ def workload_flops(n_tokens: int) -> float:
 A100_REF_TOKENS_PER_SEC = N / (workload_flops(N) / (A100_FP16_FLOPS * A100_MFU))
 
 
+def tile_workload_flops(model) -> float:
+    """Analytic forward FLOPs of ONE tile through the ViT-G/14 encoder.
+
+    SwiGLU MLP: packed fc1 is [d -> hidden] where hidden already counts
+    both gate+value mats, and fc2 is [hidden/2 -> d]: per token
+    2*d*hidden + 2*d*hidden/2 = 3*d*hidden FLOPs. Used both as the
+    compiled-HLO fallback for tile_mfu and as the workload count behind
+    the analytic A100 tile baseline (same treatment the slide encoder's
+    baseline got): BASELINE.md's north star is tiles/sec vs 1xA100
+    running the reference recipe (``gigapath/pipeline.py:141-161``)."""
+    L = model.num_patches + 1
+    hidden = model.mlp_hidden_dim
+    d = model.embed_dim
+    p = model.patch_size
+    per_layer = 4 * 2 * L * d * d + 3 * L * d * hidden + 4 * L * L * d
+    return float(model.depth * per_layer + 2 * L * 3 * p * p * d)
+
+
 def bench_tile_encoder(peak_flops: float):
     """Batch-128 bf16 ViT-G/14 forward: (tiles/sec, mfu)."""
     import jax
@@ -137,17 +155,12 @@ def bench_tile_encoder(peak_flops: float):
         lambda x, p: model.apply({"params": p}, x), imgs, params
     )
     if not flops or not np.isfinite(flops):
-        # analytic fallback. SwiGLU MLP: packed fc1 is [d -> hidden] where
-        # hidden = 8192 already counts both gate+value mats (2 x 4096), and
-        # fc2 is [hidden/2 -> d]: per token 2*d*hidden + 2*d*hidden/2
-        # = 3*d*hidden FLOPs
-        L = model.num_patches + 1
-        hidden = model.mlp_hidden_dim
-        d = model.embed_dim
-        per_layer = 4 * 2 * L * d * d + 3 * L * d * hidden + 4 * L * L * d
-        flops = TILE_BATCH * (model.depth * per_layer + 2 * L * 3 * 16 * 16 * d)
+        flops = TILE_BATCH * tile_workload_flops(model)
     mfu = (flops / sec_per_iter) / peak_flops
-    return tiles_per_sec, mfu
+    # analytic A100 denominator for the tiles/sec north star, mirroring
+    # the slide encoder's baseline treatment (same MFU assumption)
+    baseline_tiles_per_sec = (A100_FP16_FLOPS * A100_MFU) / tile_workload_flops(model)
+    return tiles_per_sec, mfu, baseline_tiles_per_sec
 
 
 def main():
@@ -201,15 +214,19 @@ def main():
     train_tokens_per_sec = N / sec_train
 
     try:
-        tile_tiles_per_sec, tile_mfu = bench_tile_encoder(peak)
+        tile_tiles_per_sec, tile_mfu, tile_baseline = bench_tile_encoder(peak)
+        tile_vs_baseline = round(tile_tiles_per_sec / tile_baseline, 3)
         tile_tiles_per_sec = round(tile_tiles_per_sec, 1)
         tile_mfu = round(tile_mfu, 3)
+        tile_baseline = round(tile_baseline, 1)
     except Exception as e:  # the headline metric must survive a tile failure
         # stderr: stdout is contractually exactly one JSON line
         import sys
 
         print(f"tile-encoder bench failed: {type(e).__name__}: {e}", file=sys.stderr)
-        tile_tiles_per_sec, tile_mfu = None, None
+        tile_tiles_per_sec, tile_mfu, tile_baseline, tile_vs_baseline = (
+            None, None, None, None,
+        )
 
     print(
         json.dumps(
@@ -223,6 +240,8 @@ def main():
                 "peak_hbm_gb": peak_hbm_gb,
                 "tile_tiles_per_sec": tile_tiles_per_sec,
                 "tile_mfu": tile_mfu,
+                "tile_vs_baseline": tile_vs_baseline,
+                "tile_baseline_tiles_per_sec": tile_baseline,
                 "baseline_tokens_per_sec": round(A100_REF_TOKENS_PER_SEC, 1),
                 "baseline_version": BASELINE_VERSION,
             }

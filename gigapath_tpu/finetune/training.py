@@ -51,11 +51,30 @@ from gigapath_tpu.utils.profiling import compiled_flops  # noqa: F401  (re-expor
 
 
 def _batch_to_device(batch):
-    images = jnp.asarray(batch["imgs"])
-    coords = jnp.asarray(batch["coords"])
-    labels = jnp.asarray(np.asarray(batch["labels"]))
-    pad_mask = jnp.asarray(batch["pad_mask"]) if "pad_mask" in batch else None
+    def dev(x):
+        # prefetched batches arrive device-resident — round-tripping them
+        # through np.asarray would force a host sync per field
+        return x if isinstance(x, jax.Array) else jnp.asarray(np.asarray(x))
+
+    images = dev(batch["imgs"])
+    coords = dev(batch["coords"])
+    labels = dev(batch["labels"])
+    pad_mask = dev(batch["pad_mask"]) if "pad_mask" in batch else None
     return images, coords, labels, pad_mask
+
+
+def _prefetched(loader, bf16: bool = True):
+    """Wrap a host loader so IO + host->device transfer overlap compute.
+
+    Measured at the 8k bucket (scripts/exp_trainharness.py): the fp32
+    transfer alone was 0.5 s of the 0.91 s/it harness step vs a 0.21 s
+    device step — the dominant train-loop cost, not the optimizer/dropout
+    machinery VERDICT r3 suspected. ``bf16`` gates the transfer-halving
+    image cast: it must be off when the model runs fp32 (args.bf16=False)
+    or the cast would silently truncate the inputs of an fp32 model."""
+    from gigapath_tpu.data.loader import DevicePrefetcher
+
+    return DevicePrefetcher(loader, depth=2, bf16_keys=("imgs",) if bf16 else ())
 
 
 def train(dataloader, fold: int, args):
@@ -214,31 +233,41 @@ class BucketCompileLog:
         self.name = name
         self.first_call_sec: Dict[tuple, float] = {}
         self.step_sec: Dict[tuple, list] = {}
+        self._counts: Dict[tuple, int] = {}  # untimed (async) steady steps
 
     def is_new(self, bucket: tuple) -> bool:
         return bucket not in self.first_call_sec
 
-    def record(self, bucket: tuple, seconds: float) -> None:
+    def record(self, bucket: tuple, seconds: Optional[float]) -> None:
         # bucket = (batch, padded_len): a short last batch retraces too, and
-        # must not be filed as a steady step of the full-batch bucket
+        # must not be filed as a steady step of the full-batch bucket.
+        # seconds=None marks a steady (async-dispatched, unsynced) step:
+        # counted, not timed — the loop only blocks on new buckets and at
+        # the 20-iteration prints, whose sec/it is the steady-state number.
         if self.is_new(bucket):
-            self.first_call_sec[bucket] = seconds
+            self.first_call_sec[bucket] = seconds if seconds is not None else 0.0
             print(
                 f"[compile] {self.name} bucket B x L={bucket}: first call "
-                f"{seconds:.2f}s (compile+run); "
+                f"{self.first_call_sec[bucket]:.2f}s (compile+run); "
                 f"{len(self.first_call_sec)} bucket(s) compiled"
             )
-        else:
+        elif seconds is not None:
             self.step_sec.setdefault(bucket, []).append(seconds)
+        else:
+            self._counts[bucket] = self._counts.get(bucket, 0) + 1
 
     def summary(self) -> str:
         parts = []
+        counts = self._counts
         for bucket in sorted(self.first_call_sec):
             steps = self.step_sec.get(bucket, [])
-            mean = sum(steps) / len(steps) if steps else float("nan")
+            n = len(steps) or counts.get(bucket, 0)
+            timing = (
+                f" @ {sum(steps) / len(steps):.3f}s" if steps else ""
+            )
             parts.append(
                 f"BxL={bucket}: compile {self.first_call_sec[bucket]:.2f}s, "
-                f"{len(steps)} steady steps @ {mean:.3f}s"
+                f"{n} steady steps{timing}"
             )
         return f"[compile] {self.name} buckets — " + "; ".join(parts)
 
@@ -253,36 +282,64 @@ def train_one_epoch(
     seq_len = 0
     records = get_records_array(len(train_loader), args.n_classes)
     n_batches = 0
+    # Device-side loss accumulator + async dispatch: the loop blocks only
+    # on a bucket's first (compiling) step and at the 20-iteration prints.
+    # A per-iteration float(loss) cost ~0.13 s of dispatch+sync over this
+    # environment's device tunnel (scripts/exp_trainharness.py), on top of
+    # serializing the input transfer the prefetcher now overlaps.
+    loss_sum = None
 
-    for batch_idx, batch in enumerate(train_loader):
+    for batch_idx, batch in enumerate(
+        _prefetched(train_loader, bf16=getattr(args, "bf16", True))
+    ):
         images, coords, labels, pad_mask = _batch_to_device(batch)
         seq_len += images.shape[1]
         rng, step_rng = jax.random.split(rng)
+        bucket = tuple(images.shape[:2])
+        new_bucket = compile_log is not None and compile_log.is_new(bucket)
+        if new_bucket and loss_sum is not None:
+            # drain the async queue first, or every pending step's runtime
+            # gets billed to this bucket's "first call" compile number
+            jax.block_until_ready(loss_sum)
         t0 = time.time()
         params, opt_state, loss = train_step(
             params, opt_state, images, coords, labels, pad_mask, step_rng
         )
-        records["loss"] += float(loss)  # blocks on the step
-        if compile_log is not None:
-            compile_log.record(tuple(images.shape[:2]), time.time() - t0)
+        if new_bucket:
+            jax.block_until_ready(loss)  # isolate the compile cost
+            compile_log.record(bucket, time.time() - t0)
+        elif compile_log is not None:
+            compile_log.record(bucket, None)
+        # fp32 accumulation: a few hundred bf16 adds of ~1.x losses round
+        # by up to 1.0 once the sum passes 256 (bf16 ulp)
+        loss32 = loss.astype(jnp.float32)
+        loss_sum = loss32 if loss_sum is None else loss_sum + loss32
         n_batches += 1
 
         if (batch_idx + 1) % 20 == 0:
+            running_loss = float(loss_sum)  # sync point: bounds queue depth
             time_per_it = (time.time() - start_time) / (batch_idx + 1)
             print(
                 "Epoch: {}, Batch: {}, Loss: {:.4f}, Time: {:.4f} sec/it, "
                 "Seq len: {:.1f}, Slide ID: {}".format(
                     epoch,
                     batch_idx,
-                    records["loss"] / (batch_idx + 1),
+                    running_loss / (batch_idx + 1),
                     time_per_it,
                     seq_len / (batch_idx + 1),
                     batch["slide_id"][-1] if "slide_id" in batch else "None",
                 )
             )
 
-    records["loss"] = records["loss"] / max(n_batches, 1)
-    print("Epoch: {}, Loss: {:.4f}".format(epoch, records["loss"]))
+    records["loss"] = (
+        float(loss_sum) if loss_sum is not None else 0.0
+    ) / max(n_batches, 1)
+    epoch_sec = time.time() - start_time
+    print(
+        "Epoch: {}, Loss: {:.4f}, Epoch time: {:.1f}s ({:.3f} sec/it)".format(
+            epoch, records["loss"], epoch_sec, epoch_sec / max(n_batches, 1)
+        )
+    )
     if compile_log is not None and compile_log.first_call_sec:
         print(compile_log.summary())
     return params, opt_state, records
@@ -296,7 +353,7 @@ def evaluate(loader, eval_step, params, loss_fn, epoch, args):
     probs, onehots = [], []
     total_loss, n = 0.0, 0
     task_setting = args.task_config.get("setting", "multi_class")
-    for batch in loader:
+    for batch in _prefetched(loader, bf16=getattr(args, "bf16", True)):
         images, coords, labels, pad_mask = _batch_to_device(batch)
         logits = eval_step(params, images, coords, pad_mask)
         logits = jnp.asarray(logits, jnp.float32)

@@ -567,6 +567,13 @@ def dilated_attention_bhld(
         # HBM at PANDA-scale N (the 1M-token operating point). Identical
         # math: final = sum_b softmax_b(lse)[b] * out_b, weights constant
         # in backward (stop_gradient, parity with reference torch.no_grad).
+        #
+        # Layout note (round 4, measured): keeping the accumulator in the
+        # branch layout [B, H, L, D] lets XLA fuse each branch's undilate
+        # write directly into the online update — one pass, no extra
+        # buffer. A lane-clean [B, L, H, D] accumulator (tried to shave
+        # the 48->128 tile padding) materializes every branch output in
+        # BOTH layouts and pushed 256k from 12.7 GB to an OOM at 15.9 GB.
         acc = m_run = l_run = None
         for sl, r in zip(segment_lengths, dilated_ratios):
             o, l = _branch_bhld(

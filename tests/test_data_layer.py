@@ -272,3 +272,66 @@ class TestTileDataset:
         sample = ds[0]
         assert sample["img"].shape == (224, 224, 3)
         np.testing.assert_array_equal(sample["coords"], [123, 456])
+
+
+class TestDevicePrefetcher:
+    def _loader(self, batches):
+        class L:
+            dataset = "ds"
+
+            def __len__(self):
+                return len(batches)
+
+            def __iter__(self):
+                return iter(batches)
+
+        return L()
+
+    def test_order_dtype_and_passthrough(self):
+        import jax
+        import jax.numpy as jnp
+
+        from gigapath_tpu.data.loader import DevicePrefetcher
+
+        batches = [
+            {
+                "imgs": np.full((1, 4, 8), i, np.float32),
+                "pad_mask": np.ones((1, 4), bool),
+                "slide_id": [f"s{i}"],
+            }
+            for i in range(5)
+        ]
+        out = list(DevicePrefetcher(self._loader(batches), depth=2))
+        assert len(out) == 5
+        for i, b in enumerate(out):
+            assert isinstance(b["imgs"], jax.Array)
+            assert b["imgs"].dtype == jnp.bfloat16  # halved transfer bytes
+            assert float(b["imgs"][0, 0, 0]) == i  # order preserved
+            assert b["pad_mask"].dtype == jnp.bool_
+            assert b["slide_id"] == [f"s{i}"]  # host values untouched
+
+    def test_none_batches_dropped(self):
+        from gigapath_tpu.data.loader import DevicePrefetcher
+
+        batches = [None, {"imgs": np.zeros((1, 2, 2), np.float32)}, None]
+        out = list(DevicePrefetcher(self._loader(batches)))
+        assert len(out) == 1
+
+    def test_producer_error_reraises(self):
+        import pytest
+
+        from gigapath_tpu.data.loader import DevicePrefetcher
+
+        def gen():
+            yield {"imgs": np.zeros((1, 2, 2), np.float32)}
+            raise RuntimeError("h5 went away")
+
+        class L:
+            def __iter__(self):
+                return gen()
+
+        pf = DevicePrefetcher(L())
+        it = iter(pf)
+        next(it)
+        with pytest.raises(RuntimeError, match="h5 went away"):
+            list(it)

@@ -457,3 +457,27 @@ class TestFusedPhaseMajorPath:
         out = dilated_attention_fused(q, k, v, [8, 12], [1, 3], interpret=True)
         ref = dilated_attention(q, k, v, [8, 12], [1, 3])
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4)
+
+
+def test_streaming_fusion_matches_stacked(rng):
+    """Online-over-branches fusion must be numerically identical to the
+    stacked LSE-softmax fusion. (It enables the long-context envelope; its
+    accumulator deliberately KEEPS the branch [B,H,L,D] layout — a
+    lane-clean [B,L,H,D] accumulator was tried in round 4 and regressed
+    256k from 12.7 GB to an OOM, see the comment in the streaming block.)"""
+    from gigapath_tpu.ops.dilated_attention import dilated_attention_bhld
+
+    B, L, H, Dh = 1, 512, 4, 16
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(B, L, H, Dh)), jnp.float32)
+        for _ in range(3)
+    )
+    kwargs = dict(
+        segment_lengths=[128, 256, 512], dilated_ratios=[1, 2, 4],
+        valid_len=500, interpret=True,
+    )
+    stacked = dilated_attention_bhld(q, k, v, streaming_fusion=False, **kwargs)
+    streamed = dilated_attention_bhld(q, k, v, streaming_fusion=True, **kwargs)
+    np.testing.assert_allclose(
+        np.asarray(streamed), np.asarray(stacked), atol=2e-6, rtol=1e-5
+    )
