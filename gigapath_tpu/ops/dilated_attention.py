@@ -313,6 +313,18 @@ def _bhld_kvlen(
     return kv
 
 
+def _normalize_valid_len(valid_len, B: int, L: int):
+    """(real_len static int, valid_dyn traced [B] or None) from the public
+    ``valid_len`` contract: None = all valid, int = static suffix bound
+    (folds into trace-time masks), array = TRACED per-batch suffix valid
+    lengths (ride the kernels' SMEM valid-count tables at runtime)."""
+    if valid_len is None:
+        return L, None
+    if isinstance(valid_len, (int, np.integer)):
+        return min(int(valid_len), L), None
+    return L, jnp.asarray(valid_len).reshape(B)
+
+
 def _flat_eligible(g: int, r: int) -> bool:
     """True when an undilated branch takes the flat zero-glue kernel path
     instead of the segmented one. The single dispatch predicate — also
@@ -461,7 +473,7 @@ def dilated_attention_fused(
     dilated_ratios: Sequence[int],
     *,
     is_causal: bool = False,
-    valid_len: Optional[int] = None,
+    valid_len=None,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Fastest path: per-branch phase-major Pallas kernels on dense
@@ -479,20 +491,21 @@ def dilated_attention_fused(
     B, L, H, Dh = q.shape
     E = H * Dh
     qE, kE, vE = (x.reshape(B, L, E) for x in (q, k, v))
-    real_len = L if valid_len is None else min(int(valid_len), L)
+    real_len, valid_dyn = _normalize_valid_len(valid_len, B, L)
     outs, lses = [], []
     for sl, r in zip(segment_lengths, dilated_ratios):
         sl, r = int(sl), int(r)
         if H % r == 0 and E % r == 0:
             o, l = dilated_branch_attention(
                 qE, kE, vE, sl, r, H,
-                real_len=real_len, is_causal=is_causal, interpret=interpret,
+                real_len=real_len, valid_len_dyn=valid_dyn,
+                is_causal=is_causal, interpret=interpret,
             )
         else:
             qh, kh, vh = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
             o4, l = _branch_bhld(
                 qh, kh, vh, sl, r, is_causal=is_causal, real_len=real_len,
-                interpret=interpret, use_pallas=None,
+                interpret=interpret, use_pallas=None, valid_len_dyn=valid_dyn,
             )
             o = o4.transpose(0, 2, 1, 3).reshape(B, L, E)
         outs.append(o)
@@ -542,14 +555,7 @@ def dilated_attention_bhld(
     the Pallas path for masked batches.
     """
     B, L, H, Dh = q.shape
-    valid_dyn = None
-    if valid_len is None:
-        real_len = L
-    elif isinstance(valid_len, (int, np.integer)):
-        real_len = min(int(valid_len), L)
-    else:
-        real_len = L
-        valid_dyn = jnp.asarray(valid_len).reshape(B)
+    real_len, valid_dyn = _normalize_valid_len(valid_len, B, L)
     # optimization barriers pin the op's boundaries: without them XLA fuses
     # the entry/exit relayouts into the surrounding layernorm/projection
     # fusions, which then read the 48-lane-minor head-major layout strided
@@ -731,11 +737,24 @@ def dilated_attention(
         # the compiled kernels are otherwise validated by
         # scripts/tpu_selfcheck.py rather than the CPU/interpret CI tier)
         if _on_tpu() and not _env_flag("GIGAPATH_FORCE_GENERIC_ATTN"):
-            # Head-major fast path. The phase-major dilated_attention_fused
-            # kernels (pallas_dilated.py) have faster attention cells but
-            # their per-branch packing relayouts currently cost more than
-            # they save end-to-end (v5e traces: reshape+pad dominate); keep
-            # them opt-in until the packing is kernel-side.
+            # Phase-major fused path (pallas_dilated.py) is the default
+            # since round 4's kernel-side packing landed: activations stay
+            # [B, L, E], per-branch pack/unpack are single-pass Pallas copy
+            # kernels over a diagonal-only layout, and the v5e op-time A/B
+            # at N=10241 reads fused 5.19 ms vs head-major 6.69 ms forward
+            # (grad step 15.1 vs 18.8 ms). Static AND traced valid_len both
+            # ride it (traced counts live in the kernels' SMEM tables). The
+            # head-major path remains for streaming branch fusion
+            # (long-context memory) and ratios not dividing the heads.
+            fused_ok = not _env_flag("GIGAPATH_STREAMING_FUSION") and all(
+                H % int(rr) == 0 and (H * Dh) % int(rr) == 0
+                for rr in dilated_ratios
+            )
+            if fused_ok:
+                return dilated_attention_fused(
+                    q, k, v, segment_lengths, dilated_ratios,
+                    is_causal=is_causal, valid_len=valid_len,
+                )
             # GIGAPATH_STREAMING_FUSION=1: fold branches into running
             # (acc, m, l) instead of stacking all branch outputs — ~2x
             # lower peak HBM, the enabler for the 1M-token operating point.

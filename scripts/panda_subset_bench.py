@@ -128,8 +128,8 @@ def main():
     epoch_lines = re.findall(
         r"Epoch time: ([0-9.]+)s \(([0-9.]+) sec/it\)", tee.buf.getvalue()
     )
-    epoch_secs = [float(a) for a, _ in epoch_lines]
-    steady_sec_per_epoch = round(min(epoch_secs), 1) if len(epoch_secs) > 1 else None
+    epoch_secs = [float(a) for a, _ in epoch_lines[1:]]  # epoch 0 = compiles
+    steady_sec_per_epoch = round(min(epoch_secs), 1) if epoch_secs else None
     steady_sec_per_it = (
         round(min(float(b) for _, b in epoch_lines[1:]), 3)
         if len(epoch_lines) > 1

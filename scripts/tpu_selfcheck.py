@@ -173,6 +173,40 @@ def main():
                 6e-2,
             )
 
+    # --- fused (phase-major, the DEFAULT) path at the bench geometry ----
+    # The pack/unpack + attention kernels the default dispatch runs at
+    # N_BENCH must compile fwd+bwd on chip before the driver's bench does,
+    # including the traced-valid-len variant the fine-tune train path uses.
+    def fused_loss(x, y, z, vl):
+        o = da.dilated_attention_fused(x, y, z, SEGS, RATIOS, valid_len=vl)
+        return (o.astype(jnp.float32) ** 2).mean()
+
+    def bhld_loss(x, y, z):
+        o = da.dilated_attention_bhld(x, y, z, SEGS, RATIOS, valid_len=N_BENCH - 64)
+        return (o.astype(jnp.float32) ** 2).mean()
+
+    qb = jnp.asarray(rng.normal(size=(1, N_BENCH, H, Dh)), jnp.bfloat16)
+    kb = jnp.asarray(rng.normal(size=(1, N_BENCH, H, Dh)), jnp.bfloat16)
+    vb = jnp.asarray(rng.normal(size=(1, N_BENCH, H, Dh)), jnp.bfloat16)
+    # static_argnums: a jitted int operand would be traced, silently
+    # routing the "static" check through the dynamic-kvlen path too
+    vg_f = jax.jit(
+        jax.value_and_grad(fused_loss, argnums=(0, 1, 2)), static_argnums=3
+    )
+    vg_t = jax.jit(jax.value_and_grad(fused_loss, argnums=(0, 1, 2)))
+    loss_f, grads_f = vg_f(qb, kb, vb, N_BENCH - 64)
+    loss_t, grads_t = vg_t(qb, kb, vb, jnp.asarray([N_BENCH - 64], jnp.int32))
+    loss_b, grads_b = jax.jit(jax.value_and_grad(bhld_loss, argnums=(0, 1, 2)))(
+        qb, kb, vb
+    )
+    check("fused bench-geom fwd (static vl)", loss_f, loss_b, 1e-3)
+    check("fused bench-geom fwd (traced vl == static)", loss_t, loss_f, 1e-6)
+    for name, g_f, g_t, g_b in zip("qkv", grads_f, grads_t, grads_b):
+        g_f, g_t, g_b = (x.astype(jnp.float32) for x in (g_f, g_t, g_b))
+        scale = max(float(jnp.abs(g_b).max()), 1e-12)
+        check(f"fused bench-geom d{name}", g_f / scale, g_b / scale, 6e-2)
+        check(f"fused bench-geom d{name} traced==static", g_t, g_f, 1e-6)
+
     if FAILED:
         print("FAILED:", FAILED)
         sys.exit(1)

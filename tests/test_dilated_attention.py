@@ -416,6 +416,51 @@ class TestFusedPhaseMajorPath:
         ref = _np_dilated_oracle(q, k, v, branches)
         np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5, rtol=1e-4)
 
+    def test_traced_valid_len_matches_static(self, rng):
+        """A TRACED per-batch valid length (collate pad masks) must ride
+        the fused kernels' SMEM tables and match the static-int result —
+        forward AND gradients (the fine-tune train path depends on it)."""
+        from gigapath_tpu.ops.dilated_attention import dilated_attention_fused
+
+        B, N, H, D = 2, 40, 4, 8
+        q, k, v = (
+            jnp.asarray(rng.normal(size=(B, N, H, D)), jnp.float32)
+            for _ in range(3)
+        )
+        vl = jnp.asarray([29, 33], jnp.int32)
+
+        def run(q, k, v, valid_len):
+            return dilated_attention_fused(
+                q, k, v, [8, 16], [1, 2], valid_len=valid_len, interpret=True
+            )
+
+        out_t = run(q, k, v, vl)
+        for b, n in enumerate((29, 33)):
+            out_s = dilated_attention_fused(
+                q[b : b + 1], k[b : b + 1], v[b : b + 1], [8, 16], [1, 2],
+                valid_len=n, interpret=True,
+            )
+            np.testing.assert_allclose(
+                np.asarray(out_t[b, :n]), np.asarray(out_s[0, :n]),
+                atol=2e-5, rtol=1e-4,
+            )
+
+        def loss_t(q, k, v):
+            return (run(q, k, v, vl)[:, :29] ** 2).sum()
+
+        def loss_s(q, k, v):
+            return (run(q, k, v, 29)[:, :29] ** 2).sum()
+
+        g_t = jax.grad(loss_t, argnums=(0, 1, 2))(q, k, v)
+        g_s = jax.grad(loss_s, argnums=(0, 1, 2))(q, k, v)
+        # batch 0 has valid length 29 in both variants: its gradients agree
+        for a, b, name in zip(g_t, g_s, "qkv"):
+            assert np.abs(np.asarray(a)).sum() > 0, f"d{name} is vacuously zero"
+            np.testing.assert_allclose(
+                np.asarray(a[0]), np.asarray(b[0]), atol=2e-5, rtol=1e-4,
+                err_msg=f"d{name} traced != static on batch 0",
+            )
+
     def test_valid_len_and_causal_match_generic(self, rng):
         from gigapath_tpu.ops.dilated_attention import dilated_attention_fused
 
