@@ -303,14 +303,30 @@ def _bhld_kvlen(
     kv = jnp.asarray(np.broadcast_to(static[None], (B, H, n)))
     if valid_len_dyn is not None:
         heads_per_group = -(-H // r)
-        phases = jnp.arange(H) // heads_per_group  # [H]
-        seg = jnp.arange(n)  # [n]
-        in_seg = jnp.clip(
-            valid_len_dyn.reshape(B)[:, None] - seg[None] * g, 0, g
-        )  # [B, n]
-        counts = jnp.ceil((in_seg[:, None, :] - phases[None, :, None]) / r)
-        kv = jnp.minimum(kv, jnp.clip(counts, 0, m).astype(jnp.int32))
+        phases = jnp.arange(H) // heads_per_group  # [H]: per-head phase id
+        kv = jnp.minimum(
+            kv, dyn_sparse_counts(valid_len_dyn, g, r, m, phases, n)
+        )
     return kv
+
+
+def dyn_sparse_counts(
+    valid_dyn: jnp.ndarray, g: int, r: int, m: int, phases: jnp.ndarray,
+    n_seg: int,
+) -> jnp.ndarray:
+    """[B, len(phases), n_seg] int32 valid sparse-key counts from TRACED
+    per-batch valid lengths: sparse slot j of phase p is valid iff dense
+    position ``seg*g + p + r*j`` lies inside both the segment and the
+    valid prefix. The ONE dynamic-masking formula — shared by the
+    head-major tier (phases = per-head phase ids) and the fused
+    phase-major tier (phases = arange(r)); keep callers on it so the two
+    kernel families can never disagree on boundary semantics."""
+    seg = jnp.arange(n_seg)
+    in_seg = jnp.clip(
+        valid_dyn.reshape(-1)[:, None] - seg[None] * g, 0, g
+    )  # [B, n_seg]
+    counts = jnp.ceil((in_seg[:, None, :] - phases[None, :, None]) / r)
+    return jnp.clip(counts, 0, m).astype(jnp.int32)
 
 
 def _normalize_valid_len(valid_len, B: int, L: int):
@@ -474,10 +490,19 @@ def dilated_attention_fused(
     *,
     is_causal: bool = False,
     valid_len=None,
+    streaming_fusion: bool = False,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Fastest path: per-branch phase-major Pallas kernels on dense
     [B, L, E] activations (see :mod:`gigapath_tpu.ops.pallas_dilated`).
+
+    ``streaming_fusion``: fold each branch's (out, lse) into running
+    (acc, m, l) instead of stacking all branch outputs — each branch's
+    packed temporaries AND its dense output die before the next branch
+    computes, the peak-memory requirement for long-context forwards. All
+    streaming state is 128-lane-clean here ([B, L, E] fp32 acc, [B, H, L]
+    stats), unlike the head-major variant whose accumulator had to stay in
+    the branch's padded layout to preserve XLA fusion.
 
     Activations never leave the 128-lane-aligned ``[B, L, E]`` layout:
     segmenting and dilation ride the kernels' BlockSpec index maps, each
@@ -492,22 +517,53 @@ def dilated_attention_fused(
     E = H * Dh
     qE, kE, vE = (x.reshape(B, L, E) for x in (q, k, v))
     real_len, valid_dyn = _normalize_valid_len(valid_len, B, L)
-    outs, lses = [], []
-    for sl, r in zip(segment_lengths, dilated_ratios):
+
+    def branch(sl, r):
         sl, r = int(sl), int(r)
         if H % r == 0 and E % r == 0:
-            o, l = dilated_branch_attention(
+            return dilated_branch_attention(
                 qE, kE, vE, sl, r, H,
                 real_len=real_len, valid_len_dyn=valid_dyn,
                 is_causal=is_causal, interpret=interpret,
             )
-        else:
-            qh, kh, vh = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
-            o4, l = _branch_bhld(
-                qh, kh, vh, sl, r, is_causal=is_causal, real_len=real_len,
-                interpret=interpret, use_pallas=None, valid_len_dyn=valid_dyn,
-            )
-            o = o4.transpose(0, 2, 1, 3).reshape(B, L, E)
+        qh, kh, vh = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+        o4, l = _branch_bhld(
+            qh, kh, vh, sl, r, is_causal=is_causal, real_len=real_len,
+            interpret=interpret, use_pallas=None, valid_len_dyn=valid_dyn,
+        )
+        return o4.transpose(0, 2, 1, 3).reshape(B, L, E), l
+
+    if streaming_fusion and len(segment_lengths) > 1:
+        # Online softmax over the branch axis (same math as the stacked
+        # fusion below; weights constant in backward via stop_gradient).
+        # Everything that lives ACROSS branches is lane-clean: acc is the
+        # [B, L, H, Dh] view of [B, L, E] fp32 and the running stats stay
+        # [B, H, L] (L on lanes); their transposed broadcasts inside the
+        # update are fused temps.
+        def bLH1(x):  # [B, H, L] -> broadcastable [B, L, H, 1] view
+            return x.transpose(0, 2, 1)[..., None]
+
+        acc = m_run = l_run = None
+        for sl, r in zip(segment_lengths, dilated_ratios):
+            o, l = branch(sl, r)
+            l = jax.lax.stop_gradient(l)  # [B, H, L]
+            o = o.reshape(B, L, H, Dh)
+            if acc is None:
+                m_run = l
+                l_run = jnp.ones_like(l)
+                acc = o.astype(jnp.float32)
+            else:
+                m_new = jnp.maximum(m_run, l)
+                a = jnp.exp(m_run - m_new)
+                b_ = jnp.exp(l - m_new)
+                l_run = l_run * a + b_
+                acc = acc * bLH1(a) + o.astype(jnp.float32) * bLH1(b_)
+                m_run = m_new
+        return (acc / bLH1(l_run)).astype(q.dtype)
+
+    outs, lses = [], []
+    for sl, r in zip(segment_lengths, dilated_ratios):
+        o, l = branch(sl, r)
         outs.append(o)
         lses.append(l)
 
@@ -746,7 +802,11 @@ def dilated_attention(
             # ride it (traced counts live in the kernels' SMEM tables). The
             # head-major path remains for streaming branch fusion
             # (long-context memory) and ratios not dividing the heads.
-            fused_ok = not _env_flag("GIGAPATH_STREAMING_FUSION") and all(
+            # GIGAPATH_STREAMING_FUSION=1: fold branches into running
+            # (acc, m, l) instead of stacking all branch outputs — lower
+            # peak HBM, the enabler for the 1M-token operating point.
+            streaming = _env_flag("GIGAPATH_STREAMING_FUSION")
+            fused_ok = all(
                 H % int(rr) == 0 and (H * Dh) % int(rr) == 0
                 for rr in dilated_ratios
             )
@@ -754,14 +814,12 @@ def dilated_attention(
                 return dilated_attention_fused(
                     q, k, v, segment_lengths, dilated_ratios,
                     is_causal=is_causal, valid_len=valid_len,
+                    streaming_fusion=streaming,
                 )
-            # GIGAPATH_STREAMING_FUSION=1: fold branches into running
-            # (acc, m, l) instead of stacking all branch outputs — ~2x
-            # lower peak HBM, the enabler for the 1M-token operating point.
             return dilated_attention_bhld(
                 q, k, v, segment_lengths, dilated_ratios,
                 is_causal=is_causal, valid_len=valid_len,
-                streaming_fusion=_env_flag("GIGAPATH_STREAMING_FUSION"),
+                streaming_fusion=streaming,
             )
 
     outs, lses = [], []

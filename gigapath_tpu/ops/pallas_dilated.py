@@ -207,16 +207,18 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref,
     def _compute():
         qh = q_ref[0, 0, 0, 0]
         kh = k_ref[0, 0, 0, 0]
+        # base-2 recompute (exp2 = one fewer VPU pass per logit than exp);
+        # the natural-log lse rescales on its [bq, 1] column, not per logit
         s_ = jax.lax.dot_general(
             qh, kh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
+        ) * (scale * LOG2E)
         col_bias = jnp.where(
             jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1) + j * block_k
             < kvlen_ref[b, s, p],
             0.0,
             NEG_INF,
         )
-        pp = jnp.exp(s_ + col_bias - _lane(lse_ref[0, 0, 0], t, block_q))
+        pp = jnp.exp2(s_ + col_bias - _lane(lse_ref[0, 0, 0], t, block_q) * LOG2E)
         if causal:
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
             rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
@@ -254,14 +256,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref,
         kh = k_ref[0, 0, 0, 0]
         s_ = jax.lax.dot_general(
             qh, kh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
+        ) * (scale * LOG2E)  # base-2 units (see _dq_kernel)
         col_bias = jnp.where(
             jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1) + j * block_k
             < kvlen_ref[b, s, p],
             0.0,
             NEG_INF,
         )
-        pp = jnp.exp(s_ + col_bias - _lane(lse_ref[0, 0, 0], t, block_q))
+        pp = jnp.exp2(s_ + col_bias - _lane(lse_ref[0, 0, 0], t, block_q) * LOG2E)
         if causal:
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
             rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
@@ -393,13 +395,14 @@ def _branch_geometry(L: int, E: int, sl: int, r: int) -> Tuple[int, int, int, in
     return g, S, gp, m, Mp, block
 
 
-def _pack_bt(Mp: int, r: int, E: int) -> int:
+def _pack_bt(Mp: int, r: int, E: int, itemsize: int) -> int:
     """Row-block size for the pack/unpack copy kernels: each cell holds a
-    [bt, r*E] dense row-block in VMEM, so bt*r*E*2 B must stay well under
-    the budget with double buffering. Mp is always a multiple of 128
-    (block sizes are), so every candidate divides it."""
+    [bt, r*E] dense row-block in VMEM, so bt*r*E*itemsize must stay well
+    under the budget with double buffering (itemsize matters: the public
+    op is dtype-generic, and fp32 doubles the footprint). Mp is always a
+    multiple of 128 (block sizes are), so every candidate divides it."""
     bt = 512
-    while bt > 128 and bt * r * E * 2 > 4 * 2 ** 20:
+    while bt > 128 and bt * r * E * itemsize > 4 * 2 ** 20:
         bt //= 2
     while Mp % bt:
         bt //= 2
@@ -467,7 +470,7 @@ def _pack_phases(x: jnp.ndarray, g: int, S: int, r: int, Mp: int, H: int,
     Dh = E // H
     # [B, S, Mp, r*E]: rows are token groups of r, phases live on lanes
     xp = _pad_segments(x, g, S, Mp * r).reshape(B, S, Mp, r * E)
-    bt = _pack_bt(Mp, r, E)
+    bt = _pack_bt(Mp, r, E, xp.dtype.itemsize)
     return pl.pallas_call(
         functools.partial(_pack_kernel, r=r, hb=hb, Dh=Dh, bt=bt),
         grid=(B, S, Mp // bt),
@@ -492,7 +495,7 @@ def _unpack_phases(p6: jnp.ndarray, L: int, E: int, g: int, S: int,
     written as exact zeros by the kernel. The [B, S, Mp, r*E] output view
     is token-major already, so no XLA transpose exists on either side."""
     B, _, _, hb, Mp, Dh = p6.shape
-    bt = _pack_bt(Mp, r, E)
+    bt = _pack_bt(Mp, r, E, p6.dtype.itemsize)
     x = pl.pallas_call(
         functools.partial(_unpack_kernel, r=r, hb=hb, Dh=Dh, bt=bt),
         grid=(B, S, Mp // bt),
@@ -549,11 +552,11 @@ def _branch_kvlen(B, S, g, r, m, real_len, vl_dyn):
     )
     if vl_dyn is None:
         return static
-    seg = jnp.arange(S)[None, :, None]
-    phase = jnp.arange(r)[None, None, :]
-    in_seg = jnp.clip(vl_dyn.reshape(B)[:, None, None] - seg * g, 0, g)
-    counts = jnp.ceil((in_seg - phase) / r)
-    return jnp.minimum(static, jnp.clip(counts, 0, m).astype(jnp.int32))
+    from gigapath_tpu.ops.dilated_attention import dyn_sparse_counts
+
+    # shared dynamic-masking formula; [B, r, S] -> the kernels' [B, S, r]
+    counts = dyn_sparse_counts(vl_dyn, g, r, m, jnp.arange(r), S)
+    return jnp.minimum(static, counts.transpose(0, 2, 1))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))

@@ -526,3 +526,25 @@ def test_streaming_fusion_matches_stacked(rng):
     np.testing.assert_allclose(
         np.asarray(streamed), np.asarray(stacked), atol=2e-6, rtol=1e-5
     )
+
+
+def test_fused_streaming_matches_stacked(rng):
+    """Fused-path online-over-branches fusion == stacked fusion (the
+    long-context memory mode on the default kernel path)."""
+    from gigapath_tpu.ops.dilated_attention import dilated_attention_fused
+
+    B, L, H, Dh = 1, 64, 4, 8
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(B, L, H, Dh)), jnp.float32)
+        for _ in range(3)
+    )
+    kwargs = dict(
+        segment_lengths=[16, 32, 64], dilated_ratios=[1, 2, 4],
+        valid_len=60, interpret=True,
+    )
+    stacked = dilated_attention_fused(q, k, v, streaming_fusion=False, **kwargs)
+    streamed = dilated_attention_fused(q, k, v, streaming_fusion=True, **kwargs)
+    np.testing.assert_allclose(
+        np.asarray(streamed)[:, :60], np.asarray(stacked)[:, :60],
+        atol=2e-6, rtol=1e-5,
+    )
