@@ -83,8 +83,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    @pl.when(j * block_k < kvlen_ref[b, s, p])
-    def _compute():
+    def _online_step(masked: bool):
         # log2(e) folded into the scale: exp2 instead of exp in the hot loop
         qh = (q_ref[0, 0, 0, 0].astype(jnp.float32) * (scale * LOG2E)).astype(
             q_ref.dtype
@@ -93,13 +92,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref,
             qh, k_ref[0, 0, 0, 0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [bq, bk], in log2 units
-        col_bias = jnp.where(
-            jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1) + j * block_k
-            < kvlen_ref[b, s, p],
-            0.0,
-            NEG_INF,
-        )
-        s_ = s_ + col_bias
+        if masked:
+            # select, not additive bias, masking BEFORE the running max
+            # (same rationale as pallas_flash._fwd_kernel: masked slots can
+            # hold real activations after residual layers)
+            col_ok = (
+                jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1) + j * block_k
+                < kvlen_ref[b, s, p]
+            )
+            s_ = jnp.where(col_ok, s_, NEG_INF)
         if causal:
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
             rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
@@ -109,14 +110,38 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref,
         l_prev = l_ref[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s_, axis=-1, keepdims=True))
         pp = jnp.exp2(s_ - m_new)
-        alpha = jnp.exp2(m_prev - m_new)
-        l_new = l_prev * alpha + jnp.sum(pp, axis=-1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            pp.astype(v_ref.dtype), v_ref[0, 0, 0, 0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+        if pl.num_programs(5) == 1:
+            # single k block: no online carry — skip the acc rescale and
+            # write the stats once
+            l_new = jnp.sum(pp, axis=-1, keepdims=True)
+            acc_ref[:] = jax.lax.dot_general(
+                pp.astype(v_ref.dtype), v_ref[0, 0, 0, 0], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            alpha = jnp.exp2(m_prev - m_new)
+            l_new = l_prev * alpha + jnp.sum(pp, axis=-1, keepdims=True)
+            acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+                pp.astype(v_ref.dtype), v_ref[0, 0, 0, 0], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        # single-lane stats stores (a broadcast-to-128-lane store writes
+        # 128x the bytes per step)
+        m_ref[:, :1] = m_new
+        l_ref[:, :1] = l_new
+
+    # full key blocks skip the col-mask VPU pass entirely; only the block
+    # straddling the valid-key boundary pays for masking
+    @pl.when((j + 1) * block_k <= kvlen_ref[b, s, p])
+    def _compute_full():
+        _online_step(masked=False)
+
+    @pl.when(
+        (j * block_k < kvlen_ref[b, s, p])
+        & ((j + 1) * block_k > kvlen_ref[b, s, p])
+    )
+    def _compute_partial():
+        _online_step(masked=True)
 
     @pl.when(j == pl.num_programs(5) - 1)
     def _finalize():
@@ -203,8 +228,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    @pl.when(j * block_k < kvlen_ref[b, s, p])
-    def _compute():
+    def _compute(masked: bool):
         qh = q_ref[0, 0, 0, 0]
         kh = k_ref[0, 0, 0, 0]
         # base-2 recompute (exp2 = one fewer VPU pass per logit than exp);
@@ -212,13 +236,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref,
         s_ = jax.lax.dot_general(
             qh, kh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * (scale * LOG2E)
-        col_bias = jnp.where(
-            jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1) + j * block_k
-            < kvlen_ref[b, s, p],
-            0.0,
-            NEG_INF,
-        )
-        pp = jnp.exp2(s_ + col_bias - _lane(lse_ref[0, 0, 0], t, block_q) * LOG2E)
+        if masked:
+            col_ok = (
+                jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1) + j * block_k
+                < kvlen_ref[b, s, p]
+            )
+            s_ = jnp.where(col_ok, s_, NEG_INF)
+        pp = jnp.exp2(s_ - _lane(lse_ref[0, 0, 0], t, block_q) * LOG2E)
         if causal:
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
             rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
@@ -233,6 +257,18 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref,
             ds.astype(kh.dtype), kh, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
+
+    # full key blocks skip the col-mask pass (see _fwd_kernel)
+    @pl.when((j + 1) * block_k <= kvlen_ref[b, s, p])
+    def _compute_full():
+        _compute(masked=False)
+
+    @pl.when(
+        (j * block_k < kvlen_ref[b, s, p])
+        & ((j + 1) * block_k > kvlen_ref[b, s, p])
+    )
+    def _compute_partial():
+        _compute(masked=True)
 
     @pl.when(j == pl.num_programs(5) - 1)
     def _finalize():
@@ -250,20 +286,19 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    @pl.when(j * block_k < kvlen_ref[b, s, p])
-    def _compute():
+    def _compute(masked: bool):
         qh = q_ref[0, 0, 0, 0]
         kh = k_ref[0, 0, 0, 0]
         s_ = jax.lax.dot_general(
             qh, kh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * (scale * LOG2E)  # base-2 units (see _dq_kernel)
-        col_bias = jnp.where(
-            jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1) + j * block_k
-            < kvlen_ref[b, s, p],
-            0.0,
-            NEG_INF,
-        )
-        pp = jnp.exp2(s_ + col_bias - _lane(lse_ref[0, 0, 0], t, block_q) * LOG2E)
+        if masked:
+            col_ok = (
+                jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1) + j * block_k
+                < kvlen_ref[b, s, p]
+            )
+            s_ = jnp.where(col_ok, s_, NEG_INF)
+        pp = jnp.exp2(s_ - _lane(lse_ref[0, 0, 0], t, block_q) * LOG2E)
         if causal:
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
             rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
@@ -281,6 +316,17 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref,
             ds, qh.astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
+
+    @pl.when((j + 1) * block_k <= kvlen_ref[b, s, p])
+    def _compute_full():
+        _compute(masked=False)
+
+    @pl.when(
+        (j * block_k < kvlen_ref[b, s, p])
+        & ((j + 1) * block_k > kvlen_ref[b, s, p])
+    )
+    def _compute_partial():
+        _compute(masked=True)
 
     @pl.when(i == pl.num_programs(5) - 1)
     def _finalize():
