@@ -44,9 +44,18 @@ Prints exactly one JSON line.
 
 import json
 import os
+import sys
+import time
+from typing import Tuple
 
 import jax.numpy as jnp
 import numpy as np
+
+# Written on every successful run; read back as the stale-number fallback
+# when backend acquisition fails at round end (the BENCH_r03/r04 rc=1
+# failure mode: two rounds of engineering invisible to the driver because
+# one flaky tunnel RPC zeroed the record).
+LOCAL_SNAPSHOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_LOCAL.json")
 
 N = 10240
 TILE_BATCH = 128  # reference pipeline.py:141
@@ -73,13 +82,75 @@ _PEAK_BY_KIND = {
 }
 
 
+def _probe_backend_subprocess(timeout_s: float) -> Tuple[bool, str]:
+    """Bounded out-of-process backend probe.
+
+    The tunnel has two failure modes: a fast 'Unable to initialize backend
+    axon: UNAVAILABLE' (BENCH_r04) and an indefinite HANG inside the first
+    jax.devices() (observed round 5) — the latter cannot be timed out
+    in-process (the init RPC blocks in C++ with no deadline), so each
+    attempt probes in a subprocess that a hard timeout can kill."""
+    import subprocess
+
+    code = "import jax; d = jax.devices(); print(d[0].device_kind)"
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"probe hung >{timeout_s:.0f}s (killed)"
+    if res.returncode == 0:
+        return True, res.stdout.strip().splitlines()[-1] if res.stdout else ""
+    tail = (res.stderr or "").strip().splitlines()
+    return False, tail[-1] if tail else f"probe rc={res.returncode}"
+
+
+_BACKEND_READY = False
+
+
+def acquire_backend(attempts: int = 4, delays=(10, 30, 60), probe_timeout=150.0):
+    """First jax.devices() with bounded, hang-proof retries.
+
+    Each attempt first probes backend init in a subprocess under a hard
+    timeout (see _probe_backend_subprocess); only after a probe succeeds
+    does the in-process init run — at that point it is overwhelmingly
+    likely to complete quickly. Raises after all attempts so main() can
+    emit the contractual JSON line with the stale-snapshot fallback.
+    Success is memoized: once the in-process backend is up, later calls
+    (e.g. chip_peak_flops) must not spawn further subprocess probes — a
+    second probe is one extra roll of the flaky-tunnel dice, and on
+    exclusive-lock runtimes it would fail against our own process.
+    """
+    global _BACKEND_READY
+    if _BACKEND_READY:
+        import jax
+
+        return jax.devices()
+    last = "unknown"
+    for i in range(attempts):
+        ok, msg = _probe_backend_subprocess(probe_timeout)
+        if ok:
+            import jax
+
+            devices = jax.devices()
+            _BACKEND_READY = True
+            return devices
+        last = msg
+        print(
+            f"bench: backend probe {i + 1}/{attempts} failed: {msg}",
+            file=sys.stderr,
+        )
+        if i < attempts - 1:
+            time.sleep(delays[min(i, len(delays) - 1)])
+    raise RuntimeError(f"backend unavailable after {attempts} probes: {last}")
+
+
 def chip_peak_flops() -> float:
     env = os.environ.get("TPU_PEAK_FLOPS")
     if env:
         return float(env)
-    import jax
-
-    kind = jax.devices()[0].device_kind.lower()
+    kind = acquire_backend()[0].device_kind.lower()
     for key, val in _PEAK_BY_KIND.items():
         if key in kind:
             return val
@@ -154,22 +225,33 @@ def bench_tile_encoder(peak_flops: float):
     flops = compiled_flops(
         lambda x, p: model.apply({"params": p}, x), imgs, params
     )
+    mfu_source = "compiled_hlo"
     if not flops or not np.isfinite(flops):
+        print(
+            "bench: tile_mfu falling back to analytic FLOP count "
+            f"(compiled_flops returned {flops!r})",
+            file=sys.stderr,
+        )
         flops = TILE_BATCH * tile_workload_flops(model)
+        mfu_source = "analytic"
     mfu = (flops / sec_per_iter) / peak_flops
     # analytic A100 denominator for the tiles/sec north star, mirroring
     # the slide encoder's baseline treatment (same MFU assumption)
     baseline_tiles_per_sec = (A100_FP16_FLOPS * A100_MFU) / tile_workload_flops(model)
-    return tiles_per_sec, mfu, baseline_tiles_per_sec
+    return tiles_per_sec, mfu, baseline_tiles_per_sec, mfu_source
 
 
-def main():
+def run_bench() -> dict:
     import jax
 
     from gigapath_tpu.models import slide_encoder
     from gigapath_tpu.utils.profiling import compiled_memory
     from gigapath_tpu.utils.timing import chained_seconds_per_iter
 
+    # retried init FIRST, unconditionally: with TPU_PEAK_FLOPS set,
+    # chip_peak_flops alone would never touch jax and the first (un-retried)
+    # backend init would happen inside model creation — the BENCH_r04 mode
+    acquire_backend()
     peak = chip_peak_flops()
 
     model, params = slide_encoder.create_model(
@@ -214,39 +296,81 @@ def main():
     train_tokens_per_sec = N / sec_train
 
     try:
-        tile_tiles_per_sec, tile_mfu, tile_baseline = bench_tile_encoder(peak)
+        tile_tiles_per_sec, tile_mfu, tile_baseline, tile_mfu_source = (
+            bench_tile_encoder(peak)
+        )
         tile_vs_baseline = round(tile_tiles_per_sec / tile_baseline, 3)
         tile_tiles_per_sec = round(tile_tiles_per_sec, 1)
         tile_mfu = round(tile_mfu, 3)
         tile_baseline = round(tile_baseline, 1)
     except Exception as e:  # the headline metric must survive a tile failure
         # stderr: stdout is contractually exactly one JSON line
-        import sys
-
         print(f"tile-encoder bench failed: {type(e).__name__}: {e}", file=sys.stderr)
         tile_tiles_per_sec, tile_mfu, tile_baseline, tile_vs_baseline = (
             None, None, None, None,
         )
+        tile_mfu_source = None
 
-    print(
-        json.dumps(
-            {
-                "metric": "slide_embed_tokens_per_sec",
-                "value": round(tokens_per_sec, 1),
-                "unit": "tokens/s",
-                "vs_baseline": round(tokens_per_sec / A100_REF_TOKENS_PER_SEC, 3),
-                "train_tokens_per_sec": round(train_tokens_per_sec, 1),
-                "mfu": round(mfu, 3),
-                "peak_hbm_gb": peak_hbm_gb,
-                "tile_tiles_per_sec": tile_tiles_per_sec,
-                "tile_mfu": tile_mfu,
-                "tile_vs_baseline": tile_vs_baseline,
-                "tile_baseline_tiles_per_sec": tile_baseline,
-                "baseline_tokens_per_sec": round(A100_REF_TOKENS_PER_SEC, 1),
-                "baseline_version": BASELINE_VERSION,
-            }
-        )
-    )
+    return {
+        "metric": "slide_embed_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tokens_per_sec / A100_REF_TOKENS_PER_SEC, 3),
+        "train_tokens_per_sec": round(train_tokens_per_sec, 1),
+        "mfu": round(mfu, 3),
+        "peak_hbm_gb": peak_hbm_gb,
+        "tile_tiles_per_sec": tile_tiles_per_sec,
+        "tile_mfu": tile_mfu,
+        "tile_mfu_source": tile_mfu_source,
+        "tile_vs_baseline": tile_vs_baseline,
+        "tile_baseline_tiles_per_sec": tile_baseline,
+        "baseline_tokens_per_sec": round(A100_REF_TOKENS_PER_SEC, 1),
+        "baseline_version": BASELINE_VERSION,
+    }
+
+
+def main():
+    """Print exactly one JSON line; exit 0 even on failure.
+
+    On success the payload is also snapshotted to BENCH_LOCAL.json. On
+    failure (after acquire_backend's bounded retries) the JSON line still
+    honors the contract: metric/value/unit are taken from the last local
+    snapshot if one exists (marked ``"stale": true`` with its timestamp),
+    plus an ``"error"`` field — so a transient round-end tunnel outage
+    degrades the record to "stale number", not "no number".
+    """
+    try:
+        payload = run_bench()
+    except Exception as e:  # noqa: BLE001 — contract: always print the JSON line
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        payload = {
+            "metric": "slide_embed_tokens_per_sec",
+            "value": None,
+            "unit": "tokens/s",
+            "error": f"{type(e).__name__}: {e}",
+        }
+        if os.path.exists(LOCAL_SNAPSHOT):
+            try:
+                with open(LOCAL_SNAPSHOT) as f:
+                    snap = json.load(f)
+                snap.pop("error", None)
+                payload.update(snap)
+                payload["error"] = f"{type(e).__name__}: {e}"
+                payload["stale"] = True
+            except Exception as snap_err:
+                print(f"bench: snapshot unreadable: {snap_err}", file=sys.stderr)
+        print(json.dumps(payload))
+        return
+    payload["snapshot_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    try:
+        with open(LOCAL_SNAPSHOT, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+    except Exception as snap_err:
+        print(f"bench: snapshot write failed: {snap_err}", file=sys.stderr)
+    print(json.dumps(payload))
 
 
 if __name__ == "__main__":

@@ -135,25 +135,49 @@ class DevicePrefetcher:
 
     def __iter__(self) -> Iterator[dict]:
         q: queue.Queue = queue.Queue(maxsize=self.depth)
+        # Set when the consumer abandons iteration (break / exception /
+        # GeneratorExit): without it the producer blocks forever on q.put
+        # with ``depth`` device-resident batches pinned — a leaked thread
+        # plus leaked HBM per abandoned epoch.
+        done = threading.Event()
+
+        def _put(item) -> bool:
+            while not done.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def produce():
             try:
                 for batch in self.loader:
-                    q.put(self._to_device(batch) if batch is not None else None)
-                q.put(self._SENTINEL)
+                    if not _put(self._to_device(batch) if batch is not None else None):
+                        return
+                _put(self._SENTINEL)
             except BaseException as e:  # noqa: BLE001 — re-raised in consumer
-                q.put(("__error__", e))
+                _put(("__error__", e))
 
         thread = threading.Thread(target=produce, daemon=True)
         thread.start()
-        while True:
-            item = q.get()
-            if item is self._SENTINEL:
-                return
-            if isinstance(item, tuple) and len(item) == 2 and item[0] == "__error__":
-                raise item[1]
-            if item is not None:
-                yield item
+        try:
+            while True:
+                item = q.get()
+                if item is self._SENTINEL:
+                    return
+                if isinstance(item, tuple) and len(item) == 2 and item[0] == "__error__":
+                    raise item[1]
+                if item is not None:
+                    yield item
+        finally:
+            done.set()
+            # drain so a producer mid-put unblocks immediately
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
 
 
 def get_loader(

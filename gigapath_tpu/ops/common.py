@@ -8,3 +8,12 @@ never load ``jax.experimental.pallas`` just for arithmetic.
 
 def round_up(n: int, m: int) -> int:
     return -(-n // m) * m
+
+
+def env_flag(name: str) -> bool:
+    """Truthy env flag; ''/'0'/'false'/'no' all mean OFF — the one
+    truthiness convention for every GIGAPATH_* flag (and mirrored by
+    tests/conftest.py's RUN_SLOW check)."""
+    import os
+
+    return os.environ.get(name, "").strip().lower() not in ("", "0", "false", "no")

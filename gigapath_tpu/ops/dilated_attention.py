@@ -48,11 +48,20 @@ from gigapath_tpu.ops.common import round_up as _round_up
 AttnFn = Callable[..., Tuple[jnp.ndarray, jnp.ndarray]]
 
 
-def _env_flag(name: str) -> bool:
-    """Truthy env flag; '0'/'false'/'no'/'' all mean OFF."""
-    import os
+from gigapath_tpu.ops.common import env_flag as _env_flag  # shared convention
 
-    return os.environ.get(name, "").strip().lower() not in ("", "0", "false", "no")
+
+_WARNED: set = set()
+
+
+def _warn_once(msg: str) -> None:
+    """One warning per distinct message per process (dispatch runs inside
+    trace-time Python, so an unguarded warn would fire on every retrace)."""
+    if msg not in _WARNED:
+        _WARNED.add(msg)
+        import warnings
+
+        warnings.warn(msg, stacklevel=3)
 
 
 def _kv_valid_lengths(
@@ -816,6 +825,15 @@ def dilated_attention(
                     is_causal=is_causal, valid_len=valid_len,
                     streaming_fusion=streaming,
                 )
+            # visible, once per schedule: this fallback is a perf cliff
+            # (head-major re-tiles activations per branch) that no log
+            # line would otherwise ever attribute
+            _warn_once(
+                "dilated-attention schedule %s/%s has a ratio not dividing "
+                "H=%d (or H*Dh=%d): falling back from the fused phase-major "
+                "path to the head-major path"
+                % (list(segment_lengths), list(dilated_ratios), H, H * Dh)
+            )
             return dilated_attention_bhld(
                 q, k, v, segment_lengths, dilated_ratios,
                 is_causal=is_causal, valid_len=valid_len,

@@ -163,6 +163,190 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref,
             lse_ref[0, 0, 0] = jnp.where(lane == t, val, lse_ref[0, 0, 0])
 
 
+def _fwd_kernel_pipe(q_ref, k_ref, v_ref, kvlen_ref, o_ref, lse_ref,
+                     m_ref, l_ref, acc_ref, s_bufs, *, scale,
+                     block_q, block_k, hb, nk):
+    """Software-pipelined forward: grid (B, S, r, nq, hb*nk + 1).
+
+    The serial kernel's body is a strict MXU -> VPU -> MXU dependence
+    chain (QK^T, softmax, PV), so the VPU softmax serializes behind the
+    MXU and cells measure ~1.7-1.9x over the Dh=48 shape bound
+    (PERFORMANCE.md round-4 decomposition). This variant restructures the
+    chain across grid steps: step n computes cell n's logits (MXU, into a
+    parity scratch) and consumes cell n-1's logits (VPU softmax + PV) —
+    every body opens with a big MXU matmul that is data-independent of
+    the VPU chain that follows, which is the opportunity the serial body
+    never gives the Mosaic scheduler. Cells are the flattened (head,
+    k-block) steps of one q block; v/out index maps lag one step. The
+    round-3 in-cell k-split (memory: rejected, 2.83->3.05 ms) differs
+    materially: its two softmax chains shared the running (m, l) carry,
+    so the "independent" matmul was bracketed by dependent VPU work.
+
+    Non-causal only (the fused path's production use); the serial kernel
+    remains for causal and as the default until the on-chip A/B decides.
+    """
+    b, s, p = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    n = pl.program_id(4)
+    total = hb * nk
+    kv = kvlen_ref[b, s, p]
+    j_p = jax.lax.rem(n, nk)
+    t_c = jax.lax.div(n - 1, nk)
+    j_c = jax.lax.rem(n - 1, nk)
+
+    # ---- produce: cell n's logits into the parity scratch (MXU) ----
+    @pl.when((n < total) & (j_p * block_k < kv))
+    def _produce():
+        qh = (q_ref[0, 0, 0, 0].astype(jnp.float32) * (scale * LOG2E)).astype(
+            q_ref.dtype
+        )
+        s_bufs[jax.lax.rem(n, 2)] = jax.lax.dot_general(
+            qh, k_ref[0, 0, 0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    # ---- consume: cell n-1's logits (VPU softmax + PV matmul) ----
+    @pl.when((n >= 1) & (j_c == 0))
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, M_FLOOR)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _consume(masked: bool):
+        s_ = s_bufs[jax.lax.rem(n - 1, 2)]
+        if masked:
+            col_ok = (
+                jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+                + j_c * block_k
+                < kv
+            )
+            s_ = jnp.where(col_ok, s_, NEG_INF)
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s_, axis=-1, keepdims=True))
+        pp = jnp.exp2(s_ - m_new)
+        if nk == 1:
+            # single k block per head: no online carry (see _fwd_kernel)
+            l_new = jnp.sum(pp, axis=-1, keepdims=True)
+            acc_ref[:] = jax.lax.dot_general(
+                pp.astype(v_ref.dtype), v_ref[0, 0, 0, 0],
+                (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+            )
+        else:
+            alpha = jnp.exp2(m_prev - m_new)
+            l_new = l_ref[:, :1] * alpha + jnp.sum(pp, axis=-1, keepdims=True)
+            acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+                pp.astype(v_ref.dtype), v_ref[0, 0, 0, 0],
+                (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+            )
+        m_ref[:, :1] = m_new
+        l_ref[:, :1] = l_new
+
+    @pl.when((n >= 1) & ((j_c + 1) * block_k <= kv))
+    def _consume_full():
+        _consume(masked=False)
+
+    @pl.when((n >= 1) & (j_c * block_k < kv) & ((j_c + 1) * block_k > kv))
+    def _consume_partial():
+        _consume(masked=True)
+
+    @pl.when((n >= 1) & (j_c == nk - 1))
+    def _finalize():
+        safe_l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0, 0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        val = (m_ref[:, :1] + jnp.log2(safe_l)) * LN2
+        lane = jax.lax.broadcasted_iota(jnp.int32, (block_q, LANES), 1)
+
+        @pl.when(t_c == 0)
+        def _first_head():
+            lse_ref[0, 0, 0] = jnp.where(lane == 0, val, NEG_INF)
+
+        @pl.when(t_c > 0)
+        def _later_head():
+            lse_ref[0, 0, 0] = jnp.where(lane == t_c, val, lse_ref[0, 0, 0])
+
+
+def _fwd_impl_pipe(q6, k6, v6, kvlen, scale, heads, head_dim,
+                   block_q, block_k, interpret):
+    """Pipelined forward dispatch: same contract as _fwd_impl (non-causal).
+
+    block_k may differ from block_q (a shallower k block deepens the
+    pipeline); the k/v packed arrays are zero-padded to a block_k multiple
+    — padded blocks are skipped by the kvlen guards."""
+    B, S, r, hb, M, Dh = q6.shape
+    Mk = k6.shape[4]
+    assert hb == heads and Dh == head_dim, (hb, heads, Dh, head_dim)
+    Mkp = _round_up(Mk, block_k)
+    if Mkp != Mk:
+        pad = ((0, 0), (0, 0), (0, 0), (0, 0), (0, Mkp - Mk), (0, 0))
+        k6 = jnp.pad(k6, pad)
+        v6 = jnp.pad(v6, pad)
+    nq, nk = M // block_q, Mkp // block_k
+    total = hb * nk
+
+    def t_p(n):
+        return jnp.minimum(n // nk, hb - 1)
+
+    def cell_c(n):
+        tc = jnp.clip((n - 1) // nk, 0, hb - 1)
+        jc = jnp.clip(n - 1 - tc * nk, 0, nk - 1)
+        return tc, jc
+
+    spec_q = pl.BlockSpec(
+        (1, 1, 1, 1, block_q, head_dim),
+        lambda b, s, p, i, n: (b, s, p, t_p(n), i, 0),
+        memory_space=pltpu.VMEM,
+    )
+    spec_k = pl.BlockSpec(
+        (1, 1, 1, 1, block_k, head_dim),
+        # j clamped: at the drain step (n == hb*nk) no produce executes but
+        # the index must still name a real block
+        lambda b, s, p, i, n: (
+            b, s, p, t_p(n), jnp.minimum(n - t_p(n) * nk, nk - 1), 0,
+        ),
+        memory_space=pltpu.VMEM,
+    )
+    def v_map(b, s, p, i, n):
+        tc, jc = cell_c(n)
+        return (b, s, p, tc, jc, 0)
+
+    spec_v = pl.BlockSpec(
+        (1, 1, 1, 1, block_k, head_dim), v_map, memory_space=pltpu.VMEM,
+    )
+
+    def o_map(b, s, p, i, n):
+        tc, _ = cell_c(n)
+        return (b, s, p, tc, i, 0)
+
+    spec_o = pl.BlockSpec(
+        (1, 1, 1, 1, block_q, head_dim), o_map, memory_space=pltpu.VMEM,
+    )
+    lse_spec = pl.BlockSpec(
+        (1, 1, 1, block_q, LANES), lambda b, s, p, i, n: (b, s, p, i, 0),
+        memory_space=pltpu.VMEM,
+    )
+    kernel = functools.partial(
+        _fwd_kernel_pipe, scale=scale,
+        block_q=block_q, block_k=block_k, hb=hb, nk=nk,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(B, S, r, nq, total + 1),
+        in_specs=[spec_q, spec_k, spec_v, pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=[spec_o, lse_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(q6.shape, q6.dtype),
+            jax.ShapeDtypeStruct((B, S, r, M, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+            pltpu.VMEM((2, block_q, block_k), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q6, k6, v6, kvlen)
+    return out, lse
+
+
 def _fwd_impl(q6, k6, v6, kvlen, causal, scale, heads, head_dim,
               block_q, block_k, interpret):
     B, S, r, hb, M, Dh = q6.shape
@@ -446,12 +630,29 @@ def _pack_bt(Mp: int, r: int, E: int, itemsize: int) -> int:
     [bt, r*E] dense row-block in VMEM, so bt*r*E*itemsize must stay well
     under the budget with double buffering (itemsize matters: the public
     op is dtype-generic, and fp32 doubles the footprint). Mp is always a
-    multiple of 128 (block sizes are), so every candidate divides it."""
+    multiple of 128 (block sizes are), so every candidate divides it.
+
+    bt is a SUBLANE block dim (lanes are r*E, always full-width), so it may
+    legally shrink below 128 down to the 8-row fp32 tile — which is what
+    enforces the budget when r*E*itemsize is large: at the flagship r=16
+    branch in fp32, bt=128 would be ~6.3 MB in + 6.3 MB out (~25 MB
+    double-buffered, over the ~16 MB scoped-VMEM ceiling — the BENCH_r03
+    OOM class); bt=64 lands back inside the budget. A lane split is NOT
+    available here: the per-phase window is W = E/r lanes (48 at the
+    flagship), and Mosaic only allows lane blocks that are 128-multiples
+    or the whole dim."""
     bt = 512
-    while bt > 128 and bt * r * E * itemsize > 4 * 2 ** 20:
+    while bt > 8 and bt * r * E * itemsize > 4 * 2 ** 20:
         bt //= 2
     while Mp % bt:
         bt //= 2
+    if bt * r * E * itemsize > 8 * 2 ** 20:
+        raise ValueError(
+            f"pack/unpack row block [bt={bt}, r*E={r * E}] at itemsize "
+            f"{itemsize} exceeds the VMEM copy budget even at the minimum "
+            f"block height; use a narrower model width, smaller dilation "
+            f"ratio, or a 2-byte dtype"
+        )
     return bt
 
 
@@ -613,6 +814,23 @@ def _dilated_branch(q, k, v, vl_dyn, sl, r, H, real_len, causal, interpret):
     return out, lse
 
 
+def _pipe_block_k(block_q: int) -> int:
+    """k-block for the pipelined forward: GIGAPATH_PIPE_BLOCK_K or a
+    default that keeps the two parity logits tiles + the exp2 temp inside
+    the scoped-VMEM envelope at any legal block_q (<= 1408)."""
+    import os
+
+    env = os.environ.get("GIGAPATH_PIPE_BLOCK_K", "")
+    bk = int(env) if env else 512
+    return max(LANES, min(bk, block_q))
+
+
+def _pipelined_fwd_enabled() -> bool:
+    from gigapath_tpu.ops.common import env_flag
+
+    return env_flag("GIGAPATH_PIPELINED_ATTN")
+
+
 def _dilated_branch_fwd_impl(q, k, v, vl_dyn, sl, r, H, real_len, causal, interpret):
     B, L, E = q.shape
     Dh = E // H
@@ -622,9 +840,16 @@ def _dilated_branch_fwd_impl(q, k, v, vl_dyn, sl, r, H, real_len, causal, interp
     v6 = _pack_phases(v, g, S, r, Mp, H, interpret)
     kvlen = _branch_kvlen(B, S, g, r, m, real_len, vl_dyn)
     hb = H // r
-    out6, lse5 = _fwd_impl(
-        q6, k6, v6, kvlen, causal, Dh ** -0.5, hb, Dh, block, block, interpret
-    )
+    if not causal and _pipelined_fwd_enabled():
+        out6, lse5 = _fwd_impl_pipe(
+            q6, k6, v6, kvlen, Dh ** -0.5, hb, Dh,
+            block, _pipe_block_k(block), interpret,
+        )
+    else:
+        out6, lse5 = _fwd_impl(
+            q6, k6, v6, kvlen, causal, Dh ** -0.5, hb, Dh, block, block,
+            interpret,
+        )
     # off-band lanes come back as exact zeros from the unpack kernel — the
     # branch's cover pattern needs no separate select
     out = _unpack_phases(out6, L, E, g, S, r, interpret)

@@ -26,6 +26,10 @@ def main():
     ap.add_argument("--branches", default="", help="comma indices; empty = all 5")
     ap.add_argument("--n", type=int, default=10241)
     ap.add_argument("--iters", type=int, default=24)
+    ap.add_argument(
+        "--pipe-bk", default="512",
+        help="comma list of pipelined k-block sizes (with 'pipe' variant)",
+    )
     args = ap.parse_args()
 
     from gigapath_tpu.models.longnet_config import flagship_geometry
@@ -60,6 +64,30 @@ def main():
         variants["fused"] = lambda q, k, v: da.dilated_attention_fused(
             q, k, v, SEGS, RATIOS
         )
+    if "pipe" in args.variants:
+        # software-pipelined forward kernel (env flags read at trace time,
+        # so setting them inside the traced fn scopes them to the variant)
+        def make_pipe(bk):
+            def fn(q, k, v):
+                prior = {
+                    key: os.environ.get(key)
+                    for key in ("GIGAPATH_PIPELINED_ATTN", "GIGAPATH_PIPE_BLOCK_K")
+                }
+                os.environ["GIGAPATH_PIPELINED_ATTN"] = "1"
+                os.environ["GIGAPATH_PIPE_BLOCK_K"] = str(bk)
+                try:
+                    return da.dilated_attention_fused(q, k, v, SEGS, RATIOS)
+                finally:
+                    for key, val in prior.items():
+                        if val is None:
+                            os.environ.pop(key, None)
+                        else:
+                            os.environ[key] = val
+
+            return fn
+
+        for bk in (int(b) for b in args.pipe_bk.split(",") if b):
+            variants[f"pipe{bk}"] = make_pipe(bk)
 
     def make_step(fn):
         def step(x, k, v):

@@ -1,0 +1,19 @@
+"""Probe the axon TPU backend once: exit 0 (+ one status line) if a tiny
+matmul completes, nonzero otherwise. Run under `timeout` from a watcher
+loop — backend init on a dead tunnel hangs rather than erroring, so the
+caller owns the deadline."""
+
+import sys
+import time
+
+t0 = time.time()
+import jax  # noqa: E402
+
+ds = jax.devices()
+x = jax.numpy.ones((256, 256))
+jax.block_until_ready(x @ x)
+print(
+    f"axon up: {len(ds)}x {ds[0].device_kind} "
+    f"(init+matmul {time.time() - t0:.1f}s)"
+)
+sys.exit(0)

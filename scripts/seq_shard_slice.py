@@ -6,22 +6,35 @@ The documented beyond-single-chip operating point (reference
 2^20 segment, ``slide_encoder.py:137-154``) is 8 x v5e shards over a
 ``seq`` mesh axis: each shard holds L/8 = 131,072 local tokens, branches
 whose segment exceeds the local length gather K/V across shards
-(``_gather_kv_seq_parallel``), and every shard then runs the SAME Pallas
-kernels a single-chip forward would. The 8-way virtual-CPU-mesh test
-(tests/test_dilated_attention.py::test_seq_parallel_*) proves collective
-correctness; this script measures the other half of the claim on real
-hardware — the per-shard kernel wallclock at the true per-device shapes:
+(``_gather_kv_seq_parallel``), and every shard then runs the SAME attention
+code a single-chip forward would. The 8-way virtual-CPU-mesh tests
+(tests/test_dilated_attention.py::test_seq_parallel_*) prove collective
+correctness; this script measures the compute half of the claim on real
+hardware — per-shard wallclock at the true per-device shapes, through the
+PUBLIC dispatch (pack/unpack and all glue included), forward AND
+forward+backward:
 
-  - branches with sl <= 131072 run fully local (L = 131,072);
-  - branch (185363, r=8): local phase queries m_q = 16,384 per head
-    against the segment's gathered sparse keys m_k = ceil(185363/8);
-  - branch (2^20, r=16): m_q = 8,192 against m_k = 65,536.
+  - branches with sl <= 131072 run fully local: one ``dilated_attention``
+    call at L = 131,072 (the fused phase-major Pallas path, exactly what a
+    shard executes for these branches);
+  - branch (185363, r=8): the shard's 16,384 local phase queries per head
+    cross-attend the segment's gathered sparse keys (23,171 per head);
+  - branch (2^20, r=16): 8,192 local queries vs 65,536 gathered keys.
 
-Shapes are built directly in the kernel layout (this is a TIMING slice —
-numerical equivalence of the sharded path is covered by the mesh tests).
-Prints one JSON line.
+Gathered branches are emulated by calling ``dilated_attention`` with the
+local-length q against the full segment's K/V — the identical
+``_dilated_branch`` code the shard_map path runs per shard, except that the
+emulation also packs the full segment's K/V where a real shard packs only
+its local 1/8 before the collective. That overcount is measured separately
+(``dense_to_sparse`` timed at both lengths) and reported both raw and
+corrected.
+
+The collective itself cannot be timed on one chip; it is reported as an
+analytic byte count / 100 GB/s ICI bound, clearly labeled as such. Output:
+one JSON line (tee'd to SEQ_SHARD.json by --out).
 """
 
+import argparse
 import json
 import os
 import sys
@@ -34,84 +47,156 @@ import numpy as np
 
 
 def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default=None, help="also write the JSON here")
+    parser.add_argument(
+        "--ltotal", type=int, default=1 << 20,
+        help="total tokens (default: the 1M operating point; lower it only "
+        "for smoke-testing the script itself)",
+    )
+    parser.add_argument("--ndev", type=int, default=8)
+    args = parser.parse_args()
+
     from gigapath_tpu.models.longnet_config import flagship_geometry
-    from gigapath_tpu.ops import pallas_flash as pf
-    from gigapath_tpu.ops.common import round_up
-    from gigapath_tpu.ops.dilated_attention import dilated_attention_fused
+    from gigapath_tpu.ops.dilated_attention import (
+        dense_to_sparse,
+        dilated_attention,
+    )
     from gigapath_tpu.utils.timing import chained_seconds_per_iter
 
     G = flagship_geometry()
     H, Dh = G["heads"], G["head_dim"]
     SEGS, RATIOS = G["segment_lengths"], G["dilated_ratios"]
-    L_TOTAL = 1 << 20
-    N_DEV = 8
+    L_TOTAL = args.ltotal
+    N_DEV = args.ndev
     L_LOCAL = L_TOTAL // N_DEV
 
     rng = np.random.default_rng(0)
     local_branches = [(sl, r) for sl, r in zip(SEGS, RATIOS) if sl <= L_LOCAL]
     gathered_branches = [(sl, r) for sl, r in zip(SEGS, RATIOS) if sl > L_LOCAL]
 
-    timings = {}
-
-    # local branches: one fused multi-branch call at the shard length
-    q, k, v = (
-        jnp.asarray(rng.normal(size=(1, L_LOCAL, H, Dh)), jnp.bfloat16)
-        for _ in range(3)
-    )
-
-    def step_local(x, k, v):
-        o = dilated_attention_fused(
-            x, k, v, [sl for sl, _ in local_branches],
-            [r for _, r in local_branches],
-        )
-        return x + (o.astype(jnp.float32).sum() * 1e-30).astype(x.dtype)
-
-    sec, _ = chained_seconds_per_iter(
-        step_local, q, args=(k, v), iters_low=2, iters_high=6
-    )
-    timings["local_branches_sec"] = round(sec, 4)
-
-    # gathered branches: local phase queries vs the segment's sparse keys,
-    # in the [B, H, S, M, D] kernel layout pf._fwd_impl runs
-    gather_bytes = 0
-    for sl, r in gathered_branches:
-        g = min(sl, L_TOTAL)
-        m_q = round_up(L_LOCAL // r, 128)
-        m_k = round_up(-(-g // r), 128)
-        q5 = jnp.asarray(rng.normal(size=(1, H, 1, m_q, Dh)), jnp.bfloat16)
-        k5 = jnp.asarray(rng.normal(size=(1, H, 1, m_k, Dh)), jnp.bfloat16)
-        v5 = jnp.asarray(rng.normal(size=(1, H, 1, m_k, Dh)), jnp.bfloat16)
-
-        def step_branch(x, k5, v5):
-            o, _ = pf._fwd_impl(
-                x, k5, v5, None, False, Dh ** -0.5, 1024, 1024, False
-            )
-            return x + (o.astype(jnp.float32).sum() * 1e-30).astype(x.dtype)
-
-        sec, _ = chained_seconds_per_iter(
-            step_branch, q5, args=(k5, v5), iters_low=2, iters_high=6
-        )
-        timings[f"branch_sl{sl}_r{r}_sec"] = round(sec, 4)
-        # K/V rows this shard must receive from the other 7 (bf16, k+v)
-        gather_bytes += 2 * (g - L_LOCAL) * H * Dh * 2
-
-    per_shard = sum(v for v in timings.values())
-    # v5e ICI ~100 GB/s effective per link as a round-number envelope; the
-    # gather overlaps compute in the shard_map schedule, so this is an
-    # upper bound on exposed collective time
-    gather_sec = gather_bytes / 100e9
     result = {
         "metric": "seq_shard_slice_1m",
         "recipe": f"{N_DEV} x ({L_LOCAL} local tokens + gathered KV)",
         "branches_local": local_branches,
         "branches_gathered": gathered_branches,
-        **timings,
-        "per_shard_kernel_sec": round(per_shard, 3),
-        "gather_gb_per_shard": round(gather_bytes / 2 ** 30, 2),
-        "gather_sec_bound_at_100GBps": round(gather_sec, 3),
-        "slide_sec_bound": round(per_shard + gather_sec, 3),
+        "streaming_fusion": os.environ.get("GIGAPATH_STREAMING_FUSION", ""),
     }
-    print(json.dumps(result))
+    fwd_total = 0.0
+    train_total = 0.0
+
+    def mk(shape):
+        return jnp.asarray(rng.normal(size=shape), jnp.bfloat16)
+
+    def time_fwd_and_grad(call, q, k, v, tag):
+        """Forward sec + (fwd+bwd) sec for out = call(q, k, v)."""
+        nonlocal fwd_total, train_total
+
+        def step_f(x, k, v):
+            o = call(x, k, v)
+            return x + (o.astype(jnp.float32).sum() * 1e-30).astype(x.dtype)
+
+        def step_g(x, k, v):
+            def loss(q_, k_, v_):
+                return call(q_, k_, v_).astype(jnp.float32).sum()
+
+            gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(x, k, v)
+            tot = (
+                gq.astype(jnp.float32).sum()
+                + gk.astype(jnp.float32).sum()
+                + gv.astype(jnp.float32).sum()
+            )
+            return x + (tot * 1e-30).astype(x.dtype)
+
+        sec_f, _ = chained_seconds_per_iter(
+            step_f, q, args=(k, v), iters_low=2, iters_high=6
+        )
+        sec_g, _ = chained_seconds_per_iter(
+            step_g, q, args=(k, v), iters_low=2, iters_high=6
+        )
+        result[f"{tag}_fwd_sec"] = round(sec_f, 4)
+        result[f"{tag}_train_sec"] = round(sec_g, 4)
+        fwd_total += sec_f
+        train_total += sec_g
+        return sec_f, sec_g
+
+    # ---- local branches: one public-dispatch call at the shard length ----
+    q = mk((1, L_LOCAL, H, Dh))
+    k = mk((1, L_LOCAL, H, Dh))
+    v = mk((1, L_LOCAL, H, Dh))
+    segs_l = [sl for sl, _ in local_branches]
+    rats_l = [r for _, r in local_branches]
+    time_fwd_and_grad(
+        lambda q_, k_, v_: dilated_attention(q_, k_, v_, segs_l, rats_l),
+        q, k, v, "local_branches",
+    )
+
+    # ---- gathered branches: local q vs the segment's full K/V ----
+    pack_overcount_fwd = 0.0
+    for sl, r in gathered_branches:
+        g = min(sl, L_TOTAL)
+        kg = mk((1, g, H, Dh))
+        vg = mk((1, g, H, Dh))
+        time_fwd_and_grad(
+            lambda q_, k_, v_, sl=sl, r=r: dilated_attention(
+                q_, k_, v_, [sl], [r]
+            ),
+            q, kg, vg, f"branch_sl{sl}_r{r}",
+        )
+
+        # emulation packs g K/V rows where a real shard packs L_LOCAL
+        # before the collective: measure the overcount at both lengths
+        def pack_step(x, r=r):
+            s = dense_to_sparse(x.reshape(-1, x.shape[1], H, Dh), r)
+            return x + (s.astype(jnp.float32).sum() * 1e-30).astype(x.dtype)
+
+        sec_full, _ = chained_seconds_per_iter(
+            pack_step, kg, iters_low=2, iters_high=6
+        )
+        sec_local, _ = chained_seconds_per_iter(
+            pack_step, k, iters_low=2, iters_high=6
+        )
+        over = 2.0 * max(sec_full - sec_local, 0.0)  # k and v
+        result[f"branch_sl{sl}_r{r}_kvpack_overcount_sec"] = round(over, 4)
+        pack_overcount_fwd += over
+
+        # bytes this shard RECEIVES from the other N-1: packed sparse K+V
+        # rows it does not already hold (bf16)
+        m_total = -(-g // r)
+        m_local = L_LOCAL // r
+        result[f"branch_sl{sl}_r{r}_gather_mb"] = round(
+            2 * (m_total - m_local) * H * Dh * 2 / 2**20, 1
+        )
+
+    gather_bytes = sum(
+        result[f"branch_sl{sl}_r{r}_gather_mb"] * 2**20
+        for sl, r in gathered_branches
+    )
+    gather_sec = gather_bytes / 100e9
+    result.update(
+        {
+            "per_shard_fwd_sec_raw": round(fwd_total, 4),
+            "per_shard_fwd_sec": round(fwd_total - pack_overcount_fwd, 4),
+            # bwd re-packs in the VJP too; correct with the same overcount
+            # (the backward of a copy costs what the forward copy costs)
+            "per_shard_train_sec_raw": round(train_total, 4),
+            "per_shard_train_sec": round(train_total - 2 * pack_overcount_fwd, 4),
+            "gather_mb_per_shard": round(gather_bytes / 2**20, 1),
+            "gather_sec_bound_at_100GBps_analytic": round(gather_sec, 4),
+            "slide_fwd_sec_bound": round(
+                fwd_total - pack_overcount_fwd + gather_sec, 4
+            ),
+            "slide_train_sec_bound": round(
+                train_total - 2 * pack_overcount_fwd + 2 * gather_sec, 4
+            ),
+            "device_kind": jax.devices()[0].device_kind,
+        }
+    )
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
 
 
 if __name__ == "__main__":

@@ -548,3 +548,39 @@ def test_fused_streaming_matches_stacked(rng):
         np.asarray(streamed)[:, :60], np.asarray(stacked)[:, :60],
         atol=2e-6, rtol=1e-5,
     )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "L,sl,r,rl",
+    [
+        (300, 64, 1, 300),      # nk == 1, single head band
+        (300, 64, 2, 277),      # nk == 1, phases + ragged tail
+        (1280, 1280, 1, 1280),  # nk > 1 (pipe block_k 512 vs block_q 1280)
+        (1280, 1280, 2, 1100),  # nk > 1 + phases + ragged tail
+    ],
+)
+def test_pipelined_fwd_matches_serial(rng, monkeypatch, L, sl, r, rl):
+    """GIGAPATH_PIPELINED_ATTN forward == the serial fused kernel.
+
+    The pipelined kernel computes cell n's logits while consuming cell
+    n-1's from a parity scratch (v/out index maps lag one step); same
+    online-softmax math, so outputs agree to fp32 rounding even when the
+    k-block split differs."""
+    from gigapath_tpu.ops.pallas_dilated import dilated_branch_attention
+
+    H, Dh = 8, 16
+    E = H * Dh
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(2, L, E)), jnp.float32) for _ in range(3)
+    )
+    monkeypatch.delenv("GIGAPATH_PIPELINED_ATTN", raising=False)
+    o0, l0 = dilated_branch_attention(q, k, v, sl, r, H, real_len=rl, interpret=True)
+    monkeypatch.setenv("GIGAPATH_PIPELINED_ATTN", "1")
+    monkeypatch.setenv("GIGAPATH_PIPE_BLOCK_K", "512")
+    o1, l1 = dilated_branch_attention(q, k, v, sl, r, H, real_len=rl, interpret=True)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o0), atol=2e-6, rtol=1e-5)
+    fin = np.asarray(l0) > -1e19  # uncovered slots hold sentinels
+    np.testing.assert_allclose(
+        np.asarray(l1)[fin], np.asarray(l0)[fin], atol=2e-6, rtol=1e-5
+    )
