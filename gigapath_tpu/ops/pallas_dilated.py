@@ -656,42 +656,104 @@ def _pack_bt(Mp: int, r: int, E: int, itemsize: int) -> int:
     return bt
 
 
-def _pack_kernel(x_ref, o_ref, *, r, hb, Dh, bt):
-    """One dense row-block [bt, r*E] -> ALL phases' [r, hb, bt, Dh] packed
-    blocks. In the [B, S, Mp, r*E] view of the padded dense tensor, token
-    ``j*r + p`` of a segment is row j, lanes ``[p*E, (p+1)*E)`` — so phase
-    extraction is pure static LANE slicing (the earlier per-phase variant
-    extracted rows ``phase::r``, a stride-r sublane gather that measured
-    ~5x over the bandwidth floor at r=2, and re-read the dense block once
-    per phase on top)."""
-    x = x_ref[0, 0]  # [bt, r*E]
-    E = x.shape[-1] // r
+def _band_lanes(r, hb, Dh, E):
+    """(phase, head, lane_start) of the diagonal band layout in a
+    [bt, r*E] dense row-block: token ``j*r + p`` of a segment is row j,
+    lanes ``[p*E, (p+1)*E)``, and band p's heads sit at sublanes
+    ``p*W + t*Dh`` within the token (W = hb*Dh) — so phase/head extraction
+    is pure static LANE slicing. The ONE place the layout math lives:
+    both pack kernels extract with it and both unpack kernels rebuild
+    with it (the padded-view and direct variants must never diverge)."""
     W = hb * Dh
     for p in range(r):
-        base = p * E + p * W  # phase p's row chunk, band p's lanes
+        base = p * E + p * W
         for t in range(hb):
-            o_ref[0, 0, p, t] = x[:, base + t * Dh : base + (t + 1) * Dh]
+            yield p, t, base + t * Dh
+
+
+def _extract_bands(x, o_ref, r, hb, Dh):
+    """[bt, r*E] dense row-block -> packed [.., p, t] blocks of o_ref.
+    (The earlier per-phase variant extracted rows ``phase::r``, a stride-r
+    sublane gather that measured ~5x over the bandwidth floor at r=2, and
+    re-read the dense block once per phase on top.)"""
+    E = x.shape[-1] // r
+    for p, t, lane in _band_lanes(r, hb, Dh, E):
+        o_ref[0, 0, p, t] = x[:, lane : lane + Dh]
+
+
+def _assemble_bands(x_ref, r, hb, Dh, E, bt, dtype):
+    """Packed [.., p, t] blocks -> one dense [bt, r*E] row-block, band
+    lanes filled, every other lane exactly 0 (the branch's cover pattern,
+    so no separate cover-mask select is needed)."""
+    pieces = []
+    cursor = 0
+    for p, t, lane in _band_lanes(r, hb, Dh, E):
+        if lane > cursor:
+            pieces.append(jnp.zeros((bt, lane - cursor), dtype))
+        pieces.append(x_ref[0, 0, p, t].astype(dtype))
+        cursor = lane + Dh
+    if r * E > cursor:
+        pieces.append(jnp.zeros((bt, r * E - cursor), dtype))
+    return jnp.concatenate(pieces, axis=-1)
+
+
+def _pack_kernel(x_ref, o_ref, *, r, hb, Dh, bt):
+    """One dense row-block [bt, r*E] of the [B, S, Mp, r*E] padded view ->
+    ALL phases' [r, hb, bt, Dh] packed blocks (see _band_lanes)."""
+    _extract_bands(x_ref[0, 0], o_ref, r, hb, Dh)
 
 
 def _unpack_kernel(x_ref, o_ref, *, r, hb, Dh, bt):
     """All phases' [r, hb, bt, Dh] packed blocks -> one dense row-block
-    [bt, r*E], band lanes filled, every other lane exactly 0 (the branch's
-    cover pattern, so no separate cover-mask select is needed)."""
+    [bt, r*E] of the padded view."""
     E = o_ref.shape[-1] // r
-    W = hb * Dh
-    dtype = o_ref.dtype
-    pieces = []
-    cursor = 0
-    for p in range(r):
-        base = p * E + p * W
-        if base > cursor:
-            pieces.append(jnp.zeros((bt, base - cursor), dtype))
-        for t in range(hb):
-            pieces.append(x_ref[0, 0, p, t].astype(dtype))
-        cursor = base + W
-    if r * E > cursor:
-        pieces.append(jnp.zeros((bt, r * E - cursor), dtype))
-    o_ref[0, 0] = jnp.concatenate(pieces, axis=-1)
+    o_ref[0, 0] = _assemble_bands(x_ref, r, hb, Dh, E, bt, o_ref.dtype)
+
+
+def _pack_kernel_direct(x_ref, o_ref, *, r, hb, Dh, bt, L):
+    """Dense [bt*r, E] row-block read STRAIGHT off the [B, L, E] activation
+    -> all phases' [r, hb, bt, Dh] packed blocks, merging the XLA
+    pad+reshape re-tile pass (~40-53 us/tensor HBM round-trip, round-4
+    decomposition) into the copy kernel: the (bt*r, E) -> (bt, r*E)
+    re-tile happens in VMEM. Tail rows >= L are zeroed by LOGICAL row
+    index before the reshape — correct no matter what the clamped OOB
+    block DMA delivered (garbage may be non-finite, and packed K/V MUST
+    be exact zeros at padded slots or p=0 x NaN poisons the PV matmul);
+    full blocks skip the select. Single-segment branches only: with
+    S > 1 the per-segment padding makes dense row offsets
+    non-block-aligned."""
+    i = pl.program_id(1)
+
+    def emit(x):
+        _extract_bands(x.reshape(bt, r * x.shape[-1]), o_ref, r, hb, Dh)
+
+    @pl.when((i + 1) * bt * r <= L)
+    def _full():
+        emit(x_ref[0])
+
+    @pl.when((i + 1) * bt * r > L)
+    def _partial():
+        rows = jax.lax.broadcasted_iota(jnp.int32, (bt * r, 1), 0) + i * bt * r
+        emit(jnp.where(rows < L, x_ref[0], 0))
+
+
+def _unpack_kernel_direct(x_ref, o_ref, *, r, hb, Dh, bt):
+    """Packed [r, hb, bt, Dh] blocks -> a dense [bt*r, E] row-block written
+    straight into the [B, L, E] output. The straddling tail block's OOB
+    rows are truncated by the block DMA; blocks that would START past L
+    are excluded from the grid by the caller (clamping would otherwise
+    slide them backward over valid rows). Off-band lanes exact 0, as in
+    _unpack_kernel."""
+    E = o_ref.shape[-1]
+    o_ref[0] = _assemble_bands(
+        x_ref, r, hb, Dh, E, bt, o_ref.dtype
+    ).reshape(bt * r, E)
+
+
+def _pack_direct_enabled() -> bool:
+    from gigapath_tpu.ops.common import env_flag
+
+    return env_flag("GIGAPATH_PACK_DIRECT")
 
 
 def _pad_segments(x: jnp.ndarray, g: int, S: int, gp2: int) -> jnp.ndarray:
@@ -715,6 +777,26 @@ def _pack_phases(x: jnp.ndarray, g: int, S: int, r: int, Mp: int, H: int,
     B, L, E = x.shape
     hb = H // r
     Dh = E // H
+    if S == 1 and r > 1 and _pack_direct_enabled():
+        bt = _pack_bt(Mp, r, E, x.dtype.itemsize)
+        return pl.pallas_call(
+            functools.partial(
+                _pack_kernel_direct, r=r, hb=hb, Dh=Dh, bt=bt, L=L
+            ),
+            grid=(B, Mp // bt),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, bt * r, E), lambda b, i: (b, i, 0),
+                    memory_space=pltpu.VMEM,
+                )
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, r, hb, bt, Dh), lambda b, i: (b, 0, 0, 0, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            out_shape=jax.ShapeDtypeStruct((B, 1, r, hb, Mp, Dh), x.dtype),
+            interpret=interpret,
+        )(x)
     # [B, S, Mp, r*E]: rows are token groups of r, phases live on lanes
     xp = _pad_segments(x, g, S, Mp * r).reshape(B, S, Mp, r * E)
     bt = _pack_bt(Mp, r, E, xp.dtype.itemsize)
@@ -742,6 +824,31 @@ def _unpack_phases(p6: jnp.ndarray, L: int, E: int, g: int, S: int,
     written as exact zeros by the kernel. The [B, S, Mp, r*E] output view
     is token-major already, so no XLA transpose exists on either side."""
     B, _, _, hb, Mp, Dh = p6.shape
+    if p6.shape[1] == 1 and r > 1 and _pack_direct_enabled():
+        bt = _pack_bt(Mp, r, E, p6.dtype.itemsize)
+        # Grid covers only blocks that START inside L: Pallas block DMAs
+        # have dynamic-slice semantics — a straddling block's tail is
+        # truncated, but a block starting PAST the array end would be
+        # clamped BACKWARD and overwrite the last valid rows with padded-
+        # row garbage. ceil(L / (bt*r)) blocks cover every dense row < L
+        # (packed rows beyond nb*bt are padding with nothing to write).
+        nb = min(Mp // bt, -(-L // (bt * r)))
+        return pl.pallas_call(
+            functools.partial(_unpack_kernel_direct, r=r, hb=hb, Dh=Dh, bt=bt),
+            grid=(B, nb),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, r, hb, bt, Dh), lambda b, i: (b, 0, 0, 0, i, 0),
+                    memory_space=pltpu.VMEM,
+                )
+            ],
+            out_specs=pl.BlockSpec(
+                (1, bt * r, E), lambda b, i: (b, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            out_shape=jax.ShapeDtypeStruct((B, L, E), p6.dtype),
+            interpret=interpret,
+        )(p6)
     bt = _pack_bt(Mp, r, E, p6.dtype.itemsize)
     x = pl.pallas_call(
         functools.partial(_unpack_kernel, r=r, hb=hb, Dh=Dh, bt=bt),

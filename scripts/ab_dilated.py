@@ -30,6 +30,10 @@ def main():
         "--pipe-bk", default="512",
         help="comma list of pipelined k-block sizes (with 'pipe' variant)",
     )
+    ap.add_argument(
+        "--direct", action="store_true",
+        help="also run a GIGAPATH_PACK_DIRECT twin of each fused variant",
+    )
     args = ap.parse_args()
 
     from gigapath_tpu.models.longnet_config import flagship_geometry
@@ -55,39 +59,43 @@ def main():
     E = H * Dh
     flops = sum(4 * E * L * (-(-min(sl, L) // r)) / r for sl, r in zip(SEGS, RATIOS))
 
+    def with_env(fn, **env):
+        """Scope env flags to one variant's TRACE (flags are read at trace
+        time); prior values restored afterward."""
+
+        def wrapped(q, k, v):
+            prior = {key: os.environ.get(key) for key in env}
+            os.environ.update({k_: str(v_) for k_, v_ in env.items()})
+            try:
+                return fn(q, k, v)
+            finally:
+                for key, val in prior.items():
+                    if val is None:
+                        os.environ.pop(key, None)
+                    else:
+                        os.environ[key] = val
+
+        return wrapped
+
+    fused = lambda q, k, v: da.dilated_attention_fused(q, k, v, SEGS, RATIOS)
     variants = {}
     if "bhld" in args.variants:
         variants["bhld"] = lambda q, k, v: da.dilated_attention_bhld(
             q, k, v, SEGS, RATIOS
         )
     if "fused" in args.variants:
-        variants["fused"] = lambda q, k, v: da.dilated_attention_fused(
-            q, k, v, SEGS, RATIOS
-        )
+        variants["fused"] = fused
     if "pipe" in args.variants:
-        # software-pipelined forward kernel (env flags read at trace time,
-        # so setting them inside the traced fn scopes them to the variant)
-        def make_pipe(bk):
-            def fn(q, k, v):
-                prior = {
-                    key: os.environ.get(key)
-                    for key in ("GIGAPATH_PIPELINED_ATTN", "GIGAPATH_PIPE_BLOCK_K")
-                }
-                os.environ["GIGAPATH_PIPELINED_ATTN"] = "1"
-                os.environ["GIGAPATH_PIPE_BLOCK_K"] = str(bk)
-                try:
-                    return da.dilated_attention_fused(q, k, v, SEGS, RATIOS)
-                finally:
-                    for key, val in prior.items():
-                        if val is None:
-                            os.environ.pop(key, None)
-                        else:
-                            os.environ[key] = val
-
-            return fn
-
         for bk in (int(b) for b in args.pipe_bk.split(",") if b):
-            variants[f"pipe{bk}"] = make_pipe(bk)
+            variants[f"pipe{bk}"] = with_env(
+                fused, GIGAPATH_PIPELINED_ATTN=1, GIGAPATH_PIPE_BLOCK_K=bk
+            )
+    if args.direct:
+        # _direct twin of every fused-path variant (GIGAPATH_PACK_DIRECT:
+        # single-segment branches read/write dense [B, L, E] in-kernel)
+        for name, fn in list(variants.items()):
+            if name != "bhld":
+                variants[f"{name}_direct"] = with_env(fn, GIGAPATH_PACK_DIRECT=1)
 
     def make_step(fn):
         def step(x, k, v):
