@@ -518,6 +518,316 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvlen_ref,
         dv_ref[0, 0, 0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _dq_kernel_pipe(q_ref, k_ref, v_ref, kc_ref, do_ref, lse_ref, delta_ref,
+                    kvlen_ref, dq_ref, dq_acc, s_bufs, dp_bufs, *, scale,
+                    block_q, block_k, hb, nk):
+    """Software-pipelined dQ: grid (B, S, r, nq, hb*nk + 1).
+
+    Step n computes BOTH of cell n's matmuls that feed the VPU chain —
+    s_n = (q*scale)@k_n^T and dp_n = do@v_n^T — into parity scratches,
+    then consumes cell n-1: p = exp2(s - lse), ds = p*(dp - delta) (VPU)
+    and dq_acc += ds@k (MXU, via the LAGGED second k input kc_ref). Same
+    restructuring rationale as _fwd_kernel_pipe. Non-causal only."""
+    b, s, p = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    n = pl.program_id(4)
+    total = hb * nk
+    kv = kvlen_ref[b, s, p]
+    j_p = jax.lax.rem(n, nk)
+    t_c = jax.lax.div(n - 1, nk)
+    j_c = jax.lax.rem(n - 1, nk)
+
+    @pl.when((n < total) & (j_p * block_k < kv))
+    def _produce():
+        qh = (q_ref[0, 0, 0, 0].astype(jnp.float32) * (scale * LOG2E)).astype(
+            q_ref.dtype
+        )
+        par = jax.lax.rem(n, 2)
+        s_bufs[par] = jax.lax.dot_general(
+            qh, k_ref[0, 0, 0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp_bufs[par] = jax.lax.dot_general(
+            do_ref[0, 0, 0, 0].astype(jnp.float32),
+            v_ref[0, 0, 0, 0].astype(jnp.float32),
+            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+
+    @pl.when((n >= 1) & (j_c == 0))
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _consume(masked: bool):
+        par = jax.lax.rem(n - 1, 2)
+        s_ = s_bufs[par]
+        if masked:
+            col_ok = (
+                jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+                + j_c * block_k
+                < kv
+            )
+            s_ = jnp.where(col_ok, s_, NEG_INF)
+        pp = jnp.exp2(s_ - _lane(lse_ref[0, 0, 0], t_c, block_q) * LOG2E)
+        ds = pp * (dp_bufs[par] - _lane(delta_ref[0, 0, 0], t_c, block_q))
+        kh = kc_ref[0, 0, 0, 0]
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(kh.dtype), kh, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when((n >= 1) & ((j_c + 1) * block_k <= kv))
+    def _consume_full():
+        _consume(masked=False)
+
+    @pl.when((n >= 1) & (j_c * block_k < kv) & ((j_c + 1) * block_k > kv))
+    def _consume_partial():
+        _consume(masked=True)
+
+    @pl.when((n >= 1) & (j_c == nk - 1))
+    def _finalize():
+        dq_ref[0, 0, 0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel_pipe(q_ref, k_ref, v_ref, qc_ref, doc_ref, do_ref, lse_ref,
+                     delta_ref, kvlen_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                     s_bufs, dp_bufs, *, scale, block_q, block_k, hb, nq):
+    """Software-pipelined dK/dV: grid (B, S, r, nk, hb*nq + 1).
+
+    Per k block j, the flattened (head, q-block) steps pipeline: step n
+    produces s_n = (q*scale)@k^T and dp_n = do@v^T (MXU), consumes cell
+    n-1's p/ds (VPU) + the dv/dk accumulation matmuls against the LAGGED
+    q/do inputs (qc_ref/doc_ref). lse/delta index maps lag too. Non-causal
+    only."""
+    b, s, p = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    j = pl.program_id(3)
+    n = pl.program_id(4)
+    total = hb * nq
+    kv = kvlen_ref[b, s, p]
+    t_c = jax.lax.div(n - 1, nq)
+    i_c = jax.lax.rem(n - 1, nq)
+
+    @pl.when((n < total) & (j * block_k < kv))
+    def _produce():
+        qh = (q_ref[0, 0, 0, 0].astype(jnp.float32) * (scale * LOG2E)).astype(
+            q_ref.dtype
+        )
+        par = jax.lax.rem(n, 2)
+        s_bufs[par] = jax.lax.dot_general(
+            qh, k_ref[0, 0, 0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp_bufs[par] = jax.lax.dot_general(
+            do_ref[0, 0, 0, 0].astype(jnp.float32),
+            v_ref[0, 0, 0, 0].astype(jnp.float32),
+            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+
+    @pl.when((n >= 1) & (i_c == 0))
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _consume(masked: bool):
+        par = jax.lax.rem(n - 1, 2)
+        s_ = s_bufs[par]
+        if masked:
+            col_ok = (
+                jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+                + j * block_k
+                < kv
+            )
+            s_ = jnp.where(col_ok, s_, NEG_INF)
+        pp = jnp.exp2(s_ - _lane(lse_ref[0, 0, 0], t_c, block_q) * LOG2E)
+        do_h = doc_ref[0, 0, 0, 0].astype(jnp.float32)
+        dv_acc[:] += jax.lax.dot_general(
+            pp, do_h, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = pp * (dp_bufs[par] - _lane(delta_ref[0, 0, 0], t_c, block_q))
+        dk_acc[:] += jax.lax.dot_general(
+            ds, qc_ref[0, 0, 0, 0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when((n >= 1) & ((j + 1) * block_k <= kv))
+    def _consume_full():
+        _consume(masked=False)
+
+    @pl.when((n >= 1) & (j * block_k < kv) & ((j + 1) * block_k > kv))
+    def _consume_partial():
+        _consume(masked=True)
+
+    @pl.when((n >= 1) & (i_c == nq - 1))
+    def _finalize():
+        dk_ref[0, 0, 0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, 0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _pipe_bwd_block_k(block_q: int) -> int:
+    """k block for the pipelined backward: the parity scratches double the
+    live fp32 logits tiles (~6 at peak: s2, dp2, pp, ds), so cap
+    bq*bk <= 512k elements (~12 MB across 6 tiles)."""
+    import os
+
+    env = os.environ.get("GIGAPATH_PIPE_BWD_BLOCK_K", "")
+    if env:
+        return max(LANES, min(int(env), block_q))
+    bk = 512
+    while bk > LANES and block_q * bk > 512 * 1024:
+        bk //= 2
+    return min(bk, block_q)
+
+
+def _bwd_impl_pipe(q6, k6, v6, do6, lse, delta, kvlen, scale,
+                   heads, head_dim, block_q, block_k, interpret):
+    """Pipelined backward dispatch: same contract as _bwd_impl (non-causal).
+    k/v padded to a block_k multiple; padded blocks skipped by kvlen."""
+    B, S, r, hb, M, Dh = q6.shape
+    Mk = k6.shape[4]
+    Mkp = _round_up(Mk, block_k)
+    if Mkp != Mk:
+        pad = ((0, 0), (0, 0), (0, 0), (0, 0), (0, Mkp - Mk), (0, 0))
+        k6p = jnp.pad(k6, pad)
+        v6p = jnp.pad(v6, pad)
+    else:
+        k6p, v6p = k6, v6
+    nq, nk = M // block_q, Mkp // block_k
+    total_q = hb * nk
+
+    def t_p(n):
+        return jnp.minimum(n // nk, hb - 1)
+
+    def cell_c(n, inner):
+        tc = jnp.clip((n - 1) // inner, 0, hb - 1)
+        jc = jnp.clip(n - 1 - tc * inner, 0, inner - 1)
+        return tc, jc
+
+    # ---- dQ: grid (B, S, r, nq, hb*nk + 1) ----
+    spec_q = pl.BlockSpec(
+        (1, 1, 1, 1, block_q, head_dim),
+        lambda b, s, p, i, n: (b, s, p, t_p(n), i, 0),
+        memory_space=pltpu.VMEM,
+    )
+    spec_k_prod = pl.BlockSpec(
+        (1, 1, 1, 1, block_k, head_dim),
+        lambda b, s, p, i, n: (
+            b, s, p, t_p(n), jnp.minimum(n - t_p(n) * nk, nk - 1), 0,
+        ),
+        memory_space=pltpu.VMEM,
+    )
+
+    def kc_map(b, s, p, i, n):
+        tc, jc = cell_c(n, nk)
+        return (b, s, p, tc, jc, 0)
+
+    spec_k_cons = pl.BlockSpec(
+        (1, 1, 1, 1, block_k, head_dim), kc_map, memory_space=pltpu.VMEM,
+    )
+
+    def dq_map(b, s, p, i, n):
+        tc, _ = cell_c(n, nk)
+        return (b, s, p, tc, i, 0)
+
+    spec_dq = pl.BlockSpec(
+        (1, 1, 1, 1, block_q, head_dim), dq_map, memory_space=pltpu.VMEM,
+    )
+    vec_spec = pl.BlockSpec(
+        (1, 1, 1, block_q, LANES), lambda b, s, p, i, n: (b, s, p, i, 0),
+        memory_space=pltpu.VMEM,
+    )
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel_pipe, scale=scale,
+            block_q=block_q, block_k=block_k, hb=hb, nk=nk,
+        ),
+        grid=(B, S, r, nq, total_q + 1),
+        in_specs=[spec_q, spec_k_prod, spec_k_prod, spec_k_cons, spec_q,
+                  vec_spec, vec_spec, smem],
+        out_specs=[spec_dq],
+        out_shape=[jax.ShapeDtypeStruct(q6.shape, q6.dtype)],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+            pltpu.VMEM((2, block_q, block_k), jnp.float32),
+            pltpu.VMEM((2, block_q, block_k), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q6, k6p, v6p, k6p, do6, lse, delta, kvlen)[0]
+
+    # ---- dK/dV: grid (B, S, r, nk, hb*nq + 1) ----
+    total_kv = hb * nq
+
+    def t_p_kv(n):
+        return jnp.minimum(n // nq, hb - 1)
+
+    spec_q_prod = pl.BlockSpec(
+        (1, 1, 1, 1, block_q, head_dim),
+        lambda b, s, p, j, n: (
+            b, s, p, t_p_kv(n), jnp.minimum(n - t_p_kv(n) * nq, nq - 1), 0,
+        ),
+        memory_space=pltpu.VMEM,
+    )
+
+    def qc_map(b, s, p, j, n):
+        tc, ic = cell_c(n, nq)
+        return (b, s, p, tc, ic, 0)
+
+    spec_q_cons = pl.BlockSpec(
+        (1, 1, 1, 1, block_q, head_dim), qc_map, memory_space=pltpu.VMEM,
+    )
+    spec_k_kv = pl.BlockSpec(
+        (1, 1, 1, 1, block_k, head_dim),
+        lambda b, s, p, j, n: (b, s, p, t_p_kv(n), j, 0),
+        memory_space=pltpu.VMEM,
+    )
+
+    def dk_map(b, s, p, j, n):
+        tc, _ = cell_c(n, nq)
+        return (b, s, p, tc, j, 0)
+
+    spec_dk = pl.BlockSpec(
+        (1, 1, 1, 1, block_k, head_dim), dk_map, memory_space=pltpu.VMEM,
+    )
+
+    def vec_c_map(b, s, p, j, n):
+        _, ic = cell_c(n, nq)
+        return (b, s, p, ic, 0)
+
+    vec_spec_c = pl.BlockSpec(
+        (1, 1, 1, block_q, LANES), vec_c_map, memory_space=pltpu.VMEM,
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel_pipe, scale=scale,
+            block_q=block_q, block_k=block_k, hb=hb, nq=nq,
+        ),
+        grid=(B, S, r, nk, total_kv + 1),
+        in_specs=[spec_q_prod, spec_k_kv, spec_k_kv, spec_q_cons, spec_q_cons,
+                  spec_q_prod, vec_spec_c, vec_spec_c, smem],
+        out_specs=[spec_dk, spec_dk],
+        out_shape=[
+            jax.ShapeDtypeStruct(k6p.shape, k6.dtype),
+            jax.ShapeDtypeStruct(v6p.shape, v6.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+            pltpu.VMEM((2, block_q, block_k), jnp.float32),
+            pltpu.VMEM((2, block_q, block_k), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q6, k6p, v6p, q6, do6, do6, lse, delta, kvlen)
+    if Mkp != Mk:
+        dk = dk[:, :, :, :, :Mk]
+        dv = dv[:, :, :, :, :Mk]
+    return dq, dk, dv
+
+
+def _pipelined_bwd_enabled() -> bool:
+    from gigapath_tpu.ops.common import env_flag
+
+    return env_flag("GIGAPATH_PIPELINED_BWD")
+
+
 def _bwd_impl(q6, k6, v6, do6, lse, delta, kvlen, causal, scale,
               heads, head_dim, block_q, block_k, interpret):
     B, S, r, hb, M, Dh = q6.shape
@@ -992,10 +1302,16 @@ def _dilated_branch_bwd(sl, r, H, real_len, causal, interpret, saved, cotangents
     delta = delta.transpose(0, 1, 2, 4, 3)  # [B, S, r, Mp, hb]
     delta = jnp.pad(delta, ((0, 0),) * 4 + ((0, LANES - hb),))
     kvlen = _branch_kvlen(B, S, g, r, m, real_len, vl_dyn)
-    dq6, dk6, dv6 = _bwd_impl(
-        q6, k6, v6, do6, lse5, delta, kvlen, causal, Dh ** -0.5,
-        hb, Dh, block, block, interpret,
-    )
+    if not causal and _pipelined_bwd_enabled():
+        dq6, dk6, dv6 = _bwd_impl_pipe(
+            q6, k6, v6, do6, lse5, delta, kvlen, Dh ** -0.5,
+            hb, Dh, block, _pipe_bwd_block_k(block), interpret,
+        )
+    else:
+        dq6, dk6, dv6 = _bwd_impl(
+            q6, k6, v6, do6, lse5, delta, kvlen, causal, Dh ** -0.5,
+            hb, Dh, block, block, interpret,
+        )
 
     def undo(x6):
         # off-band lanes are exact zeros from the unpack kernel — which IS

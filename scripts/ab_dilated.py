@@ -34,6 +34,15 @@ def main():
         "--direct", action="store_true",
         help="also run a GIGAPATH_PACK_DIRECT twin of each fused variant",
     )
+    ap.add_argument(
+        "--grad", action="store_true",
+        help="measure the grad step (fwd+bwd wrt q/k/v) instead of forward",
+    )
+    ap.add_argument(
+        "--pipebwd", action="store_true",
+        help="with --grad: also run a GIGAPATH_PIPELINED_BWD twin of each "
+        "fused variant",
+    )
     args = ap.parse_args()
 
     from gigapath_tpu.models.longnet_config import flagship_geometry
@@ -58,6 +67,10 @@ def main():
     # intrinsic branch FLOPs: per branch 4 * E * L * m / r (bench.py docstring)
     E = H * Dh
     flops = sum(4 * E * L * (-(-min(sl, L) // r)) / r for sl, r in zip(SEGS, RATIOS))
+    if args.grad:
+        # grad step = fwd (2 logits-tile matmuls: s, pv) + bwd (7: dq's
+        # s/dp/dq + dkv's s/dp/dv/dk) => 4.5x the forward matmul work
+        flops *= 4.5
 
     def with_env(fn, **env):
         """Scope env flags to one variant's TRACE (flags are read at trace
@@ -96,8 +109,30 @@ def main():
         for name, fn in list(variants.items()):
             if name != "bhld":
                 variants[f"{name}_direct"] = with_env(fn, GIGAPATH_PACK_DIRECT=1)
+    if args.grad and args.pipebwd:
+        for name, fn in list(variants.items()):
+            if name != "bhld":
+                variants[f"{name}_pbwd"] = with_env(
+                    fn, GIGAPATH_PIPELINED_BWD=1
+                )
 
     def make_step(fn):
+        if args.grad:
+
+            def step(x, k, v):
+                def loss(q_, k_, v_):
+                    return fn(q_, k_, v_).astype(jnp.float32).sum()
+
+                gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(x, k, v)
+                tot = (
+                    gq.astype(jnp.float32).sum()
+                    + gk.astype(jnp.float32).sum()
+                    + gv.astype(jnp.float32).sum()
+                )
+                return x + (tot * 1e-30).astype(x.dtype)
+
+            return step
+
         def step(x, k, v):
             out = fn(x, k, v)
             return x + (out.astype(jnp.float32).sum() * 1e-30).astype(x.dtype)

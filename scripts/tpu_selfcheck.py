@@ -207,6 +207,63 @@ def main():
         check(f"fused bench-geom d{name}", g_f / scale, g_b / scale, 6e-2)
         check(f"fused bench-geom d{name} traced==static", g_t, g_f, 1e-6)
 
+    # --- round-5 env-flagged kernel variants at the bench geometry -----
+    # GIGAPATH_PIPELINED_ATTN (software-pipelined forward) and
+    # GIGAPATH_PACK_DIRECT (dense-layout pack/unpack) must compile and
+    # agree on chip BEFORE any bench/dispatch default flips to them —
+    # the BENCH_r03 lesson, applied to this round's candidates. Flags are
+    # read at trace time; a fresh function identity per combo defeats the
+    # jit cache.
+    def make_fused_loss():
+        def f(x, y, z, vl):
+            o = da.dilated_attention_fused(x, y, z, SEGS, RATIOS, valid_len=vl)
+            return (o.astype(jnp.float32) ** 2).mean()
+
+        return f
+
+    combos = [
+        ("pipe", {"GIGAPATH_PIPELINED_ATTN": "1"}, 1e-3),
+        ("direct", {"GIGAPATH_PACK_DIRECT": "1"}, 1e-6),  # bit-identical path
+        ("pipebwd", {"GIGAPATH_PIPELINED_BWD": "1"}, 1e-6),  # fwd unchanged
+        (
+            "all",
+            {
+                "GIGAPATH_PIPELINED_ATTN": "1",
+                "GIGAPATH_PACK_DIRECT": "1",
+                "GIGAPATH_PIPELINED_BWD": "1",
+            },
+            1e-3,
+        ),
+    ]
+    for tag, env, tol in combos:
+        prior = {key: os.environ.get(key) for key in env}
+        os.environ.update(env)
+        try:
+            vg = jax.jit(
+                jax.value_and_grad(make_fused_loss(), argnums=(0, 1, 2)),
+                static_argnums=3,
+            )
+            loss_v, grads_v = vg(qb, kb, vb, N_BENCH - 64)
+            # traced valid_len (the fine-tune train path) on the same combo
+            loss_tv, _ = jax.jit(
+                jax.value_and_grad(make_fused_loss(), argnums=(0, 1, 2))
+            )(qb, kb, vb, jnp.asarray([N_BENCH - 64], jnp.int32))
+        finally:
+            for key, val in prior.items():
+                if val is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = val
+        check(f"flagged[{tag}] bench-geom fwd", loss_v, loss_f, tol)
+        check(f"flagged[{tag}] traced vl == static", loss_tv, loss_v, 1e-6)
+        for name, g_v, g_f2 in zip("qkv", grads_v, grads_f):
+            g_v, g_f2 = (x.astype(jnp.float32) for x in (g_v, g_f2))
+            scale = max(float(jnp.abs(g_f2).max()), 1e-12)
+            check(
+                f"flagged[{tag}] d{name}", g_v / scale, g_f2 / scale,
+                1e-6 if tag == "direct" else 1e-2,
+            )
+
     if FAILED:
         print("FAILED:", FAILED)
         sys.exit(1)

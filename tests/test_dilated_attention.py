@@ -647,3 +647,42 @@ def test_pack_direct_fully_oob_tail_block(rng, monkeypatch):
     monkeypatch.setenv("GIGAPATH_PACK_DIRECT", "1")
     o1, _ = dilated_branch_attention(q, k, v, sl, r, H, interpret=True)
     np.testing.assert_array_equal(np.asarray(o1), np.asarray(o0))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "L,sl,r,rl",
+    [
+        (300, 64, 2, 277),      # multi-segment, phases, ragged tail
+        (1280, 1280, 1, 1280),  # bwd pipe block_k 512 -> nk=3
+        (1280, 1280, 2, 1100),
+    ],
+)
+def test_pipelined_bwd_matches_serial(rng, monkeypatch, L, sl, r, rl):
+    """GIGAPATH_PIPELINED_BWD gradients == the serial backward kernels to
+    fp32 rounding (the pipelined kernels fold scale*log2(e) into q before
+    the logits matmul, as the forward does, instead of scaling the
+    [bq, bk] tile)."""
+    from gigapath_tpu.ops.pallas_dilated import dilated_branch_attention
+
+    H, Dh = 8, 16
+    E = H * Dh
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(2, L, E)), jnp.float32) for _ in range(3)
+    )
+
+    def loss(q_, k_, v_):
+        o, _ = dilated_branch_attention(
+            q_, k_, v_, sl, r, H, real_len=rl, interpret=True
+        )
+        return (o * o).sum()
+
+    monkeypatch.delenv("GIGAPATH_PIPELINED_BWD", raising=False)
+    g0 = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.setenv("GIGAPATH_PIPELINED_BWD", "1")
+    g1 = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g0):
+        scale = max(float(jnp.max(jnp.abs(np.asarray(b)))), 1e-12)
+        np.testing.assert_allclose(
+            np.asarray(a) / scale, np.asarray(b) / scale, atol=2e-6
+        )
