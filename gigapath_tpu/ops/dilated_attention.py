@@ -736,7 +736,10 @@ def dilated_attention(
     ``attn_fn(q, k, v, is_causal=...) -> (out, lse)`` defaults to the fused
     jnp op; pass the Pallas flash kernel for long dense segments. When
     ``seq_axis_name`` is set (inside ``shard_map``), L is the *local* shard
-    length and branches whose segment exceeds it gather K/V across the axis.
+    length and branches whose segment exceeds it gather K/V across the axis;
+    fully-local branches route through the fused phase-major kernels on TPU.
+    shard_map callers must pass ``check_vma=False`` when the Pallas tier is
+    active (jax 0.9's vma checking cannot see through ``pallas_call``).
     ``dropout_rate`` is attention-probability dropout inside each branch
     (parity with the reference forwarding dropout to flash-attn).
 
@@ -781,27 +784,35 @@ def dilated_attention(
         )
     B, L, H, Dh = q.shape
 
-    # Head-major fast path (TPU): see dilated_attention_bhld. Taken whenever
-    # nothing forces the generic layout — no custom attn_fn, no dropout, no
-    # sequence parallelism, no decoding offset. Both static AND traced
-    # valid_len ride this path (traced counts live in the kernels' SMEM
-    # tables) — routing traced masks to the generic jnp tier previously
-    # put the ENTIRE fine-tune train path on dense-probability attention
-    # (53 GB at the 16k bucket).
-    if (
+    # ONE eligibility gate for the compiled-kernel paths (the single-device
+    # fast path below and the seq-parallel fused-local routing further
+    # down): no custom attn_fn, no dropout, no decoding offset, self-
+    # attention shapes. Kept in one place so single-device and sharded
+    # dispatch can never silently diverge.
+    kernels_eligible = (
         attn_fn_was_default
         and not (dropout_rate > 0.0 and dropout_rng is not None)
-        and (seq_axis_name is None or seq_axis_size <= 1)
         and offset == 0
         and q.shape == k.shape == v.shape
-    ):
-        from gigapath_tpu.ops.flash_attention import _on_tpu
+    )
 
+    def _tpu_default_dispatch() -> bool:
         # escape hatch: GIGAPATH_FORCE_GENERIC_ATTN=1 re-routes the default
         # TPU dispatch to the generic jnp path (compiled-kernel triage aid;
         # the compiled kernels are otherwise validated by
         # scripts/tpu_selfcheck.py rather than the CPU/interpret CI tier)
-        if _on_tpu() and not _env_flag("GIGAPATH_FORCE_GENERIC_ATTN"):
+        from gigapath_tpu.ops.flash_attention import _on_tpu
+
+        return _on_tpu() and not _env_flag("GIGAPATH_FORCE_GENERIC_ATTN")
+
+    # Head-major fast path (TPU): see dilated_attention_bhld. Taken whenever
+    # nothing forces the generic layout and there is no sequence
+    # parallelism. Both static AND traced valid_len ride this path (traced
+    # counts live in the kernels' SMEM tables) — routing traced masks to
+    # the generic jnp tier previously put the ENTIRE fine-tune train path
+    # on dense-probability attention (53 GB at the 16k bucket).
+    if kernels_eligible and (seq_axis_name is None or seq_axis_size <= 1):
+        if _tpu_default_dispatch():
             # Phase-major fused path (pallas_dilated.py) is the default
             # since round 4's kernel-side packing landed: activations stay
             # [B, L, E], per-branch pack/unpack are single-pass Pallas copy
@@ -840,13 +851,64 @@ def dilated_attention(
                 streaming_fusion=streaming,
             )
 
+    # Under sequence parallelism, branches whose segment fits the local
+    # shard need no gather and are, per shard, exactly a single-device
+    # branch — route them through the fused phase-major kernels (the
+    # single-chip default path, measured 5.19 vs 6.69 ms fwd head-major at
+    # N=10241) instead of the head-major generic loop. Gathered branches
+    # and every non-default case keep the generic path.
+    def _vma_transparent() -> bool:
+        # jax 0.9's vma checking cannot see through pallas_call: under a
+        # shard_map with the default check_vma=True the traced avals carry
+        # a non-empty vma and the kernel call would fail at trace time.
+        # Auto-fall-back to the generic path there (warning once) instead
+        # of hard-breaking existing callers; check_vma=False unlocks the
+        # fused routing.
+        vma = getattr(jax.typeof(q), "vma", frozenset())
+        if vma:
+            _warn_once(
+                "sequence-parallel dilated attention inside a "
+                "check_vma=True shard_map: pallas kernels are vma-opaque "
+                "in jax 0.9, so local branches fall back to the generic "
+                "path — pass check_vma=False to shard_map to enable the "
+                "fused kernels"
+            )
+            return False
+        return True
+
+    fused_local = (
+        kernels_eligible
+        and seq_axis_name is not None
+        and seq_axis_size > 1
+        and valid_len is None
+        and _tpu_default_dispatch()
+        and _vma_transparent()
+    )
+
     outs, lses = [], []
     for i, (sl, r) in enumerate(zip(segment_lengths, dilated_ratios)):
+        sl_i, r_i = int(sl), int(r)
+        if (
+            fused_local
+            and sl_i <= k.shape[1]
+            and H % r_i == 0
+            and (H * Dh) % r_i == 0
+        ):
+            from gigapath_tpu.ops.pallas_dilated import dilated_branch_attention
+
+            oE, l = dilated_branch_attention(
+                q.reshape(B, L, H * Dh), k.reshape(B, L, H * Dh),
+                v.reshape(B, L, H * Dh), sl_i, r_i, H,
+                real_len=L, is_causal=is_causal,
+            )
+            outs.append(oE.reshape(B, L, H, Dh))
+            lses.append(l)
+            continue
         branch_fn = attn_fn
         if dropout_rate > 0.0 and dropout_rng is not None:
             branch_fn = make_attn_fn(rngs[i])
         o, l = _dilated_branch(
-            q, k, v, int(sl), int(r),
+            q, k, v, sl_i, r_i,
             is_causal=is_causal, offset=offset, attn_fn=branch_fn,
             seq_axis_name=seq_axis_name, seq_axis_size=seq_axis_size,
             valid_len=valid_len,

@@ -104,6 +104,9 @@ def run_sharded(n: int, n_devices: int = 8) -> dict:
         mesh=mesh,
         in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
         out_specs=P(None, "seq"),
+        # required whenever the Pallas tier runs inside this region (TPU):
+        # jax 0.9 vma checking cannot see through pallas_call
+        check_vma=False,
     )
     t0 = time.perf_counter()
     out = jax.block_until_ready(jax.jit(fn)(q, k, v))

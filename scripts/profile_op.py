@@ -19,21 +19,41 @@ import numpy as np
 
 
 def main():
+    import argparse
+
     from gigapath_tpu.models.longnet_config import flagship_geometry
     from gigapath_tpu.ops import dilated_attention as da
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--variant", default="bhld", choices=["bhld", "fused"])
+    ap.add_argument(
+        "--flags", default="",
+        help="comma list of GIGAPATH_* env flags set for the trace, e.g. "
+        "PIPELINED_ATTN,PACK_DIRECT,PIPELINED_BWD",
+    )
+    ap.add_argument("--n", type=int, default=10241)
+    args = ap.parse_args()
+    for flag in args.flags.split(","):
+        if flag:
+            os.environ[f"GIGAPATH_{flag.strip()}"] = "1"
 
     G = flagship_geometry()
     H, Dh = G["heads"], G["head_dim"]
     SEGS, RATIOS = G["segment_lengths"], G["dilated_ratios"]
-    L = 10241
+    L = args.n
     rng = np.random.default_rng(0)
     q, k, v = (
         jnp.asarray(rng.normal(size=(1, L, H, Dh)), jnp.bfloat16) for _ in range(3)
     )
+    op = (
+        da.dilated_attention_fused
+        if args.variant == "fused"
+        else da.dilated_attention_bhld
+    )
 
     @jax.jit
     def step(x, k, v):
-        out = da.dilated_attention_bhld(x, k, v, SEGS, RATIOS)
+        out = op(x, k, v, SEGS, RATIOS)
         return x + (out.astype(jnp.float32).sum() * 1e-30).astype(x.dtype)
 
     x = step(q, k, v)

@@ -1,0 +1,42 @@
+#!/bin/bash
+# Round-5 on-chip measurement checklist, in priority order. Each step is
+# timeout-bounded and logs to /tmp/r5_*.log; artifacts land in the repo.
+# Run when the axon tunnel is up:  bash scripts/round5_measure.sh
+set -x
+cd "$(dirname "$0")/.."
+
+# 1. headline bench -> BENCH_LOCAL.json (the round's survivable record)
+timeout 1800 python bench.py 2>/tmp/r5_bench.err | tee /tmp/r5_bench.log
+
+# 2. gate the new kernels at the bench geometry
+timeout 2400 python scripts/tpu_selfcheck.py > /tmp/r5_selfcheck.log 2>&1
+tail -5 /tmp/r5_selfcheck.log
+
+# 3. forward A/B: serial vs pipelined (block_k sweep) vs pack-direct
+timeout 1800 python scripts/ab_dilated.py --variants fused,pipe \
+  --pipe-bk 512,640,896 --direct > /tmp/r5_ab_fwd.log 2>&1
+tail -12 /tmp/r5_ab_fwd.log
+
+# 4. grad-step A/B incl. pipelined backward
+timeout 1800 python scripts/ab_dilated.py --variants fused,pipe \
+  --pipe-bk 512 --direct --grad --pipebwd > /tmp/r5_ab_grad.log 2>&1
+tail -12 /tmp/r5_ab_grad.log
+
+# 5. per-shard 1M-token slice -> SEQ_SHARD.json
+timeout 2400 python scripts/seq_shard_slice.py --out SEQ_SHARD.json \
+  > /tmp/r5_seqshard.log 2>&1
+tail -2 /tmp/r5_seqshard.log
+
+# 6. long-context envelope with fused streaming (393k / 524k rows)
+GIGAPATH_STREAMING_FUSION=1 timeout 2400 python scripts/long_context_smoke.py \
+  393216 524288 > /tmp/r5_envelope.log 2>&1
+tail -4 /tmp/r5_envelope.log
+
+# 7. PANDA-subset regen (current harness + bare-step ratio) -> PANDA_SUBSET.json
+timeout 3600 python scripts/panda_subset_bench.py > /tmp/r5_panda.log 2>&1
+tail -3 /tmp/r5_panda.log
+
+# 8. wall vs op-time reconciliation -> RECONCILE.json
+timeout 1200 python scripts/reconcile_walltime.py --out RECONCILE.json \
+  > /tmp/r5_reconcile.log 2>&1
+tail -2 /tmp/r5_reconcile.log

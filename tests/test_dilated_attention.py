@@ -686,3 +686,170 @@ def test_pipelined_bwd_matches_serial(rng, monkeypatch, L, sl, r, rl):
         np.testing.assert_allclose(
             np.asarray(a) / scale, np.asarray(b) / scale, atol=2e-6
         )
+
+
+@pytest.mark.slow
+def test_seq_parallel_local_branches_use_fused_path(rng, monkeypatch):
+    """Under sequence parallelism, branches whose segment fits the local
+    shard route through the fused phase-major kernels (the single-chip
+    default) and still match the single-device result. _on_tpu is
+    monkeypatched True with interpret-mode kernels so the TPU-only
+    dispatch runs on the CPU mesh."""
+    import functools
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import gigapath_tpu.ops.flash_attention as fa
+    import gigapath_tpu.ops.pallas_dilated as pdm
+    from gigapath_tpu.ops import dilated_attention as da
+
+    monkeypatch.setattr(fa, "_on_tpu", lambda: True)
+    real = pdm.dilated_branch_attention
+    routed = []
+
+    def spy(q, k, v, sl, r, H, **kw):
+        routed.append((sl, r, kw.get("real_len")))
+        kw["interpret"] = True
+        return real(q, k, v, sl, r, H, **kw)
+
+    monkeypatch.setattr(pdm, "dilated_branch_attention", spy)
+
+    n_dev = 8
+    B, L, H, Dh = 1, 1024, 4, 8
+    sls, drs = [32, 128], [1, 2]
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(B, L, H, Dh)), jnp.float32)
+        for _ in range(3)
+    )
+    single = da.dilated_attention(q, k, v, sls, drs)
+    assert routed, "single-device fast path should also route via the spy"
+    routed.clear()
+
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("seq",))
+    fn = jax.shard_map(
+        functools.partial(
+            da.dilated_attention, segment_lengths=sls, dilated_ratios=drs,
+            seq_axis_name="seq", seq_axis_size=n_dev,
+        ),
+        mesh=mesh,
+        in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+        out_specs=P(None, "seq"),
+        # jax 0.9's vma checking cannot yet see through pallas_call
+        # (out_shape avals carry no vma); jax's own guidance is
+        # check_vma=False for shard_map regions hosting pallas kernels
+        check_vma=False,
+    )
+    sharded = fn(q, k, v)
+    assert len(routed) == len(sls), (
+        f"both local branches should take the fused path, got {routed}"
+    )
+    assert all(rl == L // n_dev for _, _, rl in routed)
+    np.testing.assert_allclose(
+        np.asarray(sharded), np.asarray(single), atol=2e-5, rtol=1e-4
+    )
+
+
+@pytest.mark.slow
+def test_seq_parallel_mixed_fused_and_gathered_branches(rng, monkeypatch):
+    """One cross-branch softmax fusion mixing a fused-kernel local branch
+    (Pallas lse convention) with a gathered branch computed by the generic
+    path (sparse_to_dense lse) must match the single-device result — the
+    two lse conventions may never drift apart. The gathered branch's
+    sparse length stays under PALLAS_MIN_SEQ so it runs the jnp tier even
+    with _on_tpu patched True."""
+    import functools
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import gigapath_tpu.ops.flash_attention as fa
+    import gigapath_tpu.ops.pallas_dilated as pdm
+    from gigapath_tpu.ops import dilated_attention as da
+
+    monkeypatch.setattr(fa, "_on_tpu", lambda: True)
+    real = pdm.dilated_branch_attention
+    routed = []
+
+    def spy(q, k, v, sl, r, H, **kw):
+        routed.append(sl)
+        kw["interpret"] = True
+        return real(q, k, v, sl, r, H, **kw)
+
+    monkeypatch.setattr(pdm, "dilated_branch_attention", spy)
+
+    n_dev = 8
+    B, L, H, Dh = 1, 1024, 4, 8
+    sls, drs = [32, 512], [1, 2]  # 512 > local 128 -> gathered, m=256 jnp tier
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(B, L, H, Dh)), jnp.float32)
+        for _ in range(3)
+    )
+    single = da.dilated_attention(q, k, v, sls, drs)
+    routed.clear()
+
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("seq",))
+    fn = jax.shard_map(
+        functools.partial(
+            da.dilated_attention, segment_lengths=sls, dilated_ratios=drs,
+            seq_axis_name="seq", seq_axis_size=n_dev,
+        ),
+        mesh=mesh,
+        in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+        out_specs=P(None, "seq"),
+        check_vma=False,
+    )
+    sharded = fn(q, k, v)
+    assert routed == [32], f"only the local branch routes fused, got {routed}"
+    np.testing.assert_allclose(
+        np.asarray(sharded), np.asarray(single), atol=2e-5, rtol=1e-4
+    )
+
+
+@pytest.mark.slow
+def test_seq_parallel_vma_checked_falls_back_generic(rng, monkeypatch):
+    """Inside a DEFAULT (check_vma=True) shard_map the fused-local routing
+    must auto-fall-back to the generic path (pallas is vma-opaque in
+    jax 0.9) instead of hard-failing existing callers."""
+    import functools
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import gigapath_tpu.ops.flash_attention as fa
+    import gigapath_tpu.ops.pallas_dilated as pdm
+    from gigapath_tpu.ops import dilated_attention as da
+
+    monkeypatch.setattr(fa, "_on_tpu", lambda: True)
+    real = pdm.dilated_branch_attention
+
+    def interp(q, k, v, sl, r, H, **kw):
+        kw["interpret"] = True
+        return real(q, k, v, sl, r, H, **kw)
+
+    monkeypatch.setattr(pdm, "dilated_branch_attention", interp)
+
+    n_dev = 8
+    B, L, H, Dh = 1, 512, 4, 8
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(B, L, H, Dh)), jnp.float32)
+        for _ in range(3)
+    )
+    single = da.dilated_attention(q, k, v, [32], [1])
+
+    def boom(*a, **kw):
+        raise AssertionError("fused path must not run under check_vma=True")
+
+    monkeypatch.setattr(pdm, "dilated_branch_attention", boom)
+
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("seq",))
+    fn = jax.shard_map(
+        functools.partial(
+            da.dilated_attention, segment_lengths=[32], dilated_ratios=[1],
+            seq_axis_name="seq", seq_axis_size=n_dev,
+        ),
+        mesh=mesh,
+        in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+        out_specs=P(None, "seq"),
+    )
+    sharded = fn(q, k, v)  # must not raise
+    np.testing.assert_allclose(
+        np.asarray(sharded), np.asarray(single), atol=2e-5, rtol=1e-4
+    )
