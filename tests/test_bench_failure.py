@@ -1,0 +1,100 @@
+"""bench.py's failure-degradation contract (the BENCH_r03/r04 lesson).
+
+Two consecutive rounds lost their driver-verified perf record to single
+unguarded backend-init failures; round 5 saw the third failure mode — an
+indefinite HANG inside jax.devices(). These tests pin the hardened
+behavior: bounded hang-proof probes, exit 0 with exactly one contractual
+JSON line on stdout, and stale-snapshot degradation.
+"""
+
+import io
+import json
+import os
+import sys
+import unittest.mock as mock
+
+import pytest
+
+import bench
+
+
+@pytest.fixture
+def no_snapshot(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "LOCAL_SNAPSHOT", str(tmp_path / "BENCH_LOCAL.json"))
+    return tmp_path
+
+
+def _run_main_failing(capsys):
+    with mock.patch.object(
+        bench, "_probe_backend_subprocess", return_value=(False, "probe hung")
+    ), mock.patch.object(bench.time, "sleep"):
+        bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, f"stdout must be exactly one JSON line, got {out}"
+    return json.loads(out[0])
+
+
+def test_probe_timeout_is_bounded():
+    """A hung backend init must be killed by the subprocess timeout, not
+    block forever (the round-5 tunnel failure mode)."""
+    import subprocess
+
+    def hang(cmd, capture_output, text, timeout):
+        raise subprocess.TimeoutExpired(cmd, timeout)
+
+    with mock.patch("subprocess.run", hang):
+        ok, msg = bench._probe_backend_subprocess(1.0)
+    assert not ok
+    assert "hung" in msg
+
+
+def test_acquire_backend_raises_after_bounded_attempts():
+    calls = []
+    with mock.patch.object(
+        bench, "_probe_backend_subprocess",
+        side_effect=lambda t: calls.append(t) or (False, "down"),
+    ):
+        with pytest.raises(RuntimeError, match="backend unavailable"):
+            bench.acquire_backend(attempts=3, delays=(0,), probe_timeout=1.0)
+    assert len(calls) == 3
+
+
+def test_failure_emits_contractual_json_without_snapshot(no_snapshot, capsys):
+    payload = _run_main_failing(capsys)
+    assert payload["metric"] == "slide_embed_tokens_per_sec"
+    assert payload["value"] is None
+    assert payload["unit"] == "tokens/s"
+    assert "error" in payload
+    assert "stale" not in payload
+
+
+def test_failure_merges_stale_snapshot(no_snapshot, capsys):
+    snap = {
+        "metric": "slide_embed_tokens_per_sec",
+        "value": 138400.0,
+        "unit": "tokens/s",
+        "vs_baseline": 0.373,
+        "snapshot_utc": "2026-07-30T23:00:00Z",
+    }
+    with open(bench.LOCAL_SNAPSHOT, "w") as f:
+        json.dump(snap, f)
+    payload = _run_main_failing(capsys)
+    assert payload["value"] == 138400.0
+    assert payload["vs_baseline"] == 0.373
+    assert payload["stale"] is True
+    assert "error" in payload
+
+
+def test_success_memoizes_backend(monkeypatch):
+    """After one successful acquire, later calls (chip_peak_flops) must not
+    spawn further subprocess probes — a second probe is one extra roll of
+    the flaky-tunnel dice per bench run."""
+    monkeypatch.setattr(bench, "_BACKEND_READY", False)
+    probes = []
+    with mock.patch.object(
+        bench, "_probe_backend_subprocess",
+        side_effect=lambda t: probes.append(t) or (True, "cpu"),
+    ):
+        bench.acquire_backend(probe_timeout=1.0)
+        bench.acquire_backend(probe_timeout=1.0)
+    assert len(probes) == 1
