@@ -656,6 +656,8 @@ def test_pack_direct_fully_oob_tail_block(rng, monkeypatch):
         (300, 64, 2, 277),      # multi-segment, phases, ragged tail
         (1280, 1280, 1, 1280),  # bwd pipe block_k 512 -> nk=3
         (1280, 1280, 2, 1100),
+        (300, 64, 2, "traced"),  # TRACED per-batch valid lengths (the
+        #                          collate pad-mask mode of the train path)
     ],
 )
 def test_pipelined_bwd_matches_serial(rng, monkeypatch, L, sl, r, rl):
@@ -670,10 +672,15 @@ def test_pipelined_bwd_matches_serial(rng, monkeypatch, L, sl, r, rl):
     q, k, v = (
         jnp.asarray(rng.normal(size=(2, L, E)), jnp.float32) for _ in range(3)
     )
+    mask_kw = (
+        {"valid_len_dyn": jnp.asarray([L, 211], jnp.int32)}
+        if rl == "traced"
+        else {"real_len": rl}
+    )
 
     def loss(q_, k_, v_):
         o, _ = dilated_branch_attention(
-            q_, k_, v_, sl, r, H, real_len=rl, interpret=True
+            q_, k_, v_, sl, r, H, interpret=True, **mask_kw
         )
         return (o * o).sum()
 
@@ -853,3 +860,5 @@ def test_seq_parallel_vma_checked_falls_back_generic(rng, monkeypatch):
     np.testing.assert_allclose(
         np.asarray(sharded), np.asarray(single), atol=2e-5, rtol=1e-4
     )
+
+
