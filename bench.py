@@ -334,10 +334,14 @@ def main():
 
     On success the payload is also snapshotted to BENCH_LOCAL.json. On
     failure (after acquire_backend's bounded retries) the JSON line still
-    honors the contract: metric/value/unit are taken from the last local
-    snapshot if one exists (marked ``"stale": true`` with its timestamp),
-    plus an ``"error"`` field — so a transient round-end tunnel outage
-    degrades the record to "stale number", not "no number".
+    honors the contract — but ``"value"`` stays ``null``: an unmeasured
+    round must never be recordable as a fresh number (the round-5 advisor
+    finding: consumers that don't check ``"stale"`` would republish the
+    old snapshot as this round's result). The last successful snapshot is
+    reported only under ``"last_good"`` / ``"last_good_value"``, with
+    ``"stale": true`` and the ``"error"``, so the record degrades to
+    "here is the last measured number, clearly labeled" — never to
+    "unmeasured number that looks fresh".
     """
     try:
         payload = run_bench()
@@ -355,10 +359,14 @@ def main():
             try:
                 with open(LOCAL_SNAPSHOT) as f:
                     snap = json.load(f)
+                # only trust snapshots this script wrote on SUCCESS: a
+                # success snapshot always has a measured numeric value
                 snap.pop("error", None)
-                payload.update(snap)
-                payload["error"] = f"{type(e).__name__}: {e}"
+                snap.pop("stale", None)
                 payload["stale"] = True
+                payload["last_good"] = snap
+                payload["last_good_value"] = snap.get("value")
+                payload["last_good_snapshot_utc"] = snap.get("snapshot_utc")
             except Exception as snap_err:
                 print(f"bench: snapshot unreadable: {snap_err}", file=sys.stderr)
         print(json.dumps(payload))

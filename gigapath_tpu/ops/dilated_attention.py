@@ -863,8 +863,13 @@ def dilated_attention(
         # a non-empty vma and the kernel call would fail at trace time.
         # Auto-fall-back to the generic path there (warning once) instead
         # of hard-breaking existing callers; check_vma=False unlocks the
-        # fused routing.
-        vma = getattr(jax.typeof(q), "vma", frozenset())
+        # fused routing. jax 0.4.x has neither jax.typeof nor vma (its
+        # shard_map uses check_rep, which pallas already satisfies) — the
+        # fused routing is unconditionally available there.
+        typeof = getattr(jax, "typeof", None)
+        vma = (
+            getattr(typeof(q), "vma", frozenset()) if typeof else frozenset()
+        )
         if vma:
             _warn_once(
                 "sequence-parallel dilated attention inside a "

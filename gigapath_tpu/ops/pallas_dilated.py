@@ -44,7 +44,7 @@ fully-masked key blocks skipped.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -661,15 +661,15 @@ def _dkv_kernel_pipe(q_ref, k_ref, v_ref, qc_ref, doc_ref, do_ref, lse_ref,
         dv_ref[0, 0, 0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _pipe_bwd_block_k(block_q: int) -> int:
+def _pipe_bwd_block_k(block_q: int, override: Optional[int]) -> int:
     """k block for the pipelined backward: the parity scratches double the
     live fp32 logits tiles (~6 at peak: s2, dp2, pp, ds), so cap
-    bq*bk <= 512k elements (~12 MB across 6 tiles)."""
-    import os
-
-    env = os.environ.get("GIGAPATH_PIPE_BWD_BLOCK_K", "")
-    if env:
-        return max(LANES, min(int(env), block_q))
+    bq*bk <= 512k elements (~12 MB across 6 tiles). ``override`` comes
+    from the PipelineFlags snapshot (GIGAPATH_PIPE_BWD_BLOCK_K), read
+    once at dispatch — never from the environment here, where the value
+    would be baked into the jit cache invisibly (gigalint GL001)."""
+    if override:
+        return max(LANES, min(override, block_q))
     bk = 512
     while bk > LANES and block_q * bk > 512 * 1024:
         bk //= 2
@@ -822,10 +822,43 @@ def _bwd_impl_pipe(q6, k6, v6, do6, lse, delta, kvlen, scale,
     return dq, dk, dv
 
 
-def _pipelined_bwd_enabled() -> bool:
+class PipelineFlags(NamedTuple):
+    """One trace-stable snapshot of the kernel-dispatch env flags.
+
+    Read ONCE per public ``dilated_branch_attention`` call (host side, at
+    dispatch) and threaded through the custom_vjp as a static argument, so
+    the forward and backward of one call can never observe different flag
+    values, and no traced code reads the environment (gigalint GL001).
+    Toggling a flag still only affects future traces — the jit cache keys
+    on the traced program, not the environment; see the README flag table
+    for the fresh-function-identity workaround.
+    """
+
+    pipelined_fwd: bool = False
+    pipelined_bwd: bool = False
+    pipe_block_k: Optional[int] = None  # None: VMEM-budget auto choice
+    pipe_bwd_block_k: Optional[int] = None
+    pack_direct: bool = False
+
+
+def snapshot_flags() -> PipelineFlags:
+    """Read GIGAPATH_PIPELINED_ATTN/_BWD, GIGAPATH_PIPE(_BWD)_BLOCK_K and
+    GIGAPATH_PACK_DIRECT from the environment, once."""
+    import os
+
     from gigapath_tpu.ops.common import env_flag
 
-    return env_flag("GIGAPATH_PIPELINED_BWD")
+    def _int(name: str) -> Optional[int]:
+        raw = os.environ.get(name, "").strip()
+        return int(raw) if raw else None
+
+    return PipelineFlags(
+        pipelined_fwd=env_flag("GIGAPATH_PIPELINED_ATTN"),
+        pipelined_bwd=env_flag("GIGAPATH_PIPELINED_BWD"),
+        pipe_block_k=_int("GIGAPATH_PIPE_BLOCK_K"),
+        pipe_bwd_block_k=_int("GIGAPATH_PIPE_BWD_BLOCK_K"),
+        pack_direct=env_flag("GIGAPATH_PACK_DIRECT"),
+    )
 
 
 def _bwd_impl(q6, k6, v6, do6, lse, delta, kvlen, causal, scale,
@@ -1060,12 +1093,6 @@ def _unpack_kernel_direct(x_ref, o_ref, *, r, hb, Dh, bt):
     ).reshape(bt * r, E)
 
 
-def _pack_direct_enabled() -> bool:
-    from gigapath_tpu.ops.common import env_flag
-
-    return env_flag("GIGAPATH_PACK_DIRECT")
-
-
 def _pad_segments(x: jnp.ndarray, g: int, S: int, gp2: int) -> jnp.ndarray:
     """[B, L, E] -> [B, S, gp2, E] (zero pads on the clean E-lane layout)."""
     B, L, E = x.shape
@@ -1078,7 +1105,7 @@ def _pad_segments(x: jnp.ndarray, g: int, S: int, gp2: int) -> jnp.ndarray:
 
 
 def _pack_phases(x: jnp.ndarray, g: int, S: int, r: int, Mp: int, H: int,
-                 interpret: bool) -> jnp.ndarray:
+                 interpret: bool, pack_direct: bool = False) -> jnp.ndarray:
     """[B, L, E] -> packed [B, S, r, hb, Mp, Dh] holding ONLY the diagonal
     (phase == band) data — 1/r of the dense volume. The old 7-D layout
     materialized all r^2 (phase, band) blocks and transposed the full
@@ -1087,7 +1114,7 @@ def _pack_phases(x: jnp.ndarray, g: int, S: int, r: int, Mp: int, H: int,
     B, L, E = x.shape
     hb = H // r
     Dh = E // H
-    if S == 1 and r > 1 and _pack_direct_enabled():
+    if S == 1 and r > 1 and pack_direct:
         bt = _pack_bt(Mp, r, E, x.dtype.itemsize)
         return pl.pallas_call(
             functools.partial(
@@ -1129,12 +1156,13 @@ def _pack_phases(x: jnp.ndarray, g: int, S: int, r: int, Mp: int, H: int,
 
 
 def _unpack_phases(p6: jnp.ndarray, L: int, E: int, g: int, S: int,
-                   r: int, interpret: bool) -> jnp.ndarray:
+                   r: int, interpret: bool,
+                   pack_direct: bool = False) -> jnp.ndarray:
     """Packed [B, S, r, hb, Mp, Dh] -> dense [B, L, E]; off-band lanes are
     written as exact zeros by the kernel. The [B, S, Mp, r*E] output view
     is token-major already, so no XLA transpose exists on either side."""
     B, _, _, hb, Mp, Dh = p6.shape
-    if p6.shape[1] == 1 and r > 1 and _pack_direct_enabled():
+    if p6.shape[1] == 1 and r > 1 and pack_direct:
         bt = _pack_bt(Mp, r, E, p6.dtype.itemsize)
         # Grid covers only blocks that START inside L: Pallas block DMAs
         # have dynamic-slice semantics — a straddling block's tail is
@@ -1223,44 +1251,38 @@ def _branch_kvlen(B, S, g, r, m, real_len, vl_dyn):
     return jnp.minimum(static, counts.transpose(0, 2, 1))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
-def _dilated_branch(q, k, v, vl_dyn, sl, r, H, real_len, causal, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _dilated_branch(q, k, v, vl_dyn, sl, r, H, real_len, causal, interpret,
+                    flags):
     out, lse, _res = _dilated_branch_fwd_impl(
-        q, k, v, vl_dyn, sl, r, H, real_len, causal, interpret
+        q, k, v, vl_dyn, sl, r, H, real_len, causal, interpret, flags
     )
     return out, lse
 
 
-def _pipe_block_k(block_q: int) -> int:
-    """k-block for the pipelined forward: GIGAPATH_PIPE_BLOCK_K or a
-    default that keeps the two parity logits tiles + the exp2 temp inside
-    the scoped-VMEM envelope at any legal block_q (<= 1408)."""
-    import os
-
-    env = os.environ.get("GIGAPATH_PIPE_BLOCK_K", "")
-    bk = int(env) if env else 512
+def _pipe_block_k(block_q: int, override: Optional[int]) -> int:
+    """k-block for the pipelined forward: the PipelineFlags override
+    (GIGAPATH_PIPE_BLOCK_K, snapshotted at dispatch) or a default that
+    keeps the two parity logits tiles + the exp2 temp inside the
+    scoped-VMEM envelope at any legal block_q (<= 1408)."""
+    bk = override if override else 512
     return max(LANES, min(bk, block_q))
 
 
-def _pipelined_fwd_enabled() -> bool:
-    from gigapath_tpu.ops.common import env_flag
-
-    return env_flag("GIGAPATH_PIPELINED_ATTN")
-
-
-def _dilated_branch_fwd_impl(q, k, v, vl_dyn, sl, r, H, real_len, causal, interpret):
+def _dilated_branch_fwd_impl(q, k, v, vl_dyn, sl, r, H, real_len, causal,
+                             interpret, flags):
     B, L, E = q.shape
     Dh = E // H
     g, S, gp, m, Mp, block = _branch_geometry(L, E, sl, r)
-    q6 = _pack_phases(q, g, S, r, Mp, H, interpret)
-    k6 = _pack_phases(k, g, S, r, Mp, H, interpret)
-    v6 = _pack_phases(v, g, S, r, Mp, H, interpret)
+    q6 = _pack_phases(q, g, S, r, Mp, H, interpret, flags.pack_direct)
+    k6 = _pack_phases(k, g, S, r, Mp, H, interpret, flags.pack_direct)
+    v6 = _pack_phases(v, g, S, r, Mp, H, interpret, flags.pack_direct)
     kvlen = _branch_kvlen(B, S, g, r, m, real_len, vl_dyn)
     hb = H // r
-    if not causal and _pipelined_fwd_enabled():
+    if not causal and flags.pipelined_fwd:
         out6, lse5 = _fwd_impl_pipe(
             q6, k6, v6, kvlen, Dh ** -0.5, hb, Dh,
-            block, _pipe_block_k(block), interpret,
+            block, _pipe_block_k(block, flags.pipe_block_k), interpret,
         )
     else:
         out6, lse5 = _fwd_impl(
@@ -1269,14 +1291,15 @@ def _dilated_branch_fwd_impl(q, k, v, vl_dyn, sl, r, H, real_len, causal, interp
         )
     # off-band lanes come back as exact zeros from the unpack kernel — the
     # branch's cover pattern needs no separate select
-    out = _unpack_phases(out6, L, E, g, S, r, interpret)
+    out = _unpack_phases(out6, L, E, g, S, r, interpret, flags.pack_direct)
     lse = _scatter_lse(lse5, B, L, H, g, S, r, m)
     return out, lse, (out6, lse5)
 
 
-def _dilated_branch_fwd(q, k, v, vl_dyn, sl, r, H, real_len, causal, interpret):
+def _dilated_branch_fwd(q, k, v, vl_dyn, sl, r, H, real_len, causal,
+                        interpret, flags):
     out, lse, res = _dilated_branch_fwd_impl(
-        q, k, v, vl_dyn, sl, r, H, real_len, causal, interpret
+        q, k, v, vl_dyn, sl, r, H, real_len, causal, interpret, flags
     )
     # Residuals are the DENSE q/k/v (shared buffers across every branch of
     # the multi-branch op — XLA stores one copy) plus this branch's packed
@@ -1286,26 +1309,28 @@ def _dilated_branch_fwd(q, k, v, vl_dyn, sl, r, H, real_len, causal, interpret):
     return (out, lse), ((q, k, v, vl_dyn) + res, q.shape)
 
 
-def _dilated_branch_bwd(sl, r, H, real_len, causal, interpret, saved, cotangents):
+def _dilated_branch_bwd(sl, r, H, real_len, causal, interpret, flags, saved,
+                        cotangents):
     (q, k, v, vl_dyn, out6, lse5), (B, L, E) = saved
     do, _dlse = cotangents  # no gradient flows through the lse output
     Dh = E // H
     hb = H // r
     g, S, gp, m, Mp, block = _branch_geometry(L, E, sl, r)
-    q6 = _pack_phases(q, g, S, r, Mp, H, interpret)
-    k6 = _pack_phases(k, g, S, r, Mp, H, interpret)
-    v6 = _pack_phases(v, g, S, r, Mp, H, interpret)
-    do6 = _pack_phases(do, g, S, r, Mp, H, interpret)
+    q6 = _pack_phases(q, g, S, r, Mp, H, interpret, flags.pack_direct)
+    k6 = _pack_phases(k, g, S, r, Mp, H, interpret, flags.pack_direct)
+    v6 = _pack_phases(v, g, S, r, Mp, H, interpret, flags.pack_direct)
+    do6 = _pack_phases(do, g, S, r, Mp, H, interpret, flags.pack_direct)
     # delta = rowsum(do * out) per (token, head), in the kernel's lse
     # layout [B, S, r, Mp, LANES] — the packed arrays ARE the diagonal
     delta = (do6.astype(jnp.float32) * out6.astype(jnp.float32)).sum(axis=-1)
     delta = delta.transpose(0, 1, 2, 4, 3)  # [B, S, r, Mp, hb]
     delta = jnp.pad(delta, ((0, 0),) * 4 + ((0, LANES - hb),))
     kvlen = _branch_kvlen(B, S, g, r, m, real_len, vl_dyn)
-    if not causal and _pipelined_bwd_enabled():
+    if not causal and flags.pipelined_bwd:
         dq6, dk6, dv6 = _bwd_impl_pipe(
             q6, k6, v6, do6, lse5, delta, kvlen, Dh ** -0.5,
-            hb, Dh, block, _pipe_bwd_block_k(block), interpret,
+            hb, Dh, block,
+            _pipe_bwd_block_k(block, flags.pipe_bwd_block_k), interpret,
         )
     else:
         dq6, dk6, dv6 = _bwd_impl(
@@ -1316,7 +1341,7 @@ def _dilated_branch_bwd(sl, r, H, real_len, causal, interpret, saved, cotangents
     def undo(x6):
         # off-band lanes are exact zeros from the unpack kernel — which IS
         # the correct gradient there (the branch never reads those slots)
-        return _unpack_phases(x6, L, E, g, S, r, interpret)
+        return _unpack_phases(x6, L, E, g, S, r, interpret, flags.pack_direct)
 
     vl_ct = (
         None if vl_dyn is None
@@ -1340,6 +1365,7 @@ def dilated_branch_attention(
     valid_len_dyn: Optional[jnp.ndarray] = None,
     is_causal: bool = False,
     interpret: bool = False,
+    flags: Optional[PipelineFlags] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """One dilated-attention branch on dense [B, L, E] activations.
 
@@ -1350,12 +1376,19 @@ def dilated_branch_attention(
     ``valid_len_dyn``: optional TRACED [B] suffix valid lengths (collate
     pad masks) — combined with the static masks in the kernels' SMEM
     valid-count tables at runtime.
+    ``flags``: kernel-dispatch flag snapshot; by default the GIGAPATH_*
+    environment flags are read here, ONCE per call — the single sanctioned
+    read point (see the README flag table for trace-time semantics). Pass
+    an explicit :class:`PipelineFlags` to pin the dispatch independently
+    of the environment.
     """
     B, L, E = q.shape
     assert E % num_heads == 0
     assert num_heads % r == 0 and E % r == 0, (num_heads, E, r)
     rl = L if real_len is None else min(int(real_len), L)
+    if flags is None:
+        flags = snapshot_flags()
     return _dilated_branch(
         q, k, v, valid_len_dyn, int(sl), int(r), num_heads, rl, is_causal,
-        interpret,
+        interpret, flags,
     )

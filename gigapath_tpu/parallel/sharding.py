@@ -16,9 +16,27 @@ from typing import Any, Dict
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-# leaf module name -> (spec for `kernel`); biases/scales stay replicated
-_COLUMN_PARALLEL = ("q_proj", "k_proj", "v_proj", "fc1", "gate")
-_ROW_PARALLEL = ("out_proj", "fc2")
+# leaf module name -> (spec for `kernel`); biases/scales stay replicated.
+# Coverage of these lists against every Dense construction site in the
+# model stack is enforced mechanically by gigalint GL003
+# (tools/gigalint/sharding_coverage.py) — a name in neither list falls
+# through to replicated P() below, silently.
+_COLUMN_PARALLEL = (
+    "q_proj", "k_proj", "v_proj", "fc1", "gate",
+    # retention gate projection: [E, value_dim], split like q/k/v
+    "g_proj",
+    # ViT packed qkv: [D, 3D], output-dim split (megatron fused-qkv rule)
+    "qkv",
+    # vocab head: [E, V], vocab-dim split (softmax gathers under GSPMD)
+    "output_projection",
+)
+_ROW_PARALLEL = (
+    "out_proj", "fc2",
+    # ViT attention output projection (models/tile_encoder.py); the
+    # PatchEmbed Dense shares the name — its [in_chans, E] kernel also
+    # input-dim splits correctly (GSPMD inserts the gather)
+    "proj",
+)
 
 
 def param_spec(

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# One-shot gigalint entry point for pre-commit / CI.
+#
+#   bash scripts/lint.sh            # lint the tree, exit nonzero on findings
+#   bash scripts/lint.sh --json     # machine-readable (extra args pass through)
+#
+# Scans gigapath_tpu/ + scripts/ + tests/ — the same scope
+# tests/test_gigalint.py enforces on every tier-1 run — honoring the
+# GIGALINT_WAIVERS file at the repo root.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m tools.gigalint gigapath_tpu scripts tests "$@"

@@ -66,9 +66,14 @@ def test_failure_emits_contractual_json_without_snapshot(no_snapshot, capsys):
     assert payload["unit"] == "tokens/s"
     assert "error" in payload
     assert "stale" not in payload
+    assert "last_good" not in payload
 
 
-def test_failure_merges_stale_snapshot(no_snapshot, capsys):
+def test_failure_reports_snapshot_only_as_last_good(no_snapshot, capsys):
+    """The round-5 advisor contract: an unmeasured round must never be
+    recordable as fresh. On failure 'value' stays null even when a
+    snapshot exists; the old number appears ONLY under last_good_*,
+    alongside stale=true and the error."""
     snap = {
         "metric": "slide_embed_tokens_per_sec",
         "value": 138400.0,
@@ -79,10 +84,35 @@ def test_failure_merges_stale_snapshot(no_snapshot, capsys):
     with open(bench.LOCAL_SNAPSHOT, "w") as f:
         json.dump(snap, f)
     payload = _run_main_failing(capsys)
-    assert payload["value"] == 138400.0
-    assert payload["vs_baseline"] == 0.373
+    assert payload["value"] is None, (
+        "failure must not launder the stale snapshot into 'value'"
+    )
+    assert "vs_baseline" not in payload  # stale metrics stay out of top level
     assert payload["stale"] is True
+    assert payload["last_good_value"] == 138400.0
+    assert payload["last_good_snapshot_utc"] == "2026-07-30T23:00:00Z"
+    assert payload["last_good"]["vs_baseline"] == 0.373
     assert "error" in payload
+
+
+def test_failure_strips_error_and_stale_from_last_good(no_snapshot, capsys):
+    """A snapshot that (from an older bench version) carries error/stale
+    keys must not re-surface them inside last_good."""
+    snap = {
+        "metric": "slide_embed_tokens_per_sec",
+        "value": 99.0,
+        "unit": "tokens/s",
+        "error": "old error",
+        "stale": True,
+        "snapshot_utc": "2026-07-29T00:00:00Z",
+    }
+    with open(bench.LOCAL_SNAPSHOT, "w") as f:
+        json.dump(snap, f)
+    payload = _run_main_failing(capsys)
+    assert payload["value"] is None
+    assert "error" not in payload["last_good"]
+    assert "stale" not in payload["last_good"]
+    assert payload["last_good_value"] == 99.0
 
 
 def test_success_memoizes_backend(monkeypatch):

@@ -695,6 +695,148 @@ def test_pipelined_bwd_matches_serial(rng, monkeypatch, L, sl, r, rl):
         )
 
 
+def test_pipelined_fwd_fast_small_geometry(rng, monkeypatch):
+    """Fast default-tier sibling of test_pipelined_fwd_matches_serial:
+    one L=300/nk==1 case so ``pytest -q`` exercises the
+    GIGAPATH_PIPELINED_ATTN kernel path on every run (the round-5 slow-only
+    gap gigalint GL005 now guards against)."""
+    from gigapath_tpu.ops.pallas_dilated import dilated_branch_attention
+
+    L, sl, r, rl = 300, 64, 1, 300
+    H, Dh = 8, 16
+    E = H * Dh
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(1, L, E)), jnp.float32) for _ in range(3)
+    )
+    monkeypatch.delenv("GIGAPATH_PIPELINED_ATTN", raising=False)
+    o0, l0 = dilated_branch_attention(q, k, v, sl, r, H, real_len=rl, interpret=True)
+    monkeypatch.setenv("GIGAPATH_PIPELINED_ATTN", "1")
+    monkeypatch.setenv("GIGAPATH_PIPE_BLOCK_K", "512")
+    o1, l1 = dilated_branch_attention(q, k, v, sl, r, H, real_len=rl, interpret=True)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o0), atol=2e-6, rtol=1e-5)
+    fin = np.asarray(l0) > -1e19
+    np.testing.assert_allclose(
+        np.asarray(l1)[fin], np.asarray(l0)[fin], atol=2e-6, rtol=1e-5
+    )
+
+
+def test_pipelined_bwd_fast_small_geometry(rng, monkeypatch):
+    """Fast default-tier sibling of test_pipelined_bwd_matches_serial
+    (GIGAPATH_PIPELINED_BWD): one small multi-phase ragged-tail case."""
+    from gigapath_tpu.ops.pallas_dilated import dilated_branch_attention
+
+    L, sl, r, rl = 128, 32, 2, 101
+    H, Dh = 4, 16
+    E = H * Dh
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(1, L, E)), jnp.float32) for _ in range(3)
+    )
+
+    def loss(q_, k_, v_):
+        o, _ = dilated_branch_attention(
+            q_, k_, v_, sl, r, H, real_len=rl, interpret=True
+        )
+        return (o * o).sum()
+
+    monkeypatch.delenv("GIGAPATH_PIPELINED_BWD", raising=False)
+    g0 = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.setenv("GIGAPATH_PIPELINED_BWD", "1")
+    g1 = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g0):
+        scale = max(float(jnp.max(jnp.abs(np.asarray(b)))), 1e-12)
+        np.testing.assert_allclose(
+            np.asarray(a) / scale, np.asarray(b) / scale, atol=2e-6
+        )
+
+
+def test_pack_direct_fast_small_geometry(rng, monkeypatch):
+    """Fast default-tier sibling of test_pack_direct_matches_padded
+    (GIGAPATH_PACK_DIRECT): single-segment branch with a straddling tail
+    block, forward bit-identity only (the slow tier covers gradients)."""
+    from gigapath_tpu.ops.pallas_dilated import dilated_branch_attention
+
+    L, sl, r, rl = 300, 512, 2, 277
+    H, Dh = 8, 16
+    E = H * Dh
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(1, L, E)), jnp.float32) for _ in range(3)
+    )
+    monkeypatch.delenv("GIGAPATH_PACK_DIRECT", raising=False)
+    o0, l0 = dilated_branch_attention(q, k, v, sl, r, H, real_len=rl, interpret=True)
+    monkeypatch.setenv("GIGAPATH_PACK_DIRECT", "1")
+    o1, l1 = dilated_branch_attention(q, k, v, sl, r, H, real_len=rl, interpret=True)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o0))
+    fin = np.asarray(l0) > -1e19
+    np.testing.assert_array_equal(np.asarray(l1)[fin], np.asarray(l0)[fin])
+
+
+def test_seq_parallel_fused_routing_fast(rng, monkeypatch):
+    """Fast default-tier sibling of the seq-parallel fused-routing slow
+    tests: a 2-device mesh at tiny geometry still routes fits-local
+    branches through the fused kernels and matches single-device."""
+    import functools
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    try:  # jax >= 0.9 spells it jax.shard_map (same idiom as
+        from jax import shard_map  # ops/moe/expert_parallel.py)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    import gigapath_tpu.ops.flash_attention as fa
+    import gigapath_tpu.ops.pallas_dilated as pdm
+    from gigapath_tpu.ops import dilated_attention as da
+
+    monkeypatch.setattr(fa, "_on_tpu", lambda: True)
+    real = pdm.dilated_branch_attention
+    routed = []
+
+    def spy(q, k, v, sl, r, H, **kw):
+        routed.append((sl, r, kw.get("real_len")))
+        kw["interpret"] = True
+        return real(q, k, v, sl, r, H, **kw)
+
+    monkeypatch.setattr(pdm, "dilated_branch_attention", spy)
+
+    n_dev = 2
+    B, L, H, Dh = 1, 64, 4, 8
+    sls, drs = [8, 32], [1, 2]  # both fit the 32-token local shard
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(B, L, H, Dh)), jnp.float32)
+        for _ in range(3)
+    )
+    single = da.dilated_attention(q, k, v, sls, drs)
+    routed.clear()
+
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("seq",))
+    # rep/vma checking can't see through pallas_call on either jax line —
+    # disabled exactly as in the slow seq-parallel tests
+    import inspect
+
+    sig = inspect.signature(shard_map).parameters
+    check_kw = (
+        {"check_vma": False} if "check_vma" in sig else {"check_rep": False}
+    )
+    fn = shard_map(
+        functools.partial(
+            da.dilated_attention, segment_lengths=sls, dilated_ratios=drs,
+            seq_axis_name="seq", seq_axis_size=n_dev,
+        ),
+        mesh=mesh,
+        in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+        out_specs=P(None, "seq"),
+        **check_kw,
+    )
+    sharded = fn(q, k, v)
+    assert len(routed) == len(sls), (
+        f"both local branches should take the fused path, got {routed}"
+    )
+    assert all(rl == L // n_dev for _, _, rl in routed)
+    np.testing.assert_allclose(
+        np.asarray(sharded), np.asarray(single), atol=2e-5, rtol=1e-4
+    )
+
+
 @pytest.mark.slow
 def test_seq_parallel_local_branches_use_fused_path(rng, monkeypatch):
     """Under sequence parallelism, branches whose segment fits the local

@@ -1,0 +1,148 @@
+"""gigalint wiring: the tree stays clean, and the pass itself works.
+
+Two contracts, both from ISSUE/acceptance:
+
+1. ``python -m tools.gigalint gigapath_tpu scripts`` (and the wider
+   gigapath_tpu+scripts+tests scan that lint.sh runs) exits 0 on this
+   tree — every finding fixed or explicitly waived with a reason.
+2. The seeded-violation fixture tree under tools/gigalint/selftest/
+   makes the pass exit NONZERO with every rule class (GL001–GL005)
+   firing at least once, while the negative controls stay clean.
+
+These run in the default tier, so every ``pytest -q`` is also a lint run.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = "tools/gigalint/selftest/fixture"
+
+sys.path.insert(0, REPO_ROOT)
+
+from tools.gigalint.cli import run_lint  # noqa: E402
+
+
+def test_acceptance_scan_is_clean():
+    """The ISSUE acceptance command: gigapath_tpu + scripts, waivers on."""
+    result = run_lint(["gigapath_tpu", "scripts"], root=REPO_ROOT)
+    assert result.errors == []
+    assert result.findings == [], "\n".join(f.text() for f in result.findings)
+    assert result.exit_code == 0
+
+
+def test_full_scan_with_tests_is_clean():
+    """The lint.sh scan: tests/ included, so GL005 (pytest hygiene) and
+    the test-file-induced trace roots are enforced too."""
+    result = run_lint(["gigapath_tpu", "scripts", "tests"], root=REPO_ROOT)
+    assert result.errors == []
+    assert result.findings == [], "\n".join(f.text() for f in result.findings)
+    # the waiver file is in active use — every entry must earn its keep
+    assert result.waived, "expected the documented waivers to be exercised"
+
+
+def test_fixture_tree_fires_every_rule_class():
+    result = run_lint([FIXTURE], root=REPO_ROOT, waiver_file=None)
+    assert result.exit_code != 0
+    fired = {f.rule for f in result.findings}
+    assert fired >= {"GL001", "GL002", "GL003", "GL004", "GL005"}, (
+        f"missing rule classes: {sorted({'GL001','GL002','GL003','GL004','GL005'} - fired)}"
+    )
+
+
+def test_fixture_negative_controls_stay_clean():
+    result = run_lint([FIXTURE], root=REPO_ROOT, waiver_file=None)
+    for f in result.findings:
+        assert "negative_control" not in f.symbol, f.text()
+        assert "test_fixture_fast_without_features" not in f.symbol, f.text()
+
+
+def test_fixture_specific_findings():
+    """Each seeded violation is found at its seeded location."""
+    result = run_lint([FIXTURE], root=REPO_ROOT, waiver_file=None)
+    got = {(f.rule, f.path.rsplit("/", 1)[-1], f.symbol) for f in result.findings}
+    expected = {
+        ("GL001", "kernels.py", "env_helper"),       # direct read, reachable
+        ("GL001", "kernels.py", "kernel_dispatch"),  # helper call + direct
+        ("GL002", "kernels.py", "leaky"),
+        # compound condition: an is-None guard must not shadow the leak
+        ("GL002", "kernels.py", "leaky_compound"),
+        ("GL003", "net.py", "uncovered_proj"),
+        ("GL003", "net.py", "<anonymous>"),
+        ("GL004", "net.py", "make_net"),
+        ("GL004", "net.py", "eval"),
+        ("GL004", "net.py", "except"),
+        ("GL005", "test_hygiene.py", "test_fixture_flag_parity_slow"),
+        ("GL005", "test_hygiene.py", "test_fixture_seq_parallel_slow"),
+    }
+    assert expected <= got, f"missing: {sorted(expected - got)}"
+
+
+def test_cli_json_output_and_exit_codes():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.gigalint", "--json", "--no-waivers",
+         FIXTURE],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 1, proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["findings"], "JSON output must carry the findings"
+    assert all(
+        {"rule", "path", "lineno", "symbol", "message"} <= set(f)
+        for f in payload["findings"]
+    )
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.gigalint", "gigapath_tpu", "scripts"],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_waiver_without_reason_is_an_error(tmp_path):
+    waivers = tmp_path / "WAIVERS"
+    waivers.write_text("GL004 somewhere.py\n")  # no '-- reason'
+    result = run_lint([FIXTURE], root=REPO_ROOT, waiver_file=str(waivers))
+    assert any("justification" in e for e in result.errors)
+    assert result.exit_code == 2
+
+
+def test_waiver_suppresses_with_reason(tmp_path):
+    waivers = tmp_path / "WAIVERS"
+    waivers.write_text(
+        "GL004 tools/gigalint/selftest/fixture/models/net.py::eval"
+        " -- fixture: seeded violation\n"
+    )
+    result = run_lint([FIXTURE], root=REPO_ROOT, waiver_file=str(waivers))
+    assert not any(
+        f.rule == "GL004" and f.symbol == "eval" for f in result.findings
+    )
+    assert any(
+        f.rule == "GL004" and f.symbol == "eval"
+        and f.waived_by == "fixture: seeded violation"
+        for f in result.waived
+    )
+
+
+def test_inline_waiver(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "def f(path):\n"
+        "    return eval(path)  # gigalint: waive GL004 -- test inline\n"
+    )
+    result = run_lint([str(bad)], root=REPO_ROOT, waiver_file=None)
+    assert result.findings == []
+    assert any(f.waived_by == "inline: test inline" for f in result.waived)
+
+
+def test_lint_sh_exists_and_points_at_the_tool():
+    script = os.path.join(REPO_ROOT, "scripts", "lint.sh")
+    assert os.path.exists(script)
+    with open(script) as f:
+        body = f.read()
+    assert "tools.gigalint" in body
+    assert os.access(script, os.X_OK), "lint.sh must be executable"

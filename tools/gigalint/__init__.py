@@ -1,0 +1,22 @@
+"""gigalint: JAX-aware static analysis for the gigapath-tpu tree.
+
+Encodes the codebase's trace-time invariants as mechanical checks:
+
+- GL001  trace-time environment reads (``os.environ`` / ``env_flag``
+         reachable from jit/pjit/custom_vjp/pallas trace contexts)
+- GL002  tracer leaks (``.item()``, host casts/branches on traced
+         arguments, nondeterminism inside traced code)
+- GL003  partition-rule coverage (model parameters that silently fall
+         through to replicated ``P()`` in parallel/sharding.py)
+- GL004  forbidden APIs (``eval``/``exec``, bare ``except:``, mutable
+         default arguments)
+- GL005  pytest hygiene (slow-only coverage of kernel env flags and
+         seq-parallel routing must have fast siblings)
+
+Run as ``python -m tools.gigalint <paths...>``; see tools/gigalint/cli.py
+for flags, and GIGALINT_WAIVERS at the repo root for the waiver format.
+"""
+
+__version__ = "1.0.0"
+
+from tools.gigalint.cli import run_lint  # noqa: F401  (public API)

@@ -1,0 +1,100 @@
+"""Small AST helpers shared by the walker and the rules (stdlib-only)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None.
+
+    Calls like ``functools.partial(jax.jit, ...)`` resolve to the dotted
+    name of their first argument (the effective decorator/wrapped target),
+    so ``@functools.partial(jax.custom_vjp, nondiff_argnums=...)`` reads
+    as ``jax.custom_vjp``.
+    """
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return f"{base}.{node.attr}" if base else None
+    if isinstance(node, ast.Call):
+        fn = dotted_name(node.func)
+        if fn in ("functools.partial", "partial") and node.args:
+            return dotted_name(node.args[0])
+        return fn
+    return None
+
+
+def last_segment(dotted: str) -> str:
+    return dotted.rsplit(".", 1)[-1]
+
+
+def int_tuple_literal(node: ast.AST) -> Optional[List[int]]:
+    """Literal ints from a tuple/list display (``(4, 5, 6)``), else None."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+            else:
+                return None
+        return out
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    return None
+
+
+def str_tuple_literal(node: ast.AST) -> Optional[List[str]]:
+    """Literal strings from a tuple/list display, else None."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def call_kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def walk_calls(node: ast.AST) -> Iterator[ast.Call]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            yield sub
+
+
+def names_in(node: ast.AST) -> Iterator[ast.Name]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            yield sub
+
+
+def param_names(fn: ast.AST) -> List[str]:
+    """Positional + keyword-only parameter names, in signature order
+    (posonly first, then regular, then kwonly; *args/**kwargs excluded —
+    they can't be mapped to static argnums)."""
+    a = fn.args
+    params = [p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+    params += [p.arg for p in a.kwonlyargs]
+    return params
+
+
+MUTABLE_DEFAULT_CALLS = ("dict", "list", "set")
+
+
+def is_mutable_default(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+        return True
+    if isinstance(node, ast.Call):
+        fn = dotted_name(node.func)
+        return fn in MUTABLE_DEFAULT_CALLS
+    return False

@@ -1,0 +1,192 @@
+"""gigalint CLI: discover files, run the rule registry, report, exit.
+
+    python -m tools.gigalint gigapath_tpu scripts
+    python -m tools.gigalint --json --no-waivers tools/gigalint/selftest/fixture
+
+Exit codes: 0 clean (all findings waived or none), 1 unwaived findings,
+2 usage / waiver-file / syntax errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+from typing import List, Optional, Tuple
+
+# Import the audit modules for their registration side effects.
+from tools.gigalint import rules as _rules
+from tools.gigalint import pytest_hygiene as _hyg  # noqa: F401
+from tools.gigalint import sharding_coverage as _cov  # noqa: F401
+from tools.gigalint.graph import build_project
+from tools.gigalint.rules import RULES, Finding
+from tools.gigalint.waivers import (
+    WaiverConfig,
+    apply_waivers,
+    inline_waivers,
+    parse_waiver_file,
+)
+from tools.gigalint.walker import ModuleInfo, parse_module
+
+DEFAULT_WAIVER_FILE = "GIGALINT_WAIVERS"
+
+
+def _discover(paths: List[str], root: str) -> List[Tuple[str, str, str]]:
+    """[(abs path, repo-relative posix path, dotted modname)]."""
+    out = []
+    for p in paths:
+        ap = os.path.abspath(os.path.join(root, p))
+        if os.path.isfile(ap) and ap.endswith(".py"):
+            files = [ap]
+        else:
+            files = []
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = [d for d in sorted(dirnames)
+                               if d not in ("__pycache__", ".git")]
+                files += [os.path.join(dirpath, f) for f in sorted(filenames)
+                          if f.endswith(".py")]
+        for f in files:
+            rel = os.path.relpath(f, root).replace(os.sep, "/")
+            modname = rel[:-3].replace("/", ".")
+            if modname.endswith(".__init__"):
+                modname = modname[: -len(".__init__")]
+            out.append((f, rel, modname))
+    return out
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]
+    waived: List[Finding]
+    errors: List[str]
+    scanned: int
+    # waiver entries that matched nothing this run (stale suppressions —
+    # reported as warnings so they get pruned, never silently hoarded)
+    unused_waivers: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def exit_code(self) -> int:
+        if self.errors:
+            return 2
+        return 1 if self.findings else 0
+
+
+def run_lint(
+    paths: List[str],
+    root: str = ".",
+    waiver_file: Optional[str] = DEFAULT_WAIVER_FILE,
+    select: Optional[List[str]] = None,
+) -> LintResult:
+    """Programmatic entry point (used by tests/test_gigalint.py)."""
+    errors: List[str] = []
+    modules: List[ModuleInfo] = []
+    discovered = _discover(paths, root)
+    if not discovered:
+        errors.append(f"no python files under {paths!r} (root={root!r})")
+    for abspath, rel, modname in discovered:
+        try:
+            modules.append(parse_module(abspath, rel, modname))
+        except SyntaxError as e:
+            errors.append(f"{rel}:{e.lineno}: GL000 syntax error: {e.msg}")
+        except (ValueError, UnicodeDecodeError, OSError) as e:
+            # ast.parse raises ValueError on null bytes; open() raises
+            # UnicodeDecodeError on non-UTF-8 — report per-file and keep
+            # linting the rest instead of dying with a traceback
+            errors.append(f"{rel}: GL000 unparseable file: {e}")
+    project = build_project(modules)
+
+    cfg = WaiverConfig()
+    if waiver_file:
+        cfg = parse_waiver_file(os.path.join(root, waiver_file))
+        errors.extend(cfg.errors)
+
+    findings: List[Finding] = []
+    for rule_id, rule in sorted(RULES.items()):
+        if select and rule_id not in select:
+            continue
+        findings.extend(rule.check(project))
+    findings.sort(key=lambda f: (f.path, f.lineno, f.rule))
+
+    active, waived = apply_waivers(findings, cfg, inline_waivers(modules))
+    result = LintResult(
+        findings=active, waived=waived, errors=errors, scanned=len(modules)
+    )
+    # Unused-waiver reporting is only meaningful on a FULL-rule scan: with
+    # --select (or a path subset) a waiver's rule may simply not have run,
+    # and telling the maintainer to prune it would break the full run.
+    if select is None:
+        result.unused_waivers = [
+            f"{w.rule} {w.path_glob}" + (f"::{w.symbol}" if w.symbol else "")
+            for w in cfg.unused()
+        ]
+    return result
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.gigalint",
+        description="JAX-aware static analysis for the gigapath-tpu tree",
+    )
+    ap.add_argument("paths", nargs="+", help="files or directories to lint")
+    ap.add_argument("--root", default=".", help="repo root (default: cwd)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--waivers", default=DEFAULT_WAIVER_FILE,
+                    help=f"waiver file relative to --root "
+                    f"(default: {DEFAULT_WAIVER_FILE})")
+    ap.add_argument("--no-waivers", action="store_true",
+                    help="ignore the waiver file and inline waivers")
+    ap.add_argument("--select", action="append", metavar="GLxxx",
+                    help="run only these rules (repeatable)")
+    ap.add_argument("--show-waived", action="store_true",
+                    help="also list waived findings in text output")
+    args = ap.parse_args(argv)
+
+    result = run_lint(
+        args.paths,
+        root=args.root,
+        waiver_file=None if args.no_waivers else args.waivers,
+        select=args.select,
+    )
+    if args.no_waivers:
+        # re-fold waived findings back in: --no-waivers means "show all"
+        result.findings = sorted(
+            result.findings + result.waived,
+            key=lambda f: (f.path, f.lineno, f.rule),
+        )
+        for f in result.findings:
+            f.waived_by = None
+        result.waived = []
+
+    if args.as_json:
+        print(json.dumps({
+            "version": 1,
+            "scanned_files": result.scanned,
+            "findings": [f.as_dict() for f in result.findings],
+            "waived": [f.as_dict() for f in result.waived],
+            "errors": result.errors,
+            "exit_code": result.exit_code,
+        }, indent=1))
+        return result.exit_code
+
+    for err in result.errors:
+        print(f"error: {err}", file=sys.stderr)
+    for stale in result.unused_waivers:
+        print(
+            f"warning: unused waiver (stale entry, or the waived file is "
+            f"outside this scan's paths): {stale}",
+            file=sys.stderr,
+        )
+    for f in result.findings:
+        print(f.text())
+    if args.show_waived:
+        for f in result.waived:
+            print(f"waived: {f.text()}  [{f.waived_by}]")
+    n, w = len(result.findings), len(result.waived)
+    print(
+        f"gigalint: {result.scanned} files, {n} finding(s), {w} waived",
+        file=sys.stderr,
+    )
+    return result.exit_code

@@ -1,0 +1,128 @@
+"""Project-level call graph and jit-reachability.
+
+Links the per-file facts from :mod:`walker` into a best-effort call
+graph (same-module names, ``self.`` methods, import aliases), marks the
+trace-context roots, and computes the set of functions whose bodies run
+at trace time:
+
+- functions decorated with ``jax.jit``/``pjit``/``custom_vjp``/… ;
+- functions registered via ``primal.defvjp(fwd, bwd)``;
+- functions passed to a tracing wrapper (``jax.jit(f)``, ``shard_map(f)``,
+  ``jax.grad(f)``, …) anywhere in the scanned tree;
+- functions lexically containing a ``pallas_call`` (kernel dispatchers:
+  their whole body executes while the surrounding computation traces);
+- everything transitively *called* by any of the above.
+
+Resolution is intentionally conservative: an unresolvable callee is
+ignored rather than guessed, so findings point at real reachable code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.gigalint.walker import FunctionInfo, ModuleInfo
+
+
+@dataclasses.dataclass
+class Project:
+    modules: Dict[str, ModuleInfo]  # modname -> ModuleInfo
+
+    def all_functions(self) -> Iterable[FunctionInfo]:
+        for mod in self.modules.values():
+            yield from mod.functions.values()
+
+    # -- symbol resolution ----------------------------------------------
+    def resolve(self, mod: ModuleInfo, caller: Optional[FunctionInfo],
+                callee: str) -> Optional[FunctionInfo]:
+        """Map a textual callee (as written at the call site) to a scanned
+        FunctionInfo, or None if external/ambiguous."""
+        parts = callee.split(".")
+        # self.method -> method on the caller's class
+        if parts[0] == "self" and caller and caller.class_name and len(parts) == 2:
+            return mod.functions.get(f"{caller.class_name}.{parts[1]}")
+        if len(parts) == 1:
+            name = parts[0]
+            # nested sibling / enclosing-scope function first
+            if caller:
+                scope = caller.qualname.split(".")
+                for depth in range(len(scope), 0, -1):
+                    hit = mod.functions.get(".".join(scope[:depth] + [name]))
+                    if hit:
+                        return hit
+            if name in mod.functions:
+                return mod.functions[name]
+            target = mod.imports.get(name)
+            if target:
+                return self._resolve_dotted(target)
+            return None
+        # alias.attr...: expand a leading import alias, then try dotted
+        head, rest = parts[0], parts[1:]
+        target = mod.imports.get(head)
+        if target:
+            return self._resolve_dotted(".".join([target] + rest))
+        return self._resolve_dotted(callee)
+
+    def _resolve_dotted(self, dotted: str) -> Optional[FunctionInfo]:
+        """``pkg.mod.func`` or ``pkg.mod.Cls.meth`` -> FunctionInfo."""
+        parts = dotted.split(".")
+        for split in range(len(parts) - 1, 0, -1):
+            mod = self.modules.get(".".join(parts[:split]))
+            if mod:
+                return mod.functions.get(".".join(parts[split:]))
+        return None
+
+    # -- trace roots and reachability -----------------------------------
+    def trace_roots(self) -> Dict[FunctionInfo, str]:
+        """Trace-context roots -> human-readable reason."""
+        roots: Dict[FunctionInfo, str] = {}
+        for mod in self.modules.values():
+            for fn in mod.functions.values():
+                if fn.is_trace_decorated:
+                    roots.setdefault(fn, "decorated "
+                                     + ", ".join(fn.decorators))
+                elif fn.contains_pallas:
+                    roots.setdefault(fn, "contains pallas_call")
+            for fwd, bwd, lineno in mod.defvjp_pairs:
+                for name in (fwd, bwd):
+                    hit = self.resolve(mod, None, name)
+                    if hit:
+                        roots.setdefault(
+                            hit, f"custom_vjp piece (defvjp at {mod.path}:{lineno})"
+                        )
+            for target, lineno in mod.wrapped_refs:
+                hit = self.resolve(mod, None, target)
+                if hit:
+                    roots.setdefault(
+                        hit, f"traced wrapper target ({mod.path}:{lineno})"
+                    )
+        return roots
+
+    def trace_reachable(self) -> Dict[FunctionInfo, str]:
+        """Every function whose body runs at trace time -> why (root
+        reason, or the call chain root it is reachable from)."""
+        roots = self.trace_roots()
+        reached: Dict[FunctionInfo, str] = dict(roots)
+        queue: List[Tuple[FunctionInfo, str]] = [
+            (fn, reason) for fn, reason in roots.items()
+        ]
+        while queue:
+            fn, reason = queue.pop()
+            for site in fn.calls:
+                callee = self.resolve(fn.module, fn, site.callee)
+                if callee is None or callee in reached:
+                    continue
+                via = f"called from {fn.module.path}::{fn.qualname} ({reason})"
+                reached[callee] = via
+                queue.append((callee, via))
+        return reached
+
+
+def build_project(modules: Iterable[ModuleInfo]) -> Project:
+    return Project(modules={m.modname: m for m in modules})
+
+
+def env_reader_functions(project: Project) -> Set[FunctionInfo]:
+    """Functions whose body directly reads the process environment."""
+    return {fn for fn in project.all_functions() if fn.env_reads}

@@ -1,0 +1,259 @@
+"""Rule registry and the AST-level rules (GL001, GL002, GL004).
+
+GL003 (sharding coverage) and GL005 (pytest hygiene) live in their own
+modules — they are cross-file audits, not per-function AST walks — but
+register here so the CLI sees one registry.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Callable, Dict, List, Optional, Set
+
+from tools.gigalint.astutils import (
+    dotted_name,
+    is_mutable_default,
+    names_in,
+)
+from tools.gigalint.graph import Project, env_reader_functions
+from tools.gigalint.walker import FunctionInfo
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    lineno: int
+    symbol: str  # function qualname or harvested parameter name
+    message: str
+    waived_by: Optional[str] = None  # reason string once waived
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        if self.waived_by is None:
+            d.pop("waived_by")
+        return d
+
+    def text(self) -> str:
+        return f"{self.path}:{self.lineno}: {self.rule} [{self.symbol}] {self.message}"
+
+
+RULES: Dict[str, "Rule"] = {}
+
+
+@dataclasses.dataclass
+class Rule:
+    rule_id: str
+    summary: str
+    check: Callable[[Project], List[Finding]]
+
+
+def register(rule_id: str, summary: str):
+    def deco(fn):
+        RULES[rule_id] = Rule(rule_id, summary, fn)
+        return fn
+
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# GL001 — trace-time environment reads
+# ---------------------------------------------------------------------------
+
+@register(
+    "GL001",
+    "environment read reachable from traced code: the value is baked in at "
+    "trace time and the jit cache can serve kernels traced under stale flags",
+)
+def check_trace_env(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    reached = project.trace_reachable()
+    readers = env_reader_functions(project)
+    for fn, why in reached.items():
+        for lineno, desc in fn.env_reads:
+            findings.append(Finding(
+                rule="GL001", path=fn.module.path, lineno=lineno,
+                symbol=fn.qualname,
+                message=f"direct env read ({desc}) in trace context: {why}. "
+                "Hoist the read to the un-traced dispatch layer and pass the "
+                "value in as a static argument.",
+            ))
+        for site in fn.calls:
+            callee = project.resolve(fn.module, fn, site.callee)
+            if callee in readers and callee is not fn:
+                findings.append(Finding(
+                    rule="GL001", path=fn.module.path, lineno=site.lineno,
+                    symbol=fn.qualname,
+                    message=f"call to env-reading helper "
+                    f"{callee.module.path}::{callee.qualname} in trace "
+                    f"context: {why}. Pass the flag value in instead.",
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# GL002 — tracer leaks
+# ---------------------------------------------------------------------------
+
+_NONDET_CALLS = (
+    "time.time", "time.perf_counter", "time.monotonic", "time.time_ns",
+    "datetime.now", "datetime.datetime.now", "uuid.uuid4",
+)
+_NP_ALIASES = ("np", "numpy", "onp")
+_HOST_CASTS = ("bool", "int", "float")
+
+
+def _derived_names(fn: FunctionInfo) -> Set[str]:
+    """Traced params plus names assigned from expressions mentioning them
+    (single forward pass — good enough for straight-line dispatch code)."""
+    derived: Set[str] = set(fn.traced_params or [])
+    if not derived:
+        return derived
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.AST):
+            used = {n.id for n in names_in(node.value)}
+            if used & derived:
+                for tgt in node.targets:
+                    for n in names_in(tgt):
+                        derived.add(n.id)
+    return derived
+
+
+def _non_is_names(test: ast.AST) -> Set[str]:
+    """Bare names in a condition, excluding operands of ``is (not) None``
+    comparisons — ``if x is None`` on a traced argument is legitimate
+    Python-level structure dispatch, not a tracer leak.
+
+    The exemption is per NODE, not per name: in
+    ``if x is not None and x > 0`` the ``x`` inside ``x > 0`` is a
+    different Name node and still leaks the tracer, so it must be
+    reported even though the same name also appears null-checked."""
+    exempt: Set[ast.AST] = set()
+    for node in ast.walk(test):
+        if isinstance(node, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+        ):
+            exempt.add(node.left)
+            exempt.update(node.comparators)
+    return {
+        node.id
+        for node in ast.walk(test)
+        if isinstance(node, ast.Name) and node not in exempt
+    }
+
+
+@register(
+    "GL002",
+    "tracer leak: host-side value inspection or nondeterminism inside "
+    "traced code (forces trace-time concretization or bakes in stale values)",
+)
+def check_tracer_leaks(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    reached = project.trace_reachable()
+    roots = project.trace_roots()
+    for fn in reached:
+        # --- hazards valid in ANY trace context ---
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call):
+                callee = dotted_name(node.func)
+                if not callee:
+                    continue
+                if callee.endswith(".item") and not node.args:
+                    findings.append(Finding(
+                        "GL002", fn.module.path, node.lineno, fn.qualname,
+                        ".item() in traced code forces a host sync at trace "
+                        "time (and fails on abstract tracers under jit)",
+                    ))
+                elif callee in _NONDET_CALLS or any(
+                    callee.startswith(f"{a}.random.") for a in _NP_ALIASES
+                ) or callee.startswith("random."):
+                    findings.append(Finding(
+                        "GL002", fn.module.path, node.lineno, fn.qualname,
+                        f"nondeterministic host call {callee}() in traced "
+                        "code: the value is frozen at trace time and silently "
+                        "reused from the jit cache",
+                    ))
+        # --- hazards needing known traced params: only functions whose
+        # own decorator declares the traced/static split (jit/custom_vjp).
+        # Pallas-containing helpers and defvjp pieces pass static geometry
+        # ints positionally — flagging those would be all noise.
+        if fn not in roots or not fn.is_trace_decorated or fn.traced_params is None:
+            continue
+        derived = _derived_names(fn)
+        if not derived:
+            continue
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call):
+                callee = dotted_name(node.func)
+                if not callee:
+                    continue
+                arg0 = node.args[0] if node.args else None
+                arg_is_traced = isinstance(arg0, ast.Name) and arg0.id in derived
+                if callee in _HOST_CASTS and arg_is_traced:
+                    findings.append(Finding(
+                        "GL002", fn.module.path, node.lineno, fn.qualname,
+                        f"{callee}() on traced argument '{arg0.id}' "
+                        "concretizes a tracer (TracerBoolConversionError at "
+                        "best, silently stale constant at worst)",
+                    ))
+                elif arg_is_traced and any(
+                    callee in (f"{a}.asarray", f"{a}.array") for a in _NP_ALIASES
+                ):
+                    findings.append(Finding(
+                        "GL002", fn.module.path, node.lineno, fn.qualname,
+                        f"{callee}() on traced argument '{arg0.id}' pulls the "
+                        "value to the host inside jitted code",
+                    ))
+            elif isinstance(node, (ast.If, ast.While)):
+                leak = _non_is_names(node.test) & derived
+                if leak:
+                    findings.append(Finding(
+                        "GL002", fn.module.path, node.lineno, fn.qualname,
+                        f"Python branch on traced argument(s) {sorted(leak)}: "
+                        "branching must use lax.cond/jnp.where, or the "
+                        "argument belongs in static_argnums",
+                    ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# GL004 — forbidden APIs
+# ---------------------------------------------------------------------------
+
+@register(
+    "GL004",
+    "forbidden API: eval/exec, bare except (swallows KeyboardInterrupt and "
+    "masks checkpoint-IO corruption), or mutable default argument",
+)
+def check_forbidden(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.modules.values():
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                fn = dotted_name(node.func)
+                if fn in ("eval", "exec"):
+                    findings.append(Finding(
+                        "GL004", mod.path, node.lineno, fn,
+                        f"{fn}() is forbidden — use ast.literal_eval or an "
+                        "explicit registry",
+                    ))
+            elif isinstance(node, ast.ExceptHandler) and node.type is None:
+                findings.append(Finding(
+                    "GL004", mod.path, node.lineno, "except",
+                    "bare 'except:' — catch a concrete exception type "
+                    "(bare except swallows KeyboardInterrupt/SystemExit and "
+                    "hides corrupted checkpoint IO)",
+                ))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for default in list(node.args.defaults) + [
+                    d for d in node.args.kw_defaults if d is not None
+                ]:
+                    if is_mutable_default(default):
+                        findings.append(Finding(
+                            "GL004", mod.path, node.lineno, node.name,
+                            f"mutable default argument in {node.name}() is "
+                            "shared across calls — default to None and "
+                            "construct inside",
+                        ))
+    return findings

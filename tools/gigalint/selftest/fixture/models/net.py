@@ -1,0 +1,25 @@
+"""Seeded GL003/GL004 violations (never imported — parsed only)."""
+
+import flax.linen as nn
+
+
+class Net(nn.Module):
+    features: int = 8
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(self.features, name="fc1")(x)  # covered: no finding
+        x = nn.Dense(self.features, name="uncovered_proj")(x)  # GL003
+        x = nn.Dense(self.features)(x)  # GL003: anonymous Dense
+        return x
+
+
+def make_net(layer_sizes=[8, 8]):  # GL004: mutable default argument
+    return Net(features=layer_sizes[0])
+
+
+def load_config(path):
+    try:
+        return eval(open(path).read())  # GL004: eval
+    except:  # noqa: E722  GL004: bare except
+        return None

@@ -1,0 +1,73 @@
+"""Seeded GL001/GL002 violations (never imported — parsed only).
+
+Each marked line is load-bearing for tests/test_gigalint.py.
+"""
+
+import functools
+import os
+import time
+
+import jax
+import numpy as np
+from jax.experimental import pallas as pl
+
+
+def env_helper() -> bool:
+    # GL001: direct env read, trace-reachable via kernel_dispatch
+    return os.environ.get("FIXTURE_FLAG", "") == "1"
+
+
+def kernel_dispatch(x):
+    """Trace context: contains a pallas_call."""
+    if env_helper():  # GL001: call to env-reading helper in trace context
+        block = int(os.environ.get("FIXTURE_BLOCK", "128"))  # GL001: direct
+    else:
+        block = 128
+    del block
+    return pl.pallas_call(lambda x_ref, o_ref: None, out_shape=x)(x)
+
+
+@jax.jit
+def leaky(x):
+    if x:  # GL002: Python branch on a traced argument
+        y = float(x)  # GL002: host cast of a traced argument
+        del y
+    x.item()  # GL002: .item() inside traced code
+    t = time.time()  # GL002: nondeterminism frozen into the trace
+    z = np.asarray(x)  # GL002: host pull of a traced argument
+    del t, z
+    return x
+
+
+@jax.jit
+def leaky_compound(x):
+    # GL002: the is-not-None guard does NOT exempt the x > 0 comparison —
+    # that second x is a fresh Name node and still concretizes the tracer
+    if x is not None and x > 0:
+        return x
+    return x
+
+
+@jax.jit
+def negative_control_is_none(x, y=None):
+    # NEGATIVE CONTROL: 'is None' structure dispatch on a traced argument
+    # is legitimate Python-level routing, not a tracer leak.
+    if y is None:
+        return x
+    return x + y
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def negative_control_static(x, n):
+    # NEGATIVE CONTROL: n is static — branching/casting it is fine and
+    # must produce no GL002 finding.
+    if n:
+        return x * int(n)
+    return x
+
+
+def negative_control_host():
+    # NEGATIVE CONTROL: plain host code — env reads and time are fine
+    # outside trace contexts.
+    _ = os.environ.get("FIXTURE_HOST_FLAG", "")
+    return time.time()

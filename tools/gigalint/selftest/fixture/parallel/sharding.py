@@ -1,0 +1,4 @@
+"""Fixture sharding rules: 'uncovered_proj' is deliberately absent."""
+
+_COLUMN_PARALLEL = ("fc1",)
+_ROW_PARALLEL = ("fc2",)

@@ -1,0 +1,197 @@
+"""GL003 — partition-rule coverage.
+
+Harvests every ``nn.Dense``/``nn.DenseGeneral`` construction site in the
+scanned tree (these are the 2-D-kernel parameters ``param_spec`` in
+gigapath_tpu/parallel/sharding.py can shard by module name) and
+cross-checks the harvested module names against the ``_COLUMN_PARALLEL``
+and ``_ROW_PARALLEL`` tuples parsed from the sharding file. A name in
+neither list silently falls through to replicated ``P()`` — at flagship
+scale that is an invisible loss of tensor parallelism, not an error.
+
+Name harvesting follows the repo's idioms:
+
+- ``nn.Dense(..., name="fc1")`` — literal kwarg;
+- local factories: a def/lambda whose ``name=`` flows from its own
+  parameter (``dense = lambda n: nn.Dense(..., name=n)``), harvested from
+  the literal strings at its call sites, including one level of
+  indirection (``proj()`` passing its own ``name`` alongside the factory,
+  the ops/attention.py multiway pattern);
+- a Dense call with *no* name at all is flagged directly: auto-named
+  ``Dense_N`` parameters can never be matched by name rules.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.gigalint.astutils import dotted_name, last_segment, str_tuple_literal
+from tools.gigalint.graph import Project
+from tools.gigalint.rules import Finding, register
+from tools.gigalint.walker import ModuleInfo
+
+_DENSE_CTORS = ("Dense", "DenseGeneral")
+
+
+def _sharding_lists(project: Project) -> Tuple[Optional[str], Set[str]]:
+    """(sharding file path, union of column+row parallel names)."""
+    for mod in project.modules.values():
+        names: Set[str] = set()
+        found = False
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name) and tgt.id in (
+                    "_COLUMN_PARALLEL", "_ROW_PARALLEL",
+                    "COLUMN_PARALLEL", "ROW_PARALLEL",
+                ):
+                    vals = str_tuple_literal(node.value)
+                    if vals is not None:
+                        names.update(vals)
+                        found = True
+        if found:
+            return mod.path, names
+    return None, set()
+
+
+def _dense_sites(mod: ModuleInfo) -> List[Tuple[str, int, Optional[str]]]:
+    """[(harvested name | "" for anonymous, lineno, None)] for one module."""
+    sites: List[Tuple[str, int, Optional[str]]] = []
+    # pass 1: literal names, anonymous Denses, and direct factories
+    factories: Set[str] = set()  # local callable names whose name= is a param
+
+    class _Scope(ast.NodeVisitor):
+        def __init__(self):
+            self.param_stack: List[Set[str]] = []
+
+        def _fn(self, node):
+            params = {a.arg for a in node.args.args}
+            self.param_stack.append(params)
+            self.generic_visit(node)
+            self.param_stack.pop()
+
+        visit_FunctionDef = _fn
+        visit_AsyncFunctionDef = _fn
+        visit_Lambda = _fn
+
+        def visit_Call(self, node: ast.Call):
+            fn = dotted_name(node.func)
+            # node.func must be the Dense symbol itself — for the flax
+            # idiom ``nn.Dense(...)(x)`` the OUTER call's func is the
+            # inner Call and must not count as a second (anonymous) site
+            if (
+                fn
+                and not isinstance(node.func, ast.Call)
+                and last_segment(fn) in _DENSE_CTORS
+            ):
+                name_kw = next(
+                    (kw.value for kw in node.keywords if kw.arg == "name"), None
+                )
+                if isinstance(name_kw, ast.Constant) and isinstance(
+                    name_kw.value, str
+                ):
+                    sites.append((name_kw.value, node.lineno, None))
+                elif (
+                    isinstance(name_kw, ast.Name)
+                    and self.param_stack
+                    and any(name_kw.id in p for p in self.param_stack)
+                ):
+                    # name flows from an enclosing callable's parameter:
+                    # remember which local binding is the factory
+                    pass  # resolved below from assignment/def context
+                elif name_kw is None:
+                    sites.append(("", node.lineno, None))
+            self.generic_visit(node)
+
+    _Scope().visit(mod.tree)
+
+    # pass 2: factory bindings — "x = lambda ...: nn.Dense(name=<param>)"
+    # and "def x(...): ... nn.Dense(name=<param>)"
+    def _is_direct_factory(fn_node) -> bool:
+        params = {a.arg for a in fn_node.args.args}
+        body = fn_node.body if isinstance(fn_node.body, list) else [fn_node.body]
+        for sub in ast.walk(ast.Module(body=body, type_ignores=[])):
+            if isinstance(sub, ast.Call):
+                fn = dotted_name(sub.func)
+                if fn and last_segment(fn) in _DENSE_CTORS:
+                    for kw in sub.keywords:
+                        if (
+                            kw.arg == "name"
+                            and isinstance(kw.value, ast.Name)
+                            and kw.value.id in params
+                        ):
+                            return True
+        return False
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Lambda):
+            if _is_direct_factory(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        factories.add(tgt.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if _is_direct_factory(node):
+                factories.add(node.name)
+
+    # pass 3: one level of indirection — a def whose own param rides in a
+    # call that also references a factory (the multiway pattern)
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            params = {a.arg for a in node.args.args}
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                arg_names = {
+                    a.id for a in sub.args if isinstance(a, ast.Name)
+                }
+                if (arg_names & factories) and (arg_names & params):
+                    factories.add(node.name)
+                    break
+
+    # pass 4: literal strings at factory call sites
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            fn = dotted_name(node.func)
+            if fn in factories or (fn and fn.split(".")[-1] in factories):
+                for arg in node.args:
+                    if isinstance(arg, ast.Constant) and isinstance(
+                        arg.value, str
+                    ):
+                        sites.append((arg.value, node.lineno, None))
+    return sites
+
+
+@register(
+    "GL003",
+    "model parameter not covered by the tensor-parallel sharding rules — "
+    "its kernel silently replicates under the model-axis mesh",
+)
+def check_sharding_coverage(project: Project) -> List[Finding]:
+    sharding_path, covered = _sharding_lists(project)
+    findings: List[Finding] = []
+    if sharding_path is None:
+        # No sharding rule file in the scanned set (e.g. linting scripts/
+        # alone) — nothing to cross-check.
+        return findings
+    seen: Dict[str, Tuple[str, int]] = {}
+    anonymous: List[Tuple[str, int]] = []
+    for mod in project.modules.values():
+        for name, lineno, _ in _dense_sites(mod):
+            if name == "":
+                anonymous.append((mod.path, lineno))
+            elif name not in covered and name not in seen:
+                seen[name] = (mod.path, lineno)
+    for name, (path, lineno) in sorted(seen.items()):
+        findings.append(Finding(
+            "GL003", path, lineno, name,
+            f"Dense module '{name}' is in neither _COLUMN_PARALLEL nor "
+            f"_ROW_PARALLEL ({sharding_path}) — its kernel falls through "
+            "to replicated P() on model-parallel meshes",
+        ))
+    for path, lineno in anonymous:
+        findings.append(Finding(
+            "GL003", path, lineno, "<anonymous>",
+            "Dense module without an explicit name= (auto-named Dense_N) "
+            "can never be matched by the name-based sharding rules",
+        ))
+    return findings

@@ -1,0 +1,146 @@
+"""Waiver / per-rule config file, plus inline waiver comments.
+
+File format (default: ``GIGALINT_WAIVERS`` at the repo root), one entry
+per line, ``#`` comments and blanks ignored. Every entry REQUIRES a
+justification after ``--`` — an unexplained waiver is a parse error, so
+intent is always recorded next to the suppression:
+
+    # disable a whole rule
+    disable GL004 -- vendored demo tree predates the style rules
+
+    # waive findings of one rule at a path (fnmatch glob), optionally
+    # narrowed to a symbol substring (function qualname / harvested name)
+    GL003 gigapath_tpu/models/classification_head.py::classifier -- tiny head
+    GL001 gigapath_tpu/ops/*.py -- documented dispatch-level flag reads
+
+Inline form, on the offending line itself:
+
+    x = os.environ.get("X")  # gigalint: waive GL001 -- host-side tool
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.gigalint.rules import Finding
+from tools.gigalint.walker import ModuleInfo
+
+_INLINE_RE = re.compile(
+    r"#\s*gigalint:\s*waive\s+(?P<rules>GL\d{3}(?:\s*,\s*GL\d{3})*)"
+    r"\s*--\s*(?P<reason>\S.*)"
+)
+
+
+@dataclasses.dataclass
+class Waiver:
+    rule: str  # "GL001" or "*"
+    path_glob: str
+    symbol: str  # substring of Finding.symbol; "" matches all
+    reason: str
+    line: int  # line in the waiver file (for unused-waiver reporting)
+    used: bool = False
+
+    def matches(self, f: Finding) -> bool:
+        if self.rule not in ("*", f.rule):
+            return False
+        if self.symbol and self.symbol not in f.symbol:
+            return False
+        glob = self.path_glob
+        if fnmatch.fnmatch(f.path, glob):
+            return True
+        # a bare directory waives everything under it
+        return f.path.startswith(glob.rstrip("/") + "/")
+
+
+@dataclasses.dataclass
+class WaiverConfig:
+    waivers: List[Waiver] = dataclasses.field(default_factory=list)
+    disabled_rules: Dict[str, str] = dataclasses.field(default_factory=dict)
+    errors: List[str] = dataclasses.field(default_factory=list)
+
+    def unused(self) -> List[Waiver]:
+        return [w for w in self.waivers if not w.used]
+
+
+def parse_waiver_file(path: str) -> WaiverConfig:
+    cfg = WaiverConfig()
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            lines = f.read().splitlines()
+    except FileNotFoundError:
+        return cfg
+    for lineno, raw in enumerate(lines, 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if " -- " not in line:
+            cfg.errors.append(
+                f"{path}:{lineno}: waiver entry has no '-- reason' "
+                f"justification: {line!r}"
+            )
+            continue
+        head, reason = line.split(" -- ", 1)
+        reason = reason.strip()
+        parts = head.split()
+        if not reason:
+            cfg.errors.append(f"{path}:{lineno}: empty justification")
+            continue
+        if parts[0] == "disable" and len(parts) == 2:
+            cfg.disabled_rules[parts[1]] = reason
+            continue
+        if len(parts) != 2 or not re.fullmatch(r"GL\d{3}|\*", parts[0]):
+            cfg.errors.append(
+                f"{path}:{lineno}: expected '<rule> <path[::symbol]> -- "
+                f"reason' or 'disable <rule> -- reason', got: {line!r}"
+            )
+            continue
+        target = parts[1]
+        glob, _, symbol = target.partition("::")
+        cfg.waivers.append(Waiver(
+            rule=parts[0], path_glob=glob, symbol=symbol,
+            reason=reason, line=lineno,
+        ))
+    return cfg
+
+
+def inline_waivers(modules: List[ModuleInfo]) -> Dict[Tuple[str, int], Tuple[Set[str], str]]:
+    """{(path, lineno): ({rules}, reason)} from ``# gigalint: waive`` comments."""
+    out: Dict[Tuple[str, int], Tuple[Set[str], str]] = {}
+    for mod in modules:
+        for lineno, text in enumerate(mod.source_lines, 1):
+            m = _INLINE_RE.search(text)
+            if m:
+                rules = {r.strip() for r in m.group("rules").split(",")}
+                out[(mod.path, lineno)] = (rules, m.group("reason").strip())
+    return out
+
+
+def apply_waivers(
+    findings: List[Finding],
+    cfg: WaiverConfig,
+    inline: Dict[Tuple[str, int], Tuple[Set[str], str]],
+) -> Tuple[List[Finding], List[Finding]]:
+    """Split into (active, waived); waived findings carry their reason."""
+    active: List[Finding] = []
+    waived: List[Finding] = []
+    for f in findings:
+        if f.rule in cfg.disabled_rules:
+            f.waived_by = f"rule disabled: {cfg.disabled_rules[f.rule]}"
+            waived.append(f)
+            continue
+        key = (f.path, f.lineno)
+        if key in inline and (f.rule in inline[key][0] or "*" in inline[key][0]):
+            f.waived_by = f"inline: {inline[key][1]}"
+            waived.append(f)
+            continue
+        hit = next((w for w in cfg.waivers if w.matches(f)), None)
+        if hit is not None:
+            hit.used = True
+            f.waived_by = hit.reason
+            waived.append(f)
+        else:
+            active.append(f)
+    return active, waived

@@ -1,0 +1,255 @@
+"""Per-file AST walk: extract the per-function facts the rules consume.
+
+One pass per file produces a :class:`ModuleInfo` holding a
+:class:`FunctionInfo` for every ``def`` (module-level, methods, nested),
+plus the module's import alias table and module-level calls. No imports
+are executed — everything is derived from the AST, so files with
+unavailable dependencies (TPU-only, torch-only) still lint.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.gigalint.astutils import (
+    dotted_name,
+    int_tuple_literal,
+    param_names,
+    str_tuple_literal,
+)
+
+# Call targets whose *first argument* becomes a trace-context root: the
+# callee is traced (and retraced per jit-cache key), so everything it
+# calls runs at trace time.
+TRACING_WRAPPERS = frozenset({
+    "jax.jit", "jit", "jax.pjit", "pjit", "jax.experimental.pjit.pjit",
+    "jax.shard_map", "shard_map", "jax.experimental.shard_map.shard_map",
+    "jax.checkpoint", "jax.remat", "nn.remat",
+    "jax.grad", "jax.value_and_grad", "jax.vmap", "jax.pmap",
+    "jax.linearize", "jax.vjp", "jax.jvp", "jax.make_jaxpr",
+})
+
+# Decorators that make the decorated function's body trace-time code.
+TRACING_DECORATORS = frozenset({
+    "jax.jit", "jit", "jax.pjit", "pjit",
+    "jax.custom_vjp", "jax.custom_jvp", "custom_vjp", "custom_jvp",
+})
+
+_ENV_GET_ATTRS = ("environ.get", "environ.setdefault", "getenv")
+
+
+@dataclasses.dataclass
+class CallSite:
+    callee: str  # textual dotted name, unresolved
+    lineno: int
+
+
+@dataclasses.dataclass(eq=False)  # identity hash: used as graph node key
+class FunctionInfo:
+    module: "ModuleInfo"
+    qualname: str  # dotted within the module: "Cls.meth", "outer.inner"
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    lineno: int
+    class_name: Optional[str]
+    decorators: List[str]
+    calls: List[CallSite]
+    env_reads: List[Tuple[int, str]]  # (lineno, description)
+    contains_pallas: bool
+    params: List[str]
+    # Traced-parameter names for direct trace entries; None = unknown
+    # (e.g. defvjp fwd/bwd pieces, whose static split is implicit).
+    traced_params: Optional[List[str]]
+    is_trace_decorated: bool
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+    @property
+    def location(self) -> str:
+        return f"{self.module.path}:{self.lineno}"
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    path: str  # repo-relative posix path
+    modname: str  # dotted module name ("gigapath_tpu.ops.common")
+    tree: ast.Module
+    source_lines: List[str]
+    functions: Dict[str, FunctionInfo] = dataclasses.field(default_factory=dict)
+    # local alias -> dotted target ("np" -> "numpy", "pdm" -> "pkg.mod",
+    # "env_flag" -> "pkg.ops.common.env_flag")
+    imports: Dict[str, str] = dataclasses.field(default_factory=dict)
+    module_calls: List[CallSite] = dataclasses.field(default_factory=list)
+    # (fwd_name, bwd_name, lineno) from ``primal.defvjp(fwd, bwd)``
+    defvjp_pairs: List[Tuple[str, str, int]] = dataclasses.field(default_factory=list)
+    # functions referenced as the first arg of a tracing wrapper call
+    wrapped_refs: List[Tuple[str, int]] = dataclasses.field(default_factory=list)
+
+    @property
+    def is_test_file(self) -> bool:
+        base = self.path.rsplit("/", 1)[-1]
+        return base.startswith("test_") and base.endswith(".py")
+
+
+def _env_read_of(call: ast.Call) -> Optional[str]:
+    """Describe an environment read performed by this call, if any."""
+    fn = dotted_name(call.func)
+    if not fn:
+        return None
+    if fn == "os.getenv" or any(fn.endswith(a) for a in _ENV_GET_ATTRS):
+        # os.environ.get / os.getenv / environ.get under any alias
+        if "environ" in fn or fn.endswith("getenv"):
+            return fn
+    return None
+
+
+class _Collector(ast.NodeVisitor):
+    """Single traversal assigning every Call/def to its enclosing scope."""
+
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        self._scope: List[str] = []  # qualname parts
+        self._class: List[str] = []
+        self._fn_stack: List[FunctionInfo] = []
+
+    # -- imports ---------------------------------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            local = alias.asname or alias.name.split(".")[0]
+            target = alias.name if alias.asname else alias.name.split(".")[0]
+            self.mod.imports[local] = target
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        base = node.module or ""
+        if node.level:  # relative: resolve against this module's package
+            pkg_parts = self.mod.modname.split(".")[: -node.level]
+            base = ".".join(pkg_parts + ([node.module] if node.module else []))
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            local = alias.asname or alias.name
+            self.mod.imports[local] = f"{base}.{alias.name}" if base else alias.name
+        self.generic_visit(node)
+
+    # -- scopes ----------------------------------------------------------
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._scope.append(node.name)
+        self._class.append(node.name)
+        self.generic_visit(node)
+        self._class.pop()
+        self._scope.pop()
+
+    def _visit_function(self, node) -> None:
+        qual = ".".join(self._scope + [node.name])
+        decos = [d for d in (dotted_name(d) for d in node.decorator_list) if d]
+        info = FunctionInfo(
+            module=self.mod,
+            qualname=qual,
+            node=node,
+            lineno=node.lineno,
+            class_name=self._class[-1] if self._class else None,
+            decorators=decos,
+            calls=[],
+            env_reads=[],
+            contains_pallas=False,
+            params=param_names(node),
+            traced_params=None,
+            is_trace_decorated=any(d in TRACING_DECORATORS for d in decos),
+        )
+        if info.is_trace_decorated:
+            info.traced_params = _traced_params(node, decos)
+        self.mod.functions[qual] = info
+        self._scope.append(node.name)
+        self._fn_stack.append(info)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+        self._scope.pop()
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    # -- facts -----------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = dotted_name(node.func)
+        here = self._fn_stack[-1] if self._fn_stack else None
+        if fn:
+            site = CallSite(callee=fn, lineno=node.lineno)
+            (here.calls if here else self.mod.module_calls).append(site)
+            if fn.endswith("pallas_call") and here:
+                here.contains_pallas = True
+            if fn.endswith(".defvjp") and len(node.args) >= 2:
+                fwd = dotted_name(node.args[0])
+                bwd = dotted_name(node.args[1])
+                if fwd and bwd:
+                    self.mod.defvjp_pairs.append((fwd, bwd, node.lineno))
+            if fn in TRACING_WRAPPERS and node.args:
+                target = dotted_name(node.args[0])
+                if target:
+                    self.mod.wrapped_refs.append((target, node.lineno))
+            env = _env_read_of(node)
+            if env and here:
+                here.env_reads.append((node.lineno, env))
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        # os.environ["X"] reads (Load context only; writes are host-side
+        # configuration, not a trace hazard by themselves)
+        if isinstance(node.ctx, ast.Load):
+            base = dotted_name(node.value)
+            if base and base.endswith("environ") and self._fn_stack:
+                self._fn_stack[-1].env_reads.append(
+                    (node.lineno, f"{base}[...]")
+                )
+        self.generic_visit(node)
+
+
+def _traced_params(node, decos: List[str]) -> Optional[List[str]]:
+    """Which parameters are tracers when this function is a direct trace
+    entry. jit: all params minus static_argnums/static_argnames;
+    custom_vjp: all minus nondiff_argnums. None when the split cannot be
+    determined statically."""
+    params = param_names(node)
+    static: Set[str] = set()
+    for deco in node.decorator_list:
+        if not isinstance(deco, ast.Call):
+            continue
+        effective = dotted_name(deco)
+        if effective not in TRACING_DECORATORS:
+            continue
+        for kw in deco.keywords:
+            if kw.arg in ("static_argnums", "nondiff_argnums"):
+                nums = int_tuple_literal(kw.value)
+                if nums is None:
+                    return None
+                for i in nums:
+                    if i < len(params):
+                        static.add(params[i])
+            elif kw.arg == "static_argnames":
+                names = str_tuple_literal(kw.value)
+                if names is None and isinstance(kw.value, ast.Constant):
+                    names = [kw.value.value]
+                if names is None:
+                    return None
+                static.update(names)
+    return [p for p in params if p not in static]
+
+
+def parse_module(path: str, rel_path: str, modname: str) -> ModuleInfo:
+    """Parse one file into a ModuleInfo. Raises on unreadable/unparseable
+    input (SyntaxError, ValueError on null bytes, UnicodeDecodeError) —
+    the CLI converts those into per-file GL000 errors and keeps going."""
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    tree = ast.parse(source, filename=path)
+    mod = ModuleInfo(
+        path=rel_path,
+        modname=modname,
+        tree=tree,
+        source_lines=source.splitlines(),
+    )
+    _Collector(mod).visit(tree)
+    return mod
