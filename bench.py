@@ -39,7 +39,10 @@ count the baseline is computed from, so the two stay comparable);
 ``tile_mfu`` prefers compiled-HLO cost analysis and falls back to the
 analytic ViT count.
 
-Prints exactly one JSON line.
+Prints exactly one JSON line on stdout. An obs telemetry stream
+(run_start/step/run_end events, gigapath_tpu.obs schema) rides stderr and
+appends to BENCH_OBS.jsonl — every BENCH_LOCAL.json snapshot write lands
+there as a run_end event, so stale-number provenance is queryable.
 """
 
 import json
@@ -56,6 +59,12 @@ import numpy as np
 # failure mode: two rounds of engineering invisible to the driver because
 # one flaky tunnel RPC zeroed the record).
 LOCAL_SNAPSHOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_LOCAL.json")
+
+# Append-only telemetry stream (gigapath_tpu.obs schema): every bench run
+# emits run_start/step/run_end events here — including a run_end carrying
+# each BENCH_LOCAL.json snapshot write, so stale-number provenance is
+# queryable long after the one-line stdout contract scrolled away.
+OBS_STREAM = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_OBS.jsonl")
 
 N = 10240
 TILE_BATCH = 128  # reference pipeline.py:141
@@ -241,18 +250,25 @@ def bench_tile_encoder(peak_flops: float):
     return tiles_per_sec, mfu, baseline_tiles_per_sec, mfu_source
 
 
-def run_bench() -> dict:
+def run_bench(runlog=None) -> dict:
     import jax
 
     from gigapath_tpu.models import slide_encoder
+    from gigapath_tpu.obs import NullRunLog
     from gigapath_tpu.utils.profiling import compiled_memory
     from gigapath_tpu.utils.timing import chained_seconds_per_iter
+
+    runlog = runlog if runlog is not None else NullRunLog(driver="bench")
 
     # retried init FIRST, unconditionally: with TPU_PEAK_FLOPS set,
     # chip_peak_flops alone would never touch jax and the first (un-retried)
     # backend init would happen inside model creation — the BENCH_r04 mode
-    acquire_backend()
+    devices = acquire_backend()
     peak = chip_peak_flops()
+    runlog.event(
+        "heartbeat", phase="backend_up", device_kind=devices[0].device_kind,
+        device_count=len(devices), peak_flops=peak,
+    )
 
     model, params = slide_encoder.create_model(
         "", "gigapath_slide_enc12l768d", in_chans=1536, dtype=jnp.bfloat16
@@ -270,6 +286,8 @@ def run_bench() -> dict:
     sec_per_iter, overhead = chained_seconds_per_iter(step, x, args=(params, coords))
     tokens_per_sec = N / sec_per_iter
     mfu = (workload_flops(N) / sec_per_iter) / peak
+    runlog.step(0, wall_s=sec_per_iter, synced=True, workload="slide_forward",
+                tokens_per_sec=tokens_per_sec, mfu=mfu)
 
     mem = compiled_memory(
         lambda x, p: model.apply({"params": p}, x, coords)[0], x, params
@@ -294,18 +312,24 @@ def run_bench() -> dict:
         train_step, x, args=(params, coords), iters_low=2, iters_high=8
     )
     train_tokens_per_sec = N / sec_train
+    runlog.step(1, wall_s=sec_train, synced=True, workload="slide_train",
+                tokens_per_sec=train_tokens_per_sec)
 
     try:
         tile_tiles_per_sec, tile_mfu, tile_baseline, tile_mfu_source = (
             bench_tile_encoder(peak)
         )
         tile_vs_baseline = round(tile_tiles_per_sec / tile_baseline, 3)
+        runlog.step(2, wall_s=TILE_BATCH / tile_tiles_per_sec, synced=True,
+                    workload="tile_forward", tiles_per_sec=tile_tiles_per_sec,
+                    mfu=tile_mfu)
         tile_tiles_per_sec = round(tile_tiles_per_sec, 1)
         tile_mfu = round(tile_mfu, 3)
         tile_baseline = round(tile_baseline, 1)
     except Exception as e:  # the headline metric must survive a tile failure
         # stderr: stdout is contractually exactly one JSON line
         print(f"tile-encoder bench failed: {type(e).__name__}: {e}", file=sys.stderr)
+        runlog.error("bench.tile_encoder", e)
         tile_tiles_per_sec, tile_mfu, tile_baseline, tile_vs_baseline = (
             None, None, None, None,
         )
@@ -343,12 +367,23 @@ def main():
     "here is the last measured number, clearly labeled" — never to
     "unmeasured number that looks fresh".
     """
+    from gigapath_tpu.obs import get_run_log
+
+    # telemetry stream rides stderr + BENCH_OBS.jsonl: stdout stays the
+    # one contractual JSON line. probe_devices=False — backend init is
+    # acquire_backend's hang-proofed job, never the manifest's.
+    runlog = get_run_log(
+        "bench", path=OBS_STREAM, echo_stream=sys.stderr, probe_devices=False,
+        config={"n_tokens": N, "tile_batch": TILE_BATCH,
+                "baseline_version": BASELINE_VERSION},
+    )
     try:
-        payload = run_bench()
+        payload = run_bench(runlog)
     except Exception as e:  # noqa: BLE001 — contract: always print the JSON line
         import traceback
 
         traceback.print_exc(file=sys.stderr)
+        runlog.error("bench.run_bench", e)
         payload = {
             "metric": "slide_embed_tokens_per_sec",
             "value": None,
@@ -369,15 +404,29 @@ def main():
                 payload["last_good_snapshot_utc"] = snap.get("snapshot_utc")
             except Exception as snap_err:
                 print(f"bench: snapshot unreadable: {snap_err}", file=sys.stderr)
+        runlog.run_end(
+            status="error", error=payload["error"],
+            stale=payload.get("stale", False),
+            last_good_value=payload.get("last_good_value"),
+            last_good_snapshot_utc=payload.get("last_good_snapshot_utc"),
+        )
         print(json.dumps(payload))
         return
     payload["snapshot_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    snapshot_written = True
     try:
         with open(LOCAL_SNAPSHOT, "w") as f:
             json.dump(payload, f, indent=1)
             f.write("\n")
     except Exception as snap_err:
+        snapshot_written = False
         print(f"bench: snapshot write failed: {snap_err}", file=sys.stderr)
+    # the snapshot write IS an event: stale-number provenance stays
+    # queryable from the obs stream even after later runs overwrite it
+    runlog.run_end(
+        status="ok", snapshot_path=LOCAL_SNAPSHOT,
+        snapshot_written=snapshot_written, **payload,
+    )
     print(json.dumps(payload))
 
 

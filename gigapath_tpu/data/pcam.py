@@ -16,6 +16,8 @@ from typing import Dict
 
 import numpy as np
 
+from gigapath_tpu.obs import console
+
 
 class Processor:
     """Zip reader (reference ``Processor:329-347``)."""
@@ -28,7 +30,7 @@ class Processor:
 
         loaded = {}
         with zipfile.ZipFile(zip_path, "r") as zip_ref:
-            print(len(zip_ref.infolist()))
+            console(str(len(zip_ref.infolist())))
             for file_info in zip_ref.infolist():
                 name = file_info.filename
                 if name.endswith(".pt") and split in name:

@@ -18,6 +18,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from gigapath_tpu.obs import console
+
 
 def read_assets_from_h5(h5_path: str) -> Tuple[Dict[str, np.ndarray], Dict]:
     """Read every dataset (and its attrs) from an h5 file."""
@@ -62,7 +64,7 @@ class SlideDatasetForTasks:
         self.setup_data(data_df, splits, task_config.get("setting", "multi_class"))
         self.max_tiles = task_config.get("max_tiles", 1000)
         self.shuffle_tiles = task_config.get("shuffle_tiles", False)
-        print("Dataset has been initialized!")
+        console("Dataset has been initialized!")
 
     def _slide_filename(self, slide_id: str) -> str:
         ext = ".pt" if "pt_files" in self.root_path.split("/")[-1] else ".h5"
@@ -74,7 +76,7 @@ class SlideDatasetForTasks:
             ext = ".pt" if "pt_files" in root_path.split("/")[-1] else ".h5"
             path = os.path.join(root_path, slide_id.replace(".svs", "") + ext)
             if not os.path.exists(path):
-                print("Missing: ", path)
+                console(f"Missing:  {path}")
             else:
                 valid.append(slide_id)
         return valid
@@ -163,9 +165,9 @@ class SlideDataset(SlideDatasetForTasks):
             try:
                 return self.get_one_sample(idx)
             except Exception:
-                print("Error in getting the sample, try another index")
+                console("Error in getting the sample, try another index")
                 idx = int(self._rng.integers(0, len(self.slide_data)))
-        print("Error in getting the sample, skip the sample")
+        console("Error in getting the sample, skip the sample")
         return None
 
     def __len__(self) -> int:

@@ -14,6 +14,8 @@ from typing import Optional
 
 import numpy as np
 
+from gigapath_tpu.obs import console
+
 
 def main(argv: Optional[list] = None) -> dict:
     import pandas as pd
@@ -27,29 +29,29 @@ def main(argv: Optional[list] = None) -> dict:
     from gigapath_tpu.finetune.utils import get_exp_code, seed_everything
 
     args = get_finetune_params(argv)
-    print(args)
+    console(str(args))
 
     seed_everything(args.seed)
 
-    print("Loading task configuration from: {}".format(args.task_cfg_path))
+    console("Loading task configuration from: {}".format(args.task_cfg_path))
     args.task_config = load_task_config(args.task_cfg_path)
-    print(args.task_config)
+    console(str(args.task_config))
     args.task = args.task_config.get("name", "task")
 
     args.save_dir = os.path.join(args.save_dir, args.task, args.exp_name)
     args.model_code, args.task_code, args.exp_code = get_exp_code(args)
     args.save_dir = os.path.join(args.save_dir, args.exp_code)
     os.makedirs(args.save_dir, exist_ok=True)
-    print("Experiment code: {}".format(args.exp_code))
-    print("Setting save directory: {}".format(args.save_dir))
+    console("Experiment code: {}".format(args.exp_code))
+    console("Setting save directory: {}".format(args.save_dir))
 
     eff_batch_size = args.batch_size * args.gc
     if args.lr is None or args.lr < 0:
         args.lr = args.blr * eff_batch_size / 256
-    print("base lr: %.2e" % (args.lr * 256 / eff_batch_size))
-    print("actual lr: %.2e" % args.lr)
-    print("accumulate grad iterations: %d" % args.gc)
-    print("effective batch size: %d" % eff_batch_size)
+    console("base lr: %.2e" % (args.lr * 256 / eff_batch_size))
+    console("actual lr: %.2e" % args.lr)
+    console("accumulate grad iterations: %d" % args.gc)
+    console("effective batch size: %d" % eff_batch_size)
 
     args.split_key = "pat_id" if args.pat_strat else "slide_id"
 
@@ -59,7 +61,7 @@ def main(argv: Optional[list] = None) -> dict:
         else args.pre_split_dir
     )
     os.makedirs(args.split_dir, exist_ok=True)
-    print("Setting split directory: {}".format(args.split_dir))
+    console("Setting split directory: {}".format(args.split_dir))
     dataset = pd.read_csv(args.dataset_csv)
 
     results: dict = {}
@@ -106,13 +108,13 @@ def main(argv: Optional[list] = None) -> dict:
     results_df = pd.DataFrame(results)
     results_df.to_csv(os.path.join(args.save_dir, "summary.csv"), index=False)
     for key in results_df.columns:
-        print(
+        console(
             "{}: {:.4f} +- {:.4f}".format(
                 key, np.mean(results_df[key]), np.std(results_df[key])
             )
         )
-    print("Results saved in: {}".format(os.path.join(args.save_dir, "summary.csv")))
-    print("Done!")
+    console("Results saved in: {}".format(os.path.join(args.save_dir, "summary.csv")))
+    console("Done!")
     return results
 
 

@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from gigapath_tpu.obs import console
+
 
 def _load_params_into_model(checkpoint_path: str, params):
     """Orbax dir or torch .pt -> params (non-strict, with key remap)."""
@@ -45,7 +47,7 @@ def _load_params_into_model(checkpoint_path: str, params):
         params["slide_encoder"], missing, unexpected = merge_into_params(
             params["slide_encoder"], convert_state_dict(enc_state)
         )
-        print(f"slide_encoder loaded ({len(missing)} missing, {len(unexpected)} unexpected)")
+        console(f"slide_encoder loaded ({len(missing)} missing, {len(unexpected)} unexpected)")
     cls_state = {
         k[len("classifier."):]: v
         for k, v in state_dict.items()
@@ -58,7 +60,7 @@ def _load_params_into_model(checkpoint_path: str, params):
         params["classifier"], missing, unexpected = merge_into_params(
             params["classifier"], converted
         )
-        print(f"classifier loaded ({len(missing)} missing, {len(unexpected)} unexpected)")
+        console(f"classifier loaded ({len(missing)} missing, {len(unexpected)} unexpected)")
     return params
 
 
@@ -90,18 +92,18 @@ def predict(
     args.task_cfg_path = task_cfg_path
     args.save_dir = save_dir
     args.exp_name = exp_name
-    print("Prediction arguments:")
-    print(args)
+    console("Prediction arguments:")
+    console(str(args))
 
     seed_everything(args.seed)
-    print("Loading task configuration from: {}".format(args.task_cfg_path))
+    console("Loading task configuration from: {}".format(args.task_cfg_path))
     args.task_config = load_task_config(args.task_cfg_path)
     args.task = args.task_config.get("name", "task")
     args.model_arch = args.task_config.get("model_arch", args.model_arch)
 
     args.save_dir = os.path.join(args.save_dir, args.task, args.exp_name, "predictions")
     os.makedirs(args.save_dir, exist_ok=True)
-    print("Setting save directory for predictions: {}".format(args.save_dir))
+    console("Setting save directory for predictions: {}".format(args.save_dir))
 
     dataset = pd.read_csv(args.dataset_csv)
     predict_data = SlideDataset(
@@ -112,7 +114,7 @@ def predict(
         split_key="slide_id",
     )
     args.n_classes = predict_data.n_classes
-    print(f"Number of classes: {args.n_classes}")
+    console(f"Number of classes: {args.n_classes}")
     # sequential order (the train slot of get_loader shuffles)
     from gigapath_tpu.data.loader import DataLoader
 
@@ -129,7 +131,7 @@ def predict(
         dropout=args.dropout,
         drop_path_rate=args.drop_path_rate,
     )
-    print("Loading checkpoint from: {}".format(checkpoint_path))
+    console("Loading checkpoint from: {}".format(checkpoint_path))
     params = _load_params_into_model(checkpoint_path, params)
 
     @jax.jit
@@ -142,7 +144,7 @@ def predict(
     results = []
     for batch_idx, batch in enumerate(predict_loader):
         if max_batches is not None and batch_idx >= max_batches:
-            print(f"Stopping after {max_batches} batches as requested")
+            console(f"Stopping after {max_batches} batches as requested")
             break
         logits = forward(
             params,
@@ -163,12 +165,12 @@ def predict(
                     "probabilities": probs[i].tolist(),
                 }
             )
-        print(f"Batch {batch_idx + 1}/{len(predict_loader)} processed.")
+        console(f"Batch {batch_idx + 1}/{len(predict_loader)} processed.")
 
     results_df = pd.DataFrame(results)
     output_csv_path = os.path.join(args.save_dir, "predictions.csv")
     results_df.to_csv(output_csv_path, index=False)
-    print("Predictions saved in: {}".format(output_csv_path))
-    print("Done with prediction!")
-    print(f"Elapsed: {time.time() - start_time:.4f} s")
+    console("Predictions saved in: {}".format(output_csv_path))
+    console("Done with prediction!")
+    console(f"Elapsed: {time.time() - start_time:.4f} s")
     return results_df

@@ -4,20 +4,29 @@ Parity with reference ``finetune/training.py:130-337``: per-fold training
 with layer-decay AdamW, per-iteration cosine warmup, gradient accumulation
 (``gc``), per-epoch eval, best-val-AUROC or last-epoch model selection,
 checkpoint reload, final test; ``sec/it`` + running mean sequence length
-printed every 20 iterations (``training.py:278-282``); model statistics at
+echoed every 20 iterations (``training.py:278-282``); model statistics at
 startup (param counts by module type + compiled FLOPs — the jax
 ``cost_analysis`` replacing thop, ``training.py:23-127``).
 
 TPU shape: one jitted ``train_step(params, opt_state, batch, rng)`` closure;
 bf16 activations replace the fp16 GradScaler; batches arrive
 bucket-padded from the collate so the step retraces only O(log L) times.
+
+Observability: every run appends schema-versioned JSONL events (step
+timings + in-graph loss/grad-norm/param-norm scalars, compile/retrace
+accounting via ``CompileWatchdog``, eval metrics, heartbeat/stall
+liveness) to a per-run file under ``<save_dir>/fold_k/obs/`` — fold it
+into a report with ``scripts/obs_report.py``. Console output goes
+through the RunLog echo (one format across drivers, wall time + step
+included); ``GIGAPATH_OBS=0`` disables the event stream but keeps the
+echo.
 """
 
 from __future__ import annotations
 
 import os
 import time
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -32,6 +41,8 @@ from gigapath_tpu.finetune.utils import (
     make_writer,
 )
 from gigapath_tpu.models.classification_head import get_model
+from gigapath_tpu.obs import CompileWatchdog, Heartbeat, NullRunLog, get_run_log
+from gigapath_tpu.obs.telemetry import step_scalars
 from gigapath_tpu.utils.checkpoint import MonitorScore, restore_checkpoint, save_checkpoint
 
 
@@ -80,12 +91,26 @@ def _prefetched(loader, bf16: bool = False):
     return DevicePrefetcher(loader, depth=2, bf16_keys=("imgs",) if bf16 else ())
 
 
+def _obs_config(args) -> dict:
+    """JSON-safe slice of the run config for the run_start manifest."""
+    return {
+        k: v
+        for k, v in sorted(vars(args).items())
+        if isinstance(v, (str, int, float, bool)) or v is None
+    }
+
+
 def train(dataloader, fold: int, args):
     """Train one fold; returns ``(val_records, test_records)``
     (reference ``train:130``)."""
     train_loader, val_loader, test_loader = dataloader
     writer_dir = os.path.join(args.save_dir, f"fold_{fold}", "tensorboard")
     writer, report_to = make_writer(args.report_to, writer_dir, args)
+
+    fold_dir = os.path.join(args.save_dir, f"fold_{fold}")
+    # GIGAPATH_OBS is read HERE, once, at driver start — never at trace
+    # time (gigalint GL001): the event stream lands under fold_dir/obs/
+    runlog = get_run_log("finetune", out_dir=fold_dir, config=_obs_config(args))
 
     dtype = jnp.bfloat16 if getattr(args, "bf16", True) else None
     model, params = get_model(
@@ -105,9 +130,9 @@ def train(dataloader, fold: int, args):
         checkpoint_activations=getattr(args, "checkpoint_activations", False),
     )
     stats = count_model_statistics(model, params)
-    print(f"Model statistics: {stats['total_params']:,} params")
+    runlog.echo(f"Model statistics: {stats['total_params']:,} params")
     for mod, n in stats["params_by_module"].items():
-        print(f"  - {mod}: {n:,}")
+        runlog.echo(f"  - {mod}: {n:,}")
 
     # reference: model.slide_encoder.encoder.num_layers + 1 (utils.py:217)
     enc_layers = [
@@ -156,7 +181,10 @@ def train(dataloader, fold: int, args):
         )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = jax.tree.map(lambda p, u: p + u, params, updates)
-        return params, opt_state, loss
+        # in-graph telemetry: a few extra reductions in the same XLA
+        # program, resolved host-side only at existing sync points
+        tel = step_scalars(grads=grads, params=params)
+        return params, opt_state, loss, tel
 
     @jax.jit
     def eval_step(params, images, coords, pad_mask):
@@ -164,133 +192,114 @@ def train(dataloader, fold: int, args):
             {"params": params}, images, coords, pad_mask=pad_mask, deterministic=True
         )
 
-    print(f"Training on {len(train_loader.dataset)} samples")
+    runlog.echo(f"Training on {len(train_loader.dataset)} samples")
     if val_loader is not None:
-        print(f"Validating on {len(val_loader.dataset)} samples")
+        runlog.echo(f"Validating on {len(val_loader.dataset)} samples")
     if test_loader is not None:
-        print(f"Testing on {len(test_loader.dataset)} samples")
-    print("Training starts!")
+        runlog.echo(f"Testing on {len(test_loader.dataset)} samples")
+    runlog.echo("Training starts!")
 
-    fold_dir = os.path.join(args.save_dir, f"fold_{fold}")
     ckpt_path = os.path.join(fold_dir, "checkpoint")
     rng = jax.random.PRNGKey(args.seed)
     val_records, test_records = None, None
 
-    compile_log = BucketCompileLog("train_step")
-    for epoch in range(args.epochs):
-        print(f"Epoch: {epoch}")
-        rng, epoch_rng = jax.random.split(rng)
-        params, opt_state, train_records = train_one_epoch(
-            train_loader, train_step, params, opt_state, epoch, epoch_rng, args,
-            compile_log=compile_log,
-        )
+    compile_log = CompileWatchdog("train_step", runlog, fn=train_step)
+    heartbeat = Heartbeat(
+        runlog,
+        interval_s=float(getattr(args, "obs_heartbeat_s", 60.0)),
+        stall_after_s=float(getattr(args, "obs_stall_s", 600.0)),
+        name="finetune",
+    )
+    try:
+        with heartbeat:
+            for epoch in range(args.epochs):
+                runlog.echo(f"Epoch: {epoch}")
+                rng, epoch_rng = jax.random.split(rng)
+                params, opt_state, train_records = train_one_epoch(
+                    train_loader, train_step, params, opt_state, epoch,
+                    epoch_rng, args, compile_log=compile_log, runlog=runlog,
+                    heartbeat=heartbeat,
+                )
 
-        if val_loader is not None:
-            val_records = evaluate(val_loader, eval_step, params, loss_fn, epoch, args)
-            log_dict = {
-                "train_" + k: v
-                for k, v in train_records.items()
-                if "prob" not in k and "label" not in k
-            }
-            log_dict.update(
-                {
-                    "val_" + k: v
-                    for k, v in val_records.items()
-                    if "prob" not in k and "label" not in k
-                }
+                if val_loader is not None:
+                    val_records = evaluate(
+                        val_loader, eval_step, params, loss_fn, epoch, args,
+                        runlog=runlog, heartbeat=heartbeat,
+                    )
+                    log_dict = {
+                        "train_" + k: v
+                        for k, v in train_records.items()
+                        if "prob" not in k and "label" not in k
+                    }
+                    log_dict.update(
+                        {
+                            "val_" + k: v
+                            for k, v in val_records.items()
+                            if "prob" not in k and "label" not in k
+                        }
+                    )
+                    log_writer(log_dict, epoch, report_to, writer)
+                    score = val_records["macro_auroc"]
+
+                if args.model_select == "val" and val_loader is not None:
+                    monitor(score, {"params": jax.device_get(params)}, ckpt_path)
+                elif args.model_select == "last_epoch" and epoch == args.epochs - 1:
+                    save_checkpoint(ckpt_path, {"params": jax.device_get(params)})
+
+            # still inside the heartbeat scope: the final test pass blocks
+            # on the device too (fresh eval_step compiles for unseen
+            # buckets) and must not be a stall-monitoring blind spot
+            params = restore_checkpoint(ckpt_path, {"params": jax.device_get(params)})["params"]
+            test_records = evaluate(
+                test_loader, eval_step, params, loss_fn, args.epochs, args,
+                runlog=runlog, heartbeat=heartbeat,
             )
-            log_writer(log_dict, epoch, report_to, writer)
-            score = val_records["macro_auroc"]
 
-        if args.model_select == "val" and val_loader is not None:
-            monitor(score, {"params": jax.device_get(params)}, ckpt_path)
-        elif args.model_select == "last_epoch" and epoch == args.epochs - 1:
-            save_checkpoint(ckpt_path, {"params": jax.device_get(params)})
+        log_dict = {
+            "test_" + k: v
+            for k, v in test_records.items()
+            if "prob" not in k and "label" not in k
+        }
+        log_writer(log_dict, fold, report_to, writer)
+        if report_to == "wandb":
+            writer.finish()
+    except Exception as e:
+        runlog.error("finetune.train", e)
+        runlog.run_end(status="error")
+        raise
 
-    params = restore_checkpoint(ckpt_path, {"params": jax.device_get(params)})["params"]
-    test_records = evaluate(test_loader, eval_step, params, loss_fn, args.epochs, args)
-    log_dict = {
-        "test_" + k: v
-        for k, v in test_records.items()
-        if "prob" not in k and "label" not in k
-    }
-    log_writer(log_dict, fold, report_to, writer)
-    if report_to == "wandb":
-        writer.finish()
-
+    runlog.run_end(
+        status="ok",
+        fold=fold,
+        test_macro_auroc=float(test_records.get("macro_auroc", float("nan"))),
+        compile_seconds_total=compile_log.compile_seconds_total(),
+        stalls=heartbeat.stall_count,
+    )
     return val_records, test_records
-
-
-class BucketCompileLog:
-    """Tracks jit retraces per padded-bucket length.
-
-    Bucketed collate bounds retraces to O(log L), but each new bucket's
-    first step silently pays a full XLA compile — a PANDA epoch's first
-    pass looks mysteriously slow without this (observability the
-    reference's ``sec/it`` print effectively had, since eager torch never
-    pauses to compile). Logs the first-call cost per bucket and keeps
-    per-bucket step-time running means for the epoch summary.
-    """
-
-    def __init__(self, name: str):
-        self.name = name
-        self.first_call_sec: Dict[tuple, float] = {}
-        self.step_sec: Dict[tuple, list] = {}
-        self._counts: Dict[tuple, int] = {}  # untimed (async) steady steps
-
-    def is_new(self, bucket: tuple) -> bool:
-        return bucket not in self.first_call_sec
-
-    def record(self, bucket: tuple, seconds: Optional[float]) -> None:
-        # bucket = (batch, padded_len): a short last batch retraces too, and
-        # must not be filed as a steady step of the full-batch bucket.
-        # seconds=None marks a steady (async-dispatched, unsynced) step:
-        # counted, not timed — the loop only blocks on new buckets and at
-        # the 20-iteration prints, whose sec/it is the steady-state number.
-        if self.is_new(bucket):
-            self.first_call_sec[bucket] = seconds if seconds is not None else 0.0
-            print(
-                f"[compile] {self.name} bucket B x L={bucket}: first call "
-                f"{self.first_call_sec[bucket]:.2f}s (compile+run); "
-                f"{len(self.first_call_sec)} bucket(s) compiled"
-            )
-        elif seconds is not None:
-            self.step_sec.setdefault(bucket, []).append(seconds)
-        else:
-            self._counts[bucket] = self._counts.get(bucket, 0) + 1
-
-    def summary(self) -> str:
-        parts = []
-        counts = self._counts
-        for bucket in sorted(self.first_call_sec):
-            steps = self.step_sec.get(bucket, [])
-            n = len(steps) or counts.get(bucket, 0)
-            timing = (
-                f" @ {sum(steps) / len(steps):.3f}s" if steps else ""
-            )
-            parts.append(
-                f"BxL={bucket}: compile {self.first_call_sec[bucket]:.2f}s, "
-                f"{n} steady steps{timing}"
-            )
-        return f"[compile] {self.name} buckets — " + "; ".join(parts)
 
 
 def train_one_epoch(
     train_loader, train_step, params, opt_state, epoch, rng, args,
-    compile_log: Optional[BucketCompileLog] = None,
+    compile_log: Optional[CompileWatchdog] = None,
+    runlog=None,
+    heartbeat: Optional[Heartbeat] = None,
 ):
     """One epoch (reference ``train_one_epoch:223``); per-iteration LR rides
     inside the optimizer schedule."""
+    runlog = runlog if runlog is not None else NullRunLog(driver="finetune")
     start_time = time.time()
     seq_len = 0
     records = get_records_array(len(train_loader), args.n_classes)
     n_batches = 0
+    steps_per_epoch = len(train_loader)
     # Device-side loss accumulator + async dispatch: the loop blocks only
-    # on a bucket's first (compiling) step and at the 20-iteration prints.
+    # on a bucket's first (compiling) step and at the 20-iteration echoes.
     # A per-iteration float(loss) cost ~0.13 s of dispatch+sync over this
     # environment's device tunnel (scripts/exp_trainharness.py), on top of
     # serializing the input transfer the prefetcher now overlaps.
     loss_sum = None
+    tel = None  # latest step's in-graph scalars (device arrays, unsynced)
+    t_prev = start_time
 
     for batch_idx, batch in enumerate(
         # getattr default MUST match model creation above (dtype line in
@@ -301,13 +310,14 @@ def train_one_epoch(
         seq_len += images.shape[1]
         rng, step_rng = jax.random.split(rng)
         bucket = tuple(images.shape[:2])
+        global_step = epoch * steps_per_epoch + batch_idx
         new_bucket = compile_log is not None and compile_log.is_new(bucket)
         if new_bucket and loss_sum is not None:
             # drain the async queue first, or every pending step's runtime
             # gets billed to this bucket's "first call" compile number
             jax.block_until_ready(loss_sum)
         t0 = time.time()
-        params, opt_state, loss = train_step(
+        params, opt_state, loss, tel = train_step(
             params, opt_state, images, coords, labels, pad_mask, step_rng
         )
         if new_bucket:
@@ -320,11 +330,31 @@ def train_one_epoch(
         loss32 = loss.astype(jnp.float32)
         loss_sum = loss32 if loss_sum is None else loss_sum + loss32
         n_batches += 1
+        if heartbeat is not None:
+            heartbeat.beat(global_step)
 
         if (batch_idx + 1) % 20 == 0:
             running_loss = float(loss_sum)  # sync point: bounds queue depth
-            time_per_it = (time.time() - start_time) / (batch_idx + 1)
-            print(
+            # timestamp AFTER the drain: the synced step's wall_s carries
+            # the queued device work it just waited for — these are the
+            # events obs_report calls device truth
+            t_now = time.time()
+            time_per_it = (t_now - start_time) / (batch_idx + 1)
+            # tel's device arrays are materialized by the sync above —
+            # reading them here costs no extra round-trip
+            scalars = {k: float(np.asarray(v)) for k, v in tel.items()}
+            runlog.step(
+                global_step,
+                wall_s=round(t_now - t_prev, 6),
+                synced=True,
+                epoch=epoch,
+                bucket=str(bucket),
+                loss=running_loss / (batch_idx + 1),
+                sec_per_it=time_per_it,
+                seq_len=seq_len / (batch_idx + 1),
+                **scalars,
+            )
+            runlog.echo(
                 "Epoch: {}, Batch: {}, Loss: {:.4f}, Time: {:.4f} sec/it, "
                 "Seq len: {:.1f}, Slide ID: {}".format(
                     epoch,
@@ -333,32 +363,51 @@ def train_one_epoch(
                     time_per_it,
                     seq_len / (batch_idx + 1),
                     batch["slide_id"][-1] if "slide_id" in batch else "None",
-                )
+                ),
+                step=global_step,
             )
+        else:
+            # unsynced: wall_s is host dispatch time under async dispatch;
+            # the report reads `synced` and treats these accordingly
+            t_now = time.time()
+            runlog.step(
+                global_step,
+                wall_s=round(t_now - t_prev, 6),
+                synced=bool(new_bucket),
+                epoch=epoch,
+                bucket=str(bucket),
+            )
+        t_prev = t_now
 
     records["loss"] = (
         float(loss_sum) if loss_sum is not None else 0.0
     ) / max(n_batches, 1)
     epoch_sec = time.time() - start_time
-    print(
+    runlog.echo(
         "Epoch: {}, Loss: {:.4f}, Epoch time: {:.1f}s ({:.3f} sec/it)".format(
             epoch, records["loss"], epoch_sec, epoch_sec / max(n_batches, 1)
-        )
+        ),
+        step=epoch * steps_per_epoch + max(n_batches - 1, 0),
     )
     if compile_log is not None and compile_log.first_call_sec:
-        print(compile_log.summary())
+        runlog.echo(compile_log.summary())
     return params, opt_state, records
 
 
-def evaluate(loader, eval_step, params, loss_fn, epoch, args):
+def evaluate(loader, eval_step, params, loss_fn, epoch, args, runlog=None,
+             heartbeat: Optional[Heartbeat] = None):
     """Eval pass collecting probs/one-hot labels + metrics
     (reference ``evaluate:289``). Records are accumulated as lists so
     retry-exhausted (skipped) samples never leave all-zero rows in the
-    metric inputs."""
+    metric inputs. Each batch beats the heartbeat (step number untouched):
+    a long healthy eval must stay distinguishable from a hung one."""
+    runlog = runlog if runlog is not None else NullRunLog(driver="finetune")
     probs, onehots = [], []
     total_loss, n = 0.0, 0
     task_setting = args.task_config.get("setting", "multi_class")
     for batch in _prefetched(loader, bf16=getattr(args, "bf16", True)):
+        if heartbeat is not None:
+            heartbeat.beat()
         images, coords, labels, pad_mask = _batch_to_device(batch)
         logits = eval_step(params, images, coords, pad_mask)
         logits = jnp.asarray(logits, jnp.float32)
@@ -385,8 +434,16 @@ def evaluate(loader, eval_step, params, loss_fn, epoch, args):
     )
     records["loss"] = total_loss / max(n, 1)
 
+    runlog.eval_event(
+        epoch,
+        **{
+            k: float(v)
+            for k, v in records.items()
+            if isinstance(v, (int, float, np.floating))
+        },
+    )
     if task_setting == "multi_label":
-        print(
+        runlog.echo(
             "Epoch: {}, Loss: {:.4f}, Micro AUROC: {:.4f}, Macro AUROC: {:.4f}, "
             "Micro AUPRC: {:.4f}, Macro AUPRC: {:.4f}".format(
                 epoch,
@@ -403,5 +460,5 @@ def evaluate(loader, eval_step, params, loss_fn, epoch, args):
         )
         for metric in args.task_config.get("add_metrics", []):
             info += ", {}: {:.4f}".format(metric, records[metric])
-        print(info)
+        runlog.echo(info)
     return records

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from gigapath_tpu.obs import console
+
 
 def seed_everything(seed: int = 7) -> None:
     """Host-side seeding (reference ``seed_torch:26``); device randomness in
@@ -230,5 +232,5 @@ def make_writer(report_to: str, writer_dir: str, args=None):
 
             return tensorboard.SummaryWriter(writer_dir, flush_secs=15), "tensorboard"
         except ImportError:
-            print("tensorboard unavailable; logging scalars to metrics.jsonl")
+            console("tensorboard unavailable; logging scalars to metrics.jsonl")
     return open(os.path.join(writer_dir, "metrics.jsonl"), "a"), "jsonl"

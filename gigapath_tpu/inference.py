@@ -12,10 +12,13 @@ from __future__ import annotations
 import argparse
 import glob
 import os
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from gigapath_tpu.obs import CompileWatchdog, Heartbeat, console, get_run_log
 
 
 def load_model(
@@ -82,43 +85,79 @@ def run_inference(
 
     feature_files = sorted(glob.glob(os.path.join(feature_dir, "*_features.pt")))
     if not feature_files:
-        print(f"No feature files found in {feature_dir}")
+        console(f"No feature files found in {feature_dir}")
         return None
+
+    runlog = get_run_log(
+        "inference", out_dir=os.path.dirname(os.path.abspath(output_file)),
+        config={"feature_dir": feature_dir, "output_file": output_file,
+                "n_slides": len(feature_files)},
+    )
 
     @jax.jit
     def forward(params, embeds, coords):
         return model.apply({"params": params}, embeds, coords, deterministic=True)
 
+    # variable-length slides -> one compile per distinct N; the watchdog
+    # turns that invisible first-slide pause into compile events
+    watchdog = CompileWatchdog("inference.forward", runlog)
+    instrumented_forward = watchdog.wrap(forward)
+
     results = []
     warned = False
-    for path in feature_files:
-        feats, coords = _load_features(path)
-        feats = feats[None]  # [1, N, D]
-        if coords is None:
-            if not warned:
-                print(
-                    "Warning: feature files carry no coords; using zeros "
-                    "(positional signal collapses to one grid cell)"
+    try:
+        with Heartbeat(runlog, name="inference") as heartbeat:
+            for idx, path in enumerate(feature_files):
+                t0 = time.time()
+                feats, coords = _load_features(path)
+                feats = feats[None]  # [1, N, D]
+                if coords is None:
+                    if not warned:
+                        runlog.echo(
+                            "Warning: feature files carry no coords; using zeros "
+                            "(positional signal collapses to one grid cell)"
+                        )
+                        warned = True
+                    coords = np.zeros((feats.shape[1], 2), np.float32)
+                coords = np.asarray(coords, np.float32)[None]
+                logits = np.asarray(
+                    instrumented_forward(params, jnp.asarray(feats), jnp.asarray(coords)),
+                    np.float32,
                 )
-                warned = True
-            coords = np.zeros((feats.shape[1], 2), np.float32)
-        coords = np.asarray(coords, np.float32)[None]
-        logits = np.asarray(forward(params, jnp.asarray(feats), jnp.asarray(coords)), np.float32)
-        probs = np.asarray(jax.nn.softmax(logits, axis=-1))[0]
-        pred = int(probs.argmax())
-        results.append(
-            {
-                "slide_id": os.path.basename(path).replace("_features.pt", ""),
-                "predicted_label": pred,
-                "confidence": float(probs[pred]),
-            }
-        )
+                probs = np.asarray(jax.nn.softmax(logits, axis=-1))[0]
+                pred = int(probs.argmax())
+                results.append(
+                    {
+                        "slide_id": os.path.basename(path).replace("_features.pt", ""),
+                        "predicted_label": pred,
+                        "confidence": float(probs[pred]),
+                    }
+                )
+                runlog.step(
+                    idx, wall_s=round(time.time() - t0, 6), synced=True,
+                    n_tiles=int(feats.shape[1]), predicted_label=pred,
+                    confidence=float(probs[pred]),
+                )
+                heartbeat.beat(idx)
+        results_df = pd.DataFrame(results)
+        results_df.to_csv(output_file, index=False)
+    except Exception as e:
+        runlog.error("inference.run_inference", e)
+        runlog.run_end(status="error")
+        raise
 
-    results_df = pd.DataFrame(results)
-    results_df.to_csv(output_file, index=False)
-    print(f"Inference results saved to {output_file}")
-    print(f"Label distribution: {results_df['predicted_label'].value_counts().to_dict()}")
-    print(f"Mean confidence: {results_df['confidence'].mean():.4f}")
+    label_counts = {
+        str(k): int(v)
+        for k, v in results_df["predicted_label"].value_counts().items()
+    }
+    runlog.echo(f"Inference results saved to {output_file}")
+    runlog.echo(f"Label distribution: {label_counts}")
+    runlog.echo(f"Mean confidence: {results_df['confidence'].mean():.4f}")
+    runlog.run_end(
+        status="ok", n_slides=len(results), label_distribution=str(label_counts),
+        mean_confidence=float(results_df["confidence"].mean()),
+        compile_seconds_total=watchdog.compile_seconds_total(),
+    )
     return results_df
 
 

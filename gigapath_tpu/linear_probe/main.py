@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import itertools
 import os
+import time
 from typing import Iterator, Tuple
 
 import jax
@@ -26,6 +27,7 @@ import optax
 
 from gigapath_tpu.data.pcam import EmbeddingDataset, Processor
 from gigapath_tpu.finetune.utils import log_writer, make_writer, seed_everything
+from gigapath_tpu.obs import CompileWatchdog, Heartbeat, console, get_run_log
 from gigapath_tpu.utils.checkpoint import restore_checkpoint, save_checkpoint
 
 
@@ -144,6 +146,13 @@ def train(
         exp_code = "linear_probe"
 
     writer, report_to = make_writer(report_to, os.path.join(output_dir, "tensorboard"), _Args)
+    runlog = get_run_log(
+        "linear_probe", out_dir=output_dir,
+        config={"train_iters": train_iters, "batch_size": batch_size,
+                "lr": lr, "min_lr": min_lr, "optim": optim,
+                "weight_decay": weight_decay, "momentum": momentum,
+                "eval_interval": eval_interval, "seed": seed},
+    )
 
     schedule = optax.cosine_decay_schedule(lr, train_iters, alpha=min_lr / max(lr, 1e-12))
     if optim == "sgd":
@@ -155,7 +164,7 @@ def train(
         tx = optax.adamw(schedule, weight_decay=weight_decay)
     else:
         raise ValueError("Invalid optimizer")
-    print(f"Set the optimizer as {optim}")
+    runlog.echo(f"Set the optimizer as {optim}")
     opt_state = tx.init(params)
 
     @jax.jit
@@ -173,63 +182,110 @@ def train(
     val_loader = lambda: _batches(val_dataset, batch_size, rng, infinite=False)  # noqa: E731
     test_loader = lambda: _batches(test_dataset, batch_size, rng, infinite=False)  # noqa: E731
 
+    watchdog = CompileWatchdog("linear_probe.step", runlog)
+    instrumented_step = watchdog.wrap(step)
+    runlog.echo("Start training")
+    try:
+        params, best_f1, f1 = _train_loop(
+            params, opt_state, instrumented_step, train_stream, train_iters,
+            schedule, eval_interval, val_loader, output_dir, report_to,
+            writer, runlog,
+        )
+
+        if model_select == "best" and best_f1 > 0:
+            val_f1 = best_f1
+            params = restore_checkpoint(os.path.join(output_dir, "best_model"))
+        else:
+            val_f1 = f1
+            params = restore_checkpoint(os.path.join(output_dir, "model"))
+
+        accuracy, f1, precision, recall, auroc, auprc = evaluate(params, test_loader)
+        runlog.echo(
+            f"Test Accuracy: {accuracy} f1: {f1} Precision: {precision} Recall: "
+            f"{recall} AUROC: {auroc} AUPRC: {auprc}"
+        )
+        with open(os.path.join(output_dir, "results.txt"), "w") as f:
+            f.write(f"Val f1: {val_f1}\n")
+            f.write(f"Test f1: {f1} Test AUROC: {auroc} Test AUPRC: {auprc}\n")
+    except Exception as e:
+        # a crashed run must still leave a terminal event in its artifact
+        runlog.error("linear_probe.train", e)
+        runlog.run_end(status="error")
+        raise
+    runlog.run_end(
+        status="ok", val_f1=val_f1, test_f1=f1, test_auroc=auroc,
+        test_auprc=auprc,
+        compile_seconds_total=watchdog.compile_seconds_total(),
+    )
+    return {"val_f1": val_f1, "test_f1": f1, "test_auroc": auroc, "test_auprc": auprc}
+
+
+def _train_loop(
+    params, opt_state, instrumented_step, train_stream, train_iters,
+    schedule, eval_interval, val_loader, output_dir, report_to, writer,
+    runlog,
+):
+    """The heartbeat-monitored iteration loop; returns
+    ``(params, best_f1, last_f1)``."""
     best_f1, f1 = 0.0, 0.0
-    print("Start training")
-    for i, (embed, target) in enumerate(itertools.islice(train_stream, train_iters)):
-        params, opt_state, loss = step(params, opt_state, jnp.asarray(embed), jnp.asarray(target))
-        if (i + 1) % 10 == 0:
-            cur_lr = float(schedule(i))
-            print(f"Iteration [{i}/{train_iters}]\tLoss: {float(loss)}\tLR: {cur_lr}")
-            log_writer({"Train Loss": float(loss), "Learning Rate": cur_lr}, i, report_to, writer)
-        if (i + 1) % eval_interval == 0 or (i + 1) == train_iters:
-            print("Start evaluating ...")
-            accuracy, f1, precision, recall, auroc, auprc = evaluate(params, val_loader)
-            print(
-                f"Val [{i}/{train_iters}] Accuracy: {accuracy} f1: {f1} Precision: "
-                f"{precision} Recall: {recall} AUROC: {auroc} AUPRC: {auprc}"
+    with Heartbeat(runlog, name="linear_probe") as heartbeat:
+        t_prev = time.time()
+        for i, (embed, target) in enumerate(itertools.islice(train_stream, train_iters)):
+            params, opt_state, loss = instrumented_step(
+                params, opt_state, jnp.asarray(embed), jnp.asarray(target)
             )
-            log_writer(
-                {
-                    "Val Accuracy": accuracy,
-                    "Val f1": f1,
-                    "Val AUROC": auroc,
-                    "Val AUPRC": auprc,
-                    "Val Precision": precision,
-                    "Val Recall": recall,
-                    "Best f1": best_f1,
-                },
-                i,
-                report_to,
-                writer,
-            )
-            if f1 > best_f1:
-                print(f"Best f1 increase from {best_f1} to {f1}")
-                best_f1 = f1
-                save_checkpoint(os.path.join(output_dir, "best_model"), jax.device_get(params))
+            heartbeat.beat(i)
+            if (i + 1) % 10 == 0:
+                cur_lr = float(schedule(i))
+                t_now = time.time()
+                runlog.step(
+                    i, wall_s=round(t_now - t_prev, 6), synced=True,
+                    loss=float(loss), lr=cur_lr,
+                )
+                t_prev = t_now
+                runlog.echo(
+                    f"Iteration [{i}/{train_iters}]\tLoss: {float(loss)}\tLR: {cur_lr}",
+                    step=i,
+                )
+                log_writer({"Train Loss": float(loss), "Learning Rate": cur_lr}, i, report_to, writer)
+            if (i + 1) % eval_interval == 0 or (i + 1) == train_iters:
+                runlog.echo("Start evaluating ...")
+                accuracy, f1, precision, recall, auroc, auprc = evaluate(params, val_loader)
+                runlog.eval_event(
+                    i, accuracy=accuracy, f1=f1, precision=precision,
+                    recall=recall, auroc=auroc, auprc=auprc,
+                )
+                runlog.echo(
+                    f"Val [{i}/{train_iters}] Accuracy: {accuracy} f1: {f1} Precision: "
+                    f"{precision} Recall: {recall} AUROC: {auroc} AUPRC: {auprc}",
+                    step=i,
+                )
+                log_writer(
+                    {
+                        "Val Accuracy": accuracy,
+                        "Val f1": f1,
+                        "Val AUROC": auroc,
+                        "Val AUPRC": auprc,
+                        "Val Precision": precision,
+                        "Val Recall": recall,
+                        "Best f1": best_f1,
+                    },
+                    i,
+                    report_to,
+                    writer,
+                )
+                if f1 > best_f1:
+                    runlog.echo(f"Best f1 increase from {best_f1} to {f1}")
+                    best_f1 = f1
+                    save_checkpoint(os.path.join(output_dir, "best_model"), jax.device_get(params))
 
     save_checkpoint(os.path.join(output_dir, "model"), jax.device_get(params))
-
-    if model_select == "best" and best_f1 > 0:
-        val_f1 = best_f1
-        params = restore_checkpoint(os.path.join(output_dir, "best_model"))
-    else:
-        val_f1 = f1
-        params = restore_checkpoint(os.path.join(output_dir, "model"))
-
-    accuracy, f1, precision, recall, auroc, auprc = evaluate(params, test_loader)
-    print(
-        f"Test Accuracy: {accuracy} f1: {f1} Precision: {precision} Recall: "
-        f"{recall} AUROC: {auroc} AUPRC: {auprc}"
-    )
-    with open(os.path.join(output_dir, "results.txt"), "w") as f:
-        f.write(f"Val f1: {val_f1}\n")
-        f.write(f"Test f1: {f1} Test AUROC: {auroc} Test AUPRC: {auprc}\n")
-    return {"val_f1": val_f1, "test_f1": f1, "test_auroc": auroc, "test_auprc": auprc}
+    return params, best_f1, f1
 
 
 def main(argv=None):
     args = build_argparser().parse_args(argv)
-    print(args)
+    console(str(args))
     seed_everything(args.seed)
     processor = Processor()
     splits = ["train", "val", "test"]
@@ -241,7 +297,7 @@ def main(argv=None):
         for split in splits
     ]
     args.num_classes = len(train_dataset.label_dict)
-    print(f"Train: {len(train_dataset)}\tVal: {len(val_dataset)}\tTest: {len(test_dataset)}")
+    console(f"Train: {len(train_dataset)}\tVal: {len(val_dataset)}\tTest: {len(test_dataset)}")
     params = init_linear_probe(args.embed_dim, args.num_classes, args.seed)
     return train(params, train_dataset, val_dataset, test_dataset, **vars(args))
 

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from gigapath_tpu.obs import console
 from gigapath_tpu.utils.registry import create_model_from_registry
 
 
@@ -135,13 +136,13 @@ def get_model(
         params["slide_encoder"], missing, unexpected = merge_into_params(
             params["slide_encoder"], converted
         )
-        print(
+        console(
             f"\033[92m Loaded pretrained slide encoder from {pretrained} "
             f"({len(missing)} missing, {len(unexpected)} unexpected) \033[00m"
         )
     elif pretrained:
-        print(f"\033[93m Pretrained weights not found at {pretrained} \033[00m")
+        console(f"\033[93m Pretrained weights not found at {pretrained} \033[00m")
 
     if freeze:
-        print("Freezing is applied at the optimizer: use frozen_param_labels()")
+        console("Freezing is applied at the optimizer: use frozen_param_labels()")
     return model, params

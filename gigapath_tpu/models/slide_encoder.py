@@ -29,6 +29,7 @@ import numpy as np
 from flax import linen as nn
 
 from gigapath_tpu.models.longnet import make_longnet_from_name
+from gigapath_tpu.obs import console
 from gigapath_tpu.ops import pos_embed as pe
 from gigapath_tpu.utils.registry import create_model_from_registry, register_model
 from gigapath_tpu.utils.torch_convert import (
@@ -282,12 +283,12 @@ def create_model(
         state = load_torch_state_dict(local_path)
         converted = convert_state_dict(state)
         params, missing, unexpected = merge_into_params(params, converted)
-        print(
+        console(
             f"\033[92m Successfully loaded pretrained GigaPath slide encoder "
             f"from {local_path} ({len(missing)} missing, {len(unexpected)} unexpected) \033[00m"
         )
     elif pretrained:
-        print(
+        console(
             f"\033[93m Pretrained weights not found at {local_path}. "
             f"Randomly initialized the model! \033[00m"
         )

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
+from gigapath_tpu.obs import console
 from gigapath_tpu.ops.attention import attention_with_lse
 from gigapath_tpu.ops.droppath import DropPath
 from gigapath_tpu.utils.registry import create_model_from_registry, register_model
@@ -411,12 +412,12 @@ def create_tile_encoder(
         state = load_torch_state_dict(pretrained)
         converted = convert_timm_state_dict(state, target_grid=model.grid_size)
         params, missing, unexpected = merge_into_params(params, converted)
-        print(
+        console(
             f"\033[92m Successfully loaded tile encoder from {pretrained} "
             f"({len(missing)} missing, {len(unexpected)} unexpected) \033[00m"
         )
     elif pretrained:
-        print(
+        console(
             f"\033[93m Tile-encoder weights not found at {pretrained}. "
             f"Randomly initialized the model! \033[00m"
         )

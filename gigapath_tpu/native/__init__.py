@@ -24,6 +24,8 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from gigapath_tpu.obs import console
+
 _SRC = os.path.join(os.path.dirname(__file__), "tile_ops.cpp")
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
@@ -71,7 +73,7 @@ def _build() -> Optional[ctypes.CDLL]:
         ]
         _lib = lib
     except Exception as e:  # toolchain absent / compile error -> numpy path
-        print(f"gigapath_tpu.native: falling back to numpy ({e})")
+        console(f"gigapath_tpu.native: falling back to numpy ({e})")
         _build_failed = True
     return _lib
 

@@ -1,0 +1,34 @@
+"""Run-scoped observability: structured JSONL telemetry for every driver.
+
+- :mod:`gigapath_tpu.obs.runlog` — ``RunLog`` / ``NullRunLog`` / the
+  ``get_run_log`` env-gated factory and the sanctioned ``console`` sink;
+- :mod:`gigapath_tpu.obs.watchdog` — ``CompileWatchdog`` retrace/compile
+  accounting (subsumes the old finetune ``BucketCompileLog``);
+- :mod:`gigapath_tpu.obs.heartbeat` — ``Heartbeat`` liveness/stall monitor;
+- :mod:`gigapath_tpu.obs.telemetry` — in-graph scalar helpers (grad/param
+  norms, MoE gating stats) that add no device round-trips or retraces.
+
+Fold a run's JSONL into a human report with ``scripts/obs_report.py``.
+"""
+
+from gigapath_tpu.obs.heartbeat import Heartbeat
+from gigapath_tpu.obs.runlog import (
+    EVENT_KINDS,
+    SCHEMA_VERSION,
+    NullRunLog,
+    RunLog,
+    console,
+    get_run_log,
+)
+from gigapath_tpu.obs.watchdog import CompileWatchdog
+
+__all__ = [
+    "EVENT_KINDS",
+    "SCHEMA_VERSION",
+    "CompileWatchdog",
+    "Heartbeat",
+    "NullRunLog",
+    "RunLog",
+    "console",
+    "get_run_log",
+]

@@ -1,0 +1,111 @@
+"""Liveness heartbeat + stall monitor (the axon-tunnel-hang defense).
+
+The failure mode this exists for: the device tunnel hangs INSIDE a
+blocking runtime call (``jax.devices()``, a ``block_until_ready``) with
+no deadline, the train loop stops advancing, and nothing in the process
+says so — the run just goes quiet (bench.py header; round-5 hang). A
+background daemon thread cannot un-hang the RPC, but it can make the
+hang *observable*: periodic ``heartbeat`` events keep timestamped proof
+of liveness in the run artifact, and a ``stall`` event fires the moment
+no step completes within the deadline, so both a human tail and
+``scripts/obs_report.py`` can see exactly when progress stopped.
+
+Usage::
+
+    with Heartbeat(runlog, interval_s=30, stall_after_s=300) as hb:
+        for step, batch in enumerate(loader):
+            ...
+            hb.beat(step)
+
+``beat()`` is a lock + two assignments — safe to call every step. One
+``stall`` event per stall episode; a later ``beat`` re-arms it so a
+recovered run can flag a second stall.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+
+class Heartbeat:
+    def __init__(self, runlog, *, interval_s: float = 30.0,
+                 stall_after_s: float = 300.0, name: str = "train"):
+        self.runlog = runlog
+        self.interval_s = float(interval_s)
+        self.stall_after_s = float(stall_after_s)
+        self.name = name
+        self.stall_count = 0
+        self._last_beat = time.time()
+        self._last_step: Optional[int] = None
+        self._stalled = False
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "Heartbeat":
+        if self._thread is not None:
+            return self
+        self._last_beat = time.time()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"obs-heartbeat-{self.name}"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "Heartbeat":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- progress ---------------------------------------------------------
+    def beat(self, step: Optional[int] = None) -> None:
+        """Record progress; re-arms stall detection after a recovery."""
+        with self._lock:
+            self._last_beat = time.time()
+            if step is not None:
+                self._last_step = step
+            self._stalled = False
+
+    # -- monitor thread ---------------------------------------------------
+    def _tick_s(self) -> float:
+        # poll fast enough to hit the stall deadline promptly even with
+        # sub-second test configs, without spinning
+        return max(0.01, min(self.interval_s, self.stall_after_s) / 4.0)
+
+    def _run(self) -> None:
+        next_hb = time.time() + self.interval_s
+        while not self._stop.wait(timeout=self._tick_s()):
+            now = time.time()
+            with self._lock:
+                since = now - self._last_beat
+                step = self._last_step
+                stalled = self._stalled
+            if since >= self.stall_after_s and not stalled:
+                with self._lock:
+                    self._stalled = True
+                self.stall_count += 1
+                self.runlog.stall(
+                    last_step=step,
+                    since_progress_s=round(since, 3),
+                    deadline_s=self.stall_after_s,
+                )
+                self.runlog.echo(
+                    f"[stall] {self.name}: no step completed in "
+                    f"{since:.1f}s (deadline {self.stall_after_s:.1f}s); "
+                    f"last step {step}"
+                )
+            if now >= next_hb:
+                self.runlog.heartbeat(
+                    last_step=step, since_progress_s=round(since, 3)
+                )
+                next_hb = now + self.interval_s

@@ -1,0 +1,282 @@
+"""Run-scoped structured telemetry: schema-versioned JSONL events.
+
+Every run of a driver (finetune, pretrain, train_gigapath, linear probe,
+inference, bench) becomes a machine-readable artifact: one JSONL file of
+events a tool can fold into a report (``scripts/obs_report.py``), instead
+of the reference stack's loose prints that left rounds 3-4 of engineering
+invisible when one flaky tunnel RPC zeroed the bench record (bench.py
+header).
+
+Event kinds (schema v1, one JSON object per line, every record carries
+``v``/``run``/``kind``/``t``):
+
+- ``run_start``  — config + environment manifest (jax version, backend,
+  device kind/count) emitted once at driver start;
+- ``step``       — one training/inference step: ``step``, ``wall_s``
+  (host wall seconds for this step), ``synced`` (whether the host
+  blocked on the device this step — wall times of unsynced steps are
+  dispatch times under async dispatch), plus free-form scalars;
+- ``compile``    — XLA compile observed by the watchdog (fn, key,
+  seconds, running count, ``unexpected`` retrace flag);
+- ``eval``       — evaluation metrics at an epoch/step;
+- ``heartbeat``  — periodic liveness from the background monitor;
+- ``stall``      — no progress within the deadline (the axon-tunnel-hang
+  failure mode made visible);
+- ``error``      — exception surfaced by a driver;
+- ``run_end``    — terminal status + summary payload.
+
+``RunLog`` is the writing half; ``NullRunLog`` is the zero-overhead
+opt-out (events no-op; the console echo stays, so opting out of
+telemetry never silences the training console). Construct via
+:func:`get_run_log`, which reads the ``GIGAPATH_OBS`` env flag ONCE at
+driver start — never call it from traced code (gigalint GL001).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, Optional
+
+SCHEMA_VERSION = 1
+
+EVENT_KINDS = (
+    "run_start", "step", "compile", "eval", "heartbeat", "stall",
+    "error", "run_end",
+)
+
+
+def console(msg: str, *, stream=None) -> None:
+    """The single sanctioned console sink for library code (GL006): every
+    former bare ``print`` in ``gigapath_tpu/`` routes through here (or
+    through :meth:`RunLog.echo`, which calls here), so console output can
+    be redirected or silenced in one place."""
+    out = stream if stream is not None else sys.stdout
+    print(msg, file=out, flush=True)  # gigalint: waive GL006 -- the one sanctioned console sink
+
+
+def _to_scalar(value: Any) -> Any:
+    """Best-effort JSON-safe scalar: 0-d/1-element arrays -> float.
+
+    Device arrays sync when read — callers must only pass device values
+    at points where the host already blocks (see finetune/training.py's
+    20-iteration sync)."""
+    if isinstance(value, (bool, int, float, str)) or value is None:
+        return value
+    if isinstance(value, dict):
+        return {str(k): _to_scalar(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_to_scalar(v) for v in value]
+    try:
+        import numpy as np
+
+        arr = np.asarray(value)
+        if arr.size == 1:
+            return float(arr.reshape(()))
+        return arr.tolist()
+    except Exception:
+        return repr(value)
+
+
+class NullRunLog:
+    """Telemetry opt-out: every event is a no-op; echo keeps printing."""
+
+    path: Optional[str] = None
+    run_id: str = "null"
+
+    def __init__(self, driver: str = "run", echo: bool = True,
+                 echo_stream=None):
+        self.driver = driver
+        self._echo = echo
+        self._echo_stream = echo_stream
+        self._t0 = time.time()
+
+    # -- events (all no-ops; permissive signatures so every RunLog call
+    # site works unchanged against the opt-out) --------------------------
+    def event(self, *args, **fields) -> None:
+        return None
+
+    run_start = step = compile_event = eval_event = heartbeat = stall = \
+        error = run_end = event
+
+    def close(self) -> None:
+        return None
+
+    # -- console echo ----------------------------------------------------
+    def echo(self, msg: str, *, step: Optional[int] = None) -> None:
+        """One console line, single format: ``[driver +WALLs step N] msg``.
+
+        The format is shared by every driver (satellite: train_gigapath
+        and finetune/training previously disagreed on sec/it
+        conventions) — wall time is seconds since run start."""
+        if not self._echo:
+            return
+        head = f"[{self.driver} +{time.time() - self._t0:.1f}s"
+        if step is not None:
+            head += f" step {step}"
+        console(head + f"] {msg}", stream=self._echo_stream)
+
+
+class RunLog(NullRunLog):
+    """Appends schema-versioned JSONL events to a per-run file.
+
+    Thread-safe (the heartbeat monitor writes from a background thread);
+    every write is flushed so a killed/hung run still leaves a complete
+    prefix on disk — the artifact exists precisely when the run dies.
+    """
+
+    def __init__(self, path: str, *, driver: str = "run",
+                 run_id: Optional[str] = None, echo: bool = True,
+                 echo_stream=None):
+        super().__init__(driver=driver, echo=echo, echo_stream=echo_stream)
+        self.path = path
+        self.run_id = run_id or (
+            f"{driver}-{time.strftime('%Y%m%dT%H%M%S', time.gmtime())}"
+            f"-p{os.getpid()}"
+        )
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._fh = open(path, "a", encoding="utf-8")
+        self._lock = threading.Lock()
+        self._closed = False
+
+    # -- core ------------------------------------------------------------
+    def event(self, kind: str, **fields) -> Optional[Dict[str, Any]]:
+        record = {
+            "v": SCHEMA_VERSION,
+            "run": self.run_id,
+            "kind": kind,
+            "t": round(time.time(), 6),
+        }
+        record.update({k: _to_scalar(v) for k, v in fields.items()})
+        line = json.dumps(record)
+        with self._lock:
+            if self._closed:
+                return record
+            self._fh.write(line + "\n")
+            self._fh.flush()
+        return record
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._closed:
+                self._closed = True
+                self._fh.close()
+
+    # -- typed events ----------------------------------------------------
+    def run_start(self, config: Optional[dict] = None, *,
+                  probe_devices: bool = True, **fields):
+        """Environment manifest. ``probe_devices=False`` skips the
+        ``jax.devices()`` call for drivers (bench) that must control when
+        backend init happens — the init RPC can hang indefinitely."""
+        manifest: Dict[str, Any] = {"driver": self.driver, "pid": os.getpid()}
+        try:
+            import jax
+
+            manifest["jax_version"] = jax.__version__
+            if probe_devices:
+                devices = jax.devices()
+                manifest["backend"] = devices[0].platform
+                manifest["device_kind"] = devices[0].device_kind
+                manifest["device_count"] = len(devices)
+        except Exception as e:  # manifest is best-effort, never fatal
+            manifest["manifest_error"] = f"{type(e).__name__}: {e}"
+        if config is not None:
+            manifest["config"] = {
+                k: _to_scalar(v) for k, v in dict(config).items()
+            }
+        manifest.update(fields)
+        return self.event("run_start", **manifest)
+
+    def step(self, step: int, *, wall_s: Optional[float] = None,
+             synced: bool = False, **scalars):
+        return self.event("step", step=int(step), wall_s=wall_s,
+                          synced=synced, **scalars)
+
+    def compile_event(self, fn: str, key, seconds: Optional[float], *,
+                      count: int = 1, unexpected: bool = False):
+        return self.event("compile", fn=fn, key=_key_str(key),
+                          seconds=seconds, count=count,
+                          unexpected=unexpected)
+
+    def eval_event(self, step: int, **metrics):
+        return self.event("eval", step=int(step), **metrics)
+
+    def heartbeat(self, *, last_step=None, since_progress_s=None, **fields):
+        return self.event("heartbeat", last_step=last_step,
+                          since_progress_s=since_progress_s, **fields)
+
+    def stall(self, *, last_step=None, since_progress_s=None,
+              deadline_s=None, **fields):
+        return self.event("stall", last_step=last_step,
+                          since_progress_s=since_progress_s,
+                          deadline_s=deadline_s, **fields)
+
+    def error(self, where: str, err: BaseException):
+        return self.event("error", where=where,
+                          error=f"{type(err).__name__}: {err}")
+
+    def run_end(self, status: str = "ok", **fields):
+        rec = self.event("run_end", status=status,
+                         wall_s=round(time.time() - self._t0, 3), **fields)
+        self.close()
+        return rec
+
+
+def _key_str(key) -> str:
+    """Stable short string for a compile key (bucket tuple, shape, ...)."""
+    if isinstance(key, str):
+        return key
+    return repr(key)
+
+
+def _obs_enabled() -> bool:
+    """GIGAPATH_OBS semantics: unset -> ON (telemetry is cheap); set to
+    ''/'0'/'false'/'no' -> OFF; anything else -> ON. Matches the repo's
+    env_flag truthiness (ops/common.py) for set values, but defaults on
+    because the artifact is the point of the subsystem."""
+    raw = os.environ.get("GIGAPATH_OBS")
+    if raw is None:
+        return True
+    return raw.strip().lower() not in ("", "0", "false", "no")
+
+
+def get_run_log(driver: str, out_dir: Optional[str] = None, *,
+                config: Optional[dict] = None, echo: bool = True,
+                echo_stream=None, probe_devices: bool = True,
+                path: Optional[str] = None, run_start: bool = True):
+    """Build the run's telemetry sink. Reads ``GIGAPATH_OBS`` ONCE, here,
+    at driver start — never at trace time (gigalint GL001-clean because
+    no driver entry point is trace-reachable).
+
+    File placement: explicit ``path`` wins; else ``<out_dir>/obs/`` (or
+    ``$GIGAPATH_OBS_DIR``, or the system temp dir) gets a per-run file
+    named after the run id.
+    """
+    if not _obs_enabled():
+        return NullRunLog(driver=driver, echo=echo, echo_stream=echo_stream)
+    if path is None:
+        if out_dir is not None:
+            base = os.path.join(out_dir, "obs")
+        elif os.environ.get("GIGAPATH_OBS_DIR"):
+            base = os.environ["GIGAPATH_OBS_DIR"]  # used verbatim
+        else:
+            import tempfile
+
+            base = os.path.join(tempfile.gettempdir(), "gigapath_obs")
+        run_id = (
+            f"{driver}-{time.strftime('%Y%m%dT%H%M%S', time.gmtime())}"
+            f"-p{os.getpid()}"
+        )
+        path = os.path.join(base, f"{run_id}.jsonl")
+        log = RunLog(path, driver=driver, run_id=run_id, echo=echo,
+                     echo_stream=echo_stream)
+    else:
+        log = RunLog(path, driver=driver, echo=echo, echo_stream=echo_stream)
+    if run_start:
+        log.run_start(config=config, probe_devices=probe_devices)
+    return log

@@ -1,0 +1,73 @@
+"""In-graph scalar telemetry: computed INSIDE the jitted step.
+
+These helpers run at trace time and stay on-device: they add a handful
+of reductions to the step's XLA program and return 0-d arrays as extra
+step outputs. The host converts them to floats only at its existing
+sync points (the finetune loop's 20-iteration print / epoch end), so
+telemetry costs no extra device round-trips and — because the helpers
+neither read the environment nor branch on values — no retraces
+(pinned by the compile-count parity test in tests/test_obs.py).
+
+``collect_moe_metadata`` (utils/profiling.py) remains the host-side
+flattener for sown MoE gating stats; :func:`moe_scalars` is its
+in-graph twin that keeps the leaves as arrays so they can ride a jitted
+step's outputs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_norm(tree) -> jnp.ndarray:
+    """Global L2 norm over a pytree, accumulated in fp32 (bf16 squares of
+    ~1e-2 grads underflow)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.float32(0.0)
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(leaf.astype(jnp.float32))) for leaf in leaves)
+    )
+
+
+def step_scalars(
+    loss: Optional[jnp.ndarray] = None,
+    grads=None,
+    params=None,
+    **extras,
+) -> Dict[str, jnp.ndarray]:
+    """The standard per-step scalar set: loss, grad-norm, param-norm, plus
+    any caller extras (already-scalar arrays). Returned values are 0-d
+    DEVICE arrays — thread them out of the jitted step and hand them to
+    ``RunLog.step`` only at a host sync point."""
+    out: Dict[str, jnp.ndarray] = {}
+    if loss is not None:
+        out["loss"] = loss.astype(jnp.float32)
+    if grads is not None:
+        out["grad_norm"] = tree_norm(grads)
+    if params is not None:
+        out["param_norm"] = tree_norm(params)
+    out.update(extras)
+    return out
+
+
+def moe_scalars(intermediates: Dict[str, Any]) -> Dict[str, jnp.ndarray]:
+    """In-graph twin of ``collect_moe_metadata``: the same
+    ``layer_path/metric`` key space (one shared flattening —
+    ``iter_moe_metadata``), but leaves stay DEVICE arrays so MoE gating
+    telemetry (entropy, unused experts, balance fractions) can ride a
+    jitted step's outputs. Inside a jitted MoE step::
+
+        _, mods = model.apply(..., mutable=["intermediates"])
+        tel = {**step_scalars(loss=loss, grads=grads),
+               **moe_scalars(mods["intermediates"])}
+    """
+    from gigapath_tpu.utils.profiling import iter_moe_metadata
+
+    return {
+        key: jnp.asarray(leaf)
+        for key, leaf in iter_moe_metadata(intermediates)
+    }

@@ -1,0 +1,190 @@
+"""Retrace/compile watchdog for jitted step functions.
+
+Subsumes the old ``finetune.training.BucketCompileLog``: per-(function,
+bucket/shape key) compile accounting with first-call timing and steady
+step bookkeeping — plus what the old log could not see:
+
+- **true compile counting** via the jitted callable's compile-cache size
+  (``fn._cache_size()``), so a retrace is detected even when it happens
+  on a key the watchdog thought was already compiled;
+- **unexpected-retrace flagging**: cache growth on an already-seen key
+  means the jit cache key changed under us (a fresh function identity, a
+  weak-type flip, a donated-buffer mismatch) — exactly the silent
+  compile-storm failure mode bucketed collates are supposed to prevent;
+- ``compile`` events into a :class:`~gigapath_tpu.obs.runlog.RunLog`, so
+  compile-time share and the retrace table come out of the run artifact
+  (``scripts/obs_report.py``) instead of scrollback.
+
+Two usage shapes:
+
+1. Loops that already manage sync points (finetune/training.py) call
+   ``is_new(key)`` / ``record(key, seconds)`` exactly like the old
+   BucketCompileLog.
+2. Uniform-shape drivers wrap the jitted callable once::
+
+       step = watchdog.wrap(jit_step)
+
+   and every call is keyed, compile-timed on first sight, and counted
+   (never timed — no added syncs) afterwards.
+
+All bookkeeping is host-side Python around the call boundary: the traced
+program is untouched, so instrumentation can add NO retraces (pinned by
+tests/test_obs.py's compile-count parity test).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from gigapath_tpu.obs.runlog import NullRunLog, _key_str
+
+
+def _default_key(args: tuple, kwargs: dict) -> tuple:
+    """Shape/dtype signature over array-like positional args — the same
+    facts jax's jit cache keys on for them. Non-arrays (param pytrees,
+    python scalars) are skipped: hashing a whole param tree per step is
+    not free, and params do not change shape mid-run."""
+    key: List[Tuple] = []
+    for a in args:
+        shape = getattr(a, "shape", None)
+        if shape is not None:
+            key.append((tuple(shape), str(getattr(a, "dtype", ""))))
+    for name in sorted(kwargs):
+        shape = getattr(kwargs[name], "shape", None)
+        if shape is not None:
+            key.append((name, tuple(shape), str(getattr(kwargs[name], "dtype", ""))))
+    return tuple(key)
+
+
+class CompileWatchdog:
+    """Tracks XLA compiles per (function, key); flags unexpected retraces.
+
+    Bucketed collate bounds retraces to O(log L), but each new bucket's
+    first step silently pays a full XLA compile — a PANDA epoch's first
+    pass looks mysteriously slow without this. ``key`` is whatever the
+    caller buckets on (``(batch, padded_len)`` in the finetune loop; the
+    default shape signature under :meth:`wrap`).
+    """
+
+    def __init__(self, name: str, runlog=None, *, fn: Optional[Callable] = None):
+        self.name = name
+        self.runlog = runlog if runlog is not None else NullRunLog()
+        self._fn = fn
+        self.first_call_sec: Dict[Any, float] = {}
+        self.step_sec: Dict[Any, list] = {}
+        self._counts: Dict[Any, int] = {}  # untimed (async) steady steps
+        self.compile_count: Dict[Any, int] = {}
+        self.unexpected_retraces: List[Any] = []
+        self._last_cache_size = self._cache_size()
+
+    # -- cache-size truth ------------------------------------------------
+    def _cache_size(self) -> Optional[int]:
+        size = getattr(self._fn, "_cache_size", None)
+        if not callable(size):
+            return None
+        try:
+            return int(size())
+        except Exception:
+            return None
+
+    def attach(self, fn: Callable) -> None:
+        """Point the cache-size probe at a jitted callable (done
+        automatically by :meth:`wrap`)."""
+        self._fn = fn
+        self._last_cache_size = self._cache_size()
+
+    # -- BucketCompileLog-compatible surface ------------------------------
+    def is_new(self, key) -> bool:
+        return key not in self.first_call_sec
+
+    def record(self, key, seconds: Optional[float]) -> None:
+        """File one completed call under ``key``.
+
+        ``seconds=None`` marks a steady (async-dispatched, unsynced)
+        step: counted, not timed — loops only block on new keys and at
+        their periodic sync points, whose sec/it is the steady-state
+        number. A timed value on a NEW key is the first call's
+        compile+run seconds.
+        """
+        cur = self._cache_size()
+        grew = (
+            cur is not None
+            and self._last_cache_size is not None
+            and cur > self._last_cache_size
+        )
+        if cur is not None:
+            self._last_cache_size = cur
+        if self.is_new(key):
+            self.first_call_sec[key] = seconds if seconds is not None else 0.0
+            count = self.compile_count[key] = self.compile_count.get(key, 0) + 1
+            self.runlog.compile_event(
+                self.name, key, seconds, count=count, unexpected=False
+            )
+            self.runlog.echo(
+                f"[compile] {self.name} key={_key_str(key)}: first call "
+                f"{self.first_call_sec[key]:.2f}s (compile+run); "
+                f"{len(self.first_call_sec)} key(s) compiled"
+            )
+        elif grew:
+            # the jit cache grew on a key we had already compiled: an
+            # unexpected retrace (changed function identity, weak-type
+            # flip, static-arg drift). seconds, when present, is this
+            # call's wall — dominated by the recompile.
+            count = self.compile_count[key] = self.compile_count.get(key, 0) + 1
+            self.unexpected_retraces.append(key)
+            self.runlog.compile_event(
+                self.name, key, seconds, count=count, unexpected=True
+            )
+            self.runlog.echo(
+                f"[compile] WARNING {self.name} retraced on already-compiled "
+                f"key {_key_str(key)} (cache {self._last_cache_size} entries)"
+            )
+        elif seconds is not None:
+            self.step_sec.setdefault(key, []).append(seconds)
+        else:
+            self._counts[key] = self._counts.get(key, 0) + 1
+
+    # -- wrapper for uniform-shape drivers --------------------------------
+    def wrap(self, fn: Callable, key_fn: Optional[Callable] = None) -> Callable:
+        """Instrument a jitted callable. First call per key blocks to
+        isolate compile cost; steady calls pass straight through (no
+        added syncs, no retraces — the traced program is untouched)."""
+        self.attach(fn)
+
+        def wrapped(*args, **kwargs):
+            key = key_fn(*args, **kwargs) if key_fn else _default_key(args, kwargs)
+            if self.is_new(key):
+                import jax
+
+                t0 = time.time()
+                out = fn(*args, **kwargs)
+                jax.block_until_ready(out)
+                self.record(key, time.time() - t0)
+            else:
+                out = fn(*args, **kwargs)
+                self.record(key, None)
+            return out
+
+        return wrapped
+
+    # -- summaries --------------------------------------------------------
+    def compile_seconds_total(self) -> float:
+        return float(sum(self.first_call_sec.values()))
+
+    def summary(self) -> str:
+        parts = []
+        for key in sorted(self.first_call_sec, key=_key_str):
+            steps = self.step_sec.get(key, [])
+            n = len(steps) or self._counts.get(key, 0)
+            timing = f" @ {sum(steps) / len(steps):.3f}s" if steps else ""
+            retrace = (
+                f", {self.compile_count.get(key, 1) - 1} unexpected retrace(s)"
+                if self.compile_count.get(key, 1) > 1
+                else ""
+            )
+            parts.append(
+                f"key={_key_str(key)}: compile {self.first_call_sec[key]:.2f}s, "
+                f"{n} steady steps{timing}{retrace}"
+            )
+        return f"[compile] {self.name} — " + "; ".join(parts)

@@ -29,6 +29,7 @@ from gigapath_tpu.data.tile_dataset import TileEncodingDataset
 from gigapath_tpu.data.transforms import preprocess_tile
 from gigapath_tpu.models import slide_encoder as slide_encoder_lib
 from gigapath_tpu.models import tile_encoder as tile_encoder_lib
+from gigapath_tpu.obs import console
 from gigapath_tpu.preprocessing.create_tiles_dataset import process_slide
 
 
@@ -47,8 +48,8 @@ def tile_one_slide(
 
     save_dir = Path(save_dir)
     if save_dir.exists():
-        print(f"Warning: Directory {save_dir} already exists. ")
-    print(
+        console(f"Warning: Directory {save_dir} already exists. ")
+    console(
         f"Processing slide {slide_file} at level {level} with tile size "
         f"{tile_size}. Saving to {save_dir}."
     )
@@ -67,7 +68,7 @@ def tile_one_slide(
     assert len(dataset_df) > 0
     failed_df = pd.read_csv(slide_dir / "failed_tiles.csv")
     assert len(failed_df) == 0
-    print(
+    console(
         f"Slide {slide_file} has been tiled. {len(dataset_df)} tiles saved to {slide_dir}."
     )
     return slide_dir
@@ -90,7 +91,7 @@ def load_tile_slide_encoder(
         pretrained=local_tile_encoder_path, dtype=jnp.bfloat16
     )
     n_tile = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(tile_params))
-    print("Tile encoder param #", n_tile)
+    console(f"Tile encoder param # {n_tile}")
 
     slide_model, slide_params = slide_encoder_lib.create_model(
         local_slide_encoder_path or "hf_hub:prov-gigapath/prov-gigapath",
@@ -100,7 +101,7 @@ def load_tile_slide_encoder(
         dtype=jnp.bfloat16,
     )
     n_slide = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(slide_params))
-    print("Slide encoder param #", n_slide)
+    console(f"Slide encoder param # {n_slide}")
     return (tile_model, tile_params), (slide_model, slide_params)
 
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import glob
 import os
+import time
 from typing import List, Optional, Sequence
 
 import jax
@@ -34,6 +35,7 @@ import optax
 from flax import linen as nn
 
 from gigapath_tpu.models.tile_encoder import VisionTransformer
+from gigapath_tpu.obs import CompileWatchdog, Heartbeat, console, get_run_log
 from gigapath_tpu.utils.checkpoint import restore_checkpoint, save_checkpoint
 
 
@@ -130,37 +132,74 @@ def pretrain_tile_encoder(
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
+    runlog = get_run_log(
+        "pretrain_tile", out_dir=output_dir,
+        config={"batch_size": batch_size, "num_epochs": num_epochs,
+                "learning_rate": learning_rate, "mask_ratio": mask_ratio,
+                "n_images": len(image_paths), "seed": seed},
+    )
+    watchdog = CompileWatchdog("pretrain_tile.step", runlog)
+    instrumented_step = watchdog.wrap(step)
     order_rng = np.random.default_rng(seed)
     best_loss = float("inf")
     best_path = os.path.join(output_dir, "best_tile_encoder")
-    for epoch in range(num_epochs):
-        order = order_rng.permutation(len(image_paths))
-        epoch_loss, n_steps = 0.0, 0
-        for start in range(0, steps_per_epoch * batch_size, batch_size):
-            idx = order[start : start + batch_size]
-            if len(idx) == 0:
-                break
-            imgs = jnp.asarray(
-                _load_tile_batch([image_paths[i] for i in idx], encoder.img_size)
-            )
-            rng, mask_rng = jax.random.split(rng)
-            params, opt_state, loss = step(params, opt_state, imgs, mask_rng)
-            epoch_loss += float(loss)
-            n_steps += 1
-        epoch_loss /= max(n_steps, 1)
-        print(f"Epoch {epoch + 1}/{num_epochs}, loss {epoch_loss:.6f}")
-        if epoch_loss < best_loss:
-            best_loss = epoch_loss
-            save_checkpoint(
-                best_path,
-                {"params": jax.device_get(params), "epoch": np.asarray(epoch), "loss": np.asarray(epoch_loss)},
-            )
-        if (epoch + 1) % checkpoint_every == 0:
-            save_checkpoint(
-                os.path.join(output_dir, f"tile_encoder_epoch_{epoch + 1}"),
-                {"params": jax.device_get(params), "epoch": np.asarray(epoch)},
-            )
-    print(f"Pretraining done. Best loss: {best_loss:.6f}")
+    try:
+        with Heartbeat(runlog, name="pretrain_tile") as heartbeat:
+            global_step = 0
+            for epoch in range(num_epochs):
+                order = order_rng.permutation(len(image_paths))
+                epoch_loss, n_steps = 0.0, 0
+                t_epoch = time.time()
+                for start in range(0, steps_per_epoch * batch_size, batch_size):
+                    idx = order[start : start + batch_size]
+                    if len(idx) == 0:
+                        break
+                    imgs = jnp.asarray(
+                        _load_tile_batch([image_paths[i] for i in idx], encoder.img_size)
+                    )
+                    rng, mask_rng = jax.random.split(rng)
+                    t0 = time.time()
+                    params, opt_state, loss = instrumented_step(
+                        params, opt_state, imgs, mask_rng
+                    )
+                    loss = float(loss)  # host sync (tiny batches)
+                    epoch_loss += loss
+                    n_steps += 1
+                    runlog.step(
+                        global_step, wall_s=round(time.time() - t0, 6),
+                        synced=True, epoch=epoch, loss=loss,
+                    )
+                    heartbeat.beat(global_step)
+                    global_step += 1
+                epoch_loss /= max(n_steps, 1)
+                epoch_sec = time.time() - t_epoch
+                runlog.echo(
+                    "Epoch: {}, Loss: {:.6f}, Epoch time: {:.1f}s "
+                    "({:.3f} sec/it)".format(
+                        epoch, epoch_loss, epoch_sec, epoch_sec / max(n_steps, 1)
+                    ),
+                    step=max(global_step - 1, 0),
+                )
+                if epoch_loss < best_loss:
+                    best_loss = epoch_loss
+                    save_checkpoint(
+                        best_path,
+                        {"params": jax.device_get(params), "epoch": np.asarray(epoch), "loss": np.asarray(epoch_loss)},
+                    )
+                if (epoch + 1) % checkpoint_every == 0:
+                    save_checkpoint(
+                        os.path.join(output_dir, f"tile_encoder_epoch_{epoch + 1}"),
+                        {"params": jax.device_get(params), "epoch": np.asarray(epoch)},
+                    )
+    except Exception as e:
+        runlog.error("pretrain_tile_encoder", e)
+        runlog.run_end(status="error")
+        raise
+    runlog.echo(f"Pretraining done. Best loss: {best_loss:.6f}")
+    runlog.run_end(
+        status="ok", best_loss=best_loss,
+        compile_seconds_total=watchdog.compile_seconds_total(),
+    )
     return best_path
 
 
@@ -254,18 +293,44 @@ def pretrain_slide_encoder(
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
+    runlog = get_run_log(
+        "pretrain_slide", out_dir=output_dir,
+        config={"num_epochs": num_epochs, "learning_rate": learning_rate,
+                "max_tiles": max_tiles, "n_slides": int(batch.shape[0]),
+                "seed": seed},
+    )
+    watchdog = CompileWatchdog("pretrain_slide.step", runlog)
+    instrumented_step = watchdog.wrap(step)
     best_loss = float("inf")
     best_path = os.path.join(output_dir, "best_slide_encoder")
-    for epoch in range(num_epochs):
-        params, opt_state, loss = step(params, opt_state)
-        loss = float(loss)
-        print(f"Epoch {epoch + 1}/{num_epochs}, contrastive loss {loss:.6f}")
-        if loss < best_loss:
-            best_loss = loss
-            save_checkpoint(
-                best_path, {"params": jax.device_get(params), "loss": np.asarray(loss)}
-            )
-    print(f"Slide pretraining done. Best loss: {best_loss:.6f}")
+    try:
+        with Heartbeat(runlog, name="pretrain_slide") as heartbeat:
+            for epoch in range(num_epochs):
+                t0 = time.time()
+                params, opt_state, loss = instrumented_step(params, opt_state)
+                loss = float(loss)
+                runlog.step(
+                    epoch, wall_s=round(time.time() - t0, 6), synced=True,
+                    loss=loss,
+                )
+                heartbeat.beat(epoch)
+                runlog.echo(
+                    f"Epoch: {epoch}, Contrastive loss: {loss:.6f}", step=epoch
+                )
+                if loss < best_loss:
+                    best_loss = loss
+                    save_checkpoint(
+                        best_path, {"params": jax.device_get(params), "loss": np.asarray(loss)}
+                    )
+    except Exception as e:
+        runlog.error("pretrain_slide_encoder", e)
+        runlog.run_end(status="error")
+        raise
+    runlog.echo(f"Slide pretraining done. Best loss: {best_loss:.6f}")
+    runlog.run_end(
+        status="ok", best_loss=best_loss,
+        compile_seconds_total=watchdog.compile_seconds_total(),
+    )
     return best_path
 
 
@@ -281,7 +346,7 @@ def preprocess_slides(
         slide_id = os.path.basename(slide_file)
         out = os.path.join(output_dir, "output", slide_id)
         if os.path.isdir(out) and glob.glob(os.path.join(out, "*.png")):
-            print(f"Skipping {slide_id} - already processed")
+            console(f"Skipping {slide_id} - already processed")
         else:
             tile_one_slide(slide_file, output_dir, level=0, tile_size=tile_size)
         slide_dirs.append(out)

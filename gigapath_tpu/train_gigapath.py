@@ -13,11 +13,14 @@ from __future__ import annotations
 
 import glob
 import os
+import time
 from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from gigapath_tpu.obs import CompileWatchdog, Heartbeat, console, get_run_log
 
 
 def rename_slide_files(data_dir: str, ext: str = ".ndpi") -> List[str]:
@@ -63,7 +66,7 @@ def extract_features(
         out_path = os.path.join(output_dir, f"{slide_id}_features")
         feature_paths.append(out_path)
         if checkpoint_exists(out_path):
-            print(f"Skipping {slide_id} - features cached")
+            console(f"Skipping {slide_id} - features cached")
             continue
         slide_dir = tile_one_slide(
             slide_file, os.path.join(output_dir, "tiles"), tile_size=tile_size
@@ -94,8 +97,8 @@ def create_dummy_labels(
     df = pd.DataFrame({"slide_id": slide_ids, "label": labels})
     os.makedirs(os.path.dirname(output_file) or ".", exist_ok=True)
     df.to_csv(output_file, index=False)
-    print(f"Created labels file: {output_file}")
-    print(f"Label distribution: {df['label'].value_counts().to_dict()}")
+    console(f"Created labels file: {output_file}")
+    console(f"Label distribution: {df['label'].value_counts().to_dict()}")
     return output_file
 
 
@@ -166,26 +169,63 @@ def train_model(
         return optax.apply_updates(params, updates), opt_state, loss
 
     os.makedirs(output_dir, exist_ok=True)
+    runlog = get_run_log(
+        "train_gigapath", out_dir=output_dir,
+        config={"num_epochs": num_epochs, "learning_rate": learning_rate,
+                "freeze_pretrained": freeze_pretrained,
+                "model_arch": model_arch, "n_classes": n_classes,
+                "n_slides": len(feats)},
+    )
+    # per-slide sequence lengths vary -> one compile per distinct [1, N, D];
+    # the watchdog times each first call and flags unexpected retraces
+    watchdog = CompileWatchdog("train_gigapath.step", runlog)
+    instrumented_step = watchdog.wrap(step)
     history = []
     # run seed; a fresh per-step dropout key is split off below (a constant
     # key would freeze one dropout mask for the whole run)
     rng = jax.random.PRNGKey(0)
-    for epoch in range(num_epochs):
-        total = 0.0
-        for x, c, y in zip(feats, coords, labels):
-            rng, step_rng = jax.random.split(rng)
-            params, opt_state, loss = step(
-                params,
-                opt_state,
-                jnp.asarray(x[None]),
-                jnp.asarray(c[None]),
-                jnp.asarray([y]),
-                step_rng,
-            )
-            total += float(loss)
-        history.append(total / len(feats))
-        print(f"Epoch {epoch + 1}/{num_epochs}, loss {history[-1]:.4f}")
-    save_checkpoint(os.path.join(output_dir, "model"), {"params": jax.device_get(params)})
+    try:
+        with Heartbeat(runlog, name="train_gigapath") as heartbeat:
+            global_step = 0
+            for epoch in range(num_epochs):
+                total = 0.0
+                t_epoch = time.time()
+                for x, c, y in zip(feats, coords, labels):
+                    rng, step_rng = jax.random.split(rng)
+                    t0 = time.time()
+                    params, opt_state, loss = instrumented_step(
+                        params,
+                        opt_state,
+                        jnp.asarray(x[None]),
+                        jnp.asarray(c[None]),
+                        jnp.asarray([y]),
+                        step_rng,
+                    )
+                    total += float(loss)  # per-slide sync (tiny model)
+                    runlog.step(
+                        global_step, wall_s=round(time.time() - t0, 6),
+                        synced=True, epoch=epoch, loss=float(loss),
+                    )
+                    heartbeat.beat(global_step)
+                    global_step += 1
+                history.append(total / len(feats))
+                epoch_sec = time.time() - t_epoch
+                runlog.echo(
+                    "Epoch: {}, Loss: {:.4f}, Epoch time: {:.1f}s "
+                    "({:.3f} sec/it)".format(
+                        epoch, history[-1], epoch_sec, epoch_sec / len(feats)
+                    ),
+                    step=global_step - 1,
+                )
+        save_checkpoint(os.path.join(output_dir, "model"), {"params": jax.device_get(params)})
+    except Exception as e:
+        runlog.error("train_gigapath.train_model", e)
+        runlog.run_end(status="error")
+        raise
+    runlog.run_end(
+        status="ok", final_loss=history[-1] if history else None,
+        compile_seconds_total=watchdog.compile_seconds_total(),
+    )
     return {"loss_history": history, "n_classes": n_classes}
 
 

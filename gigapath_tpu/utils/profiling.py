@@ -43,10 +43,17 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
-def collect_moe_metadata(intermediates: Dict[str, Any]) -> Dict[str, float]:
-    """Flatten every sown ``moe_metadata`` dict into ``layer_path/metric``
-    scalars. Collect with ``model.apply(..., mutable=["intermediates"])``."""
-    out: Dict[str, float] = {}
+def iter_moe_metadata(intermediates: Dict[str, Any]):
+    """Yield ``("layer_path/metric", leaf)`` for every scalar sown under a
+    ``moe_metadata`` collection. The ONE flattening shared by the host
+    collector below and the in-graph ``gigapath_tpu.obs.telemetry``
+    twin, so their key spaces cannot drift.
+
+    Defensive on the edges (this feeds telemetry, it must never take a
+    run down): empty intermediates -> nothing; a non-scalar leaf under
+    ``moe_metadata`` (unexpected — gating stats are scalars by design) is
+    skipped rather than silently reduced to a made-up number. The size
+    check reads only the static shape, so it is trace-safe."""
     flat = jax.tree_util.tree_flatten_with_path(intermediates)[0]
     for path, leaf in flat:
         names = [getattr(p, "key", str(p)) for p in path]
@@ -54,8 +61,18 @@ def collect_moe_metadata(intermediates: Dict[str, Any]) -> Dict[str, float]:
             # path: (..., moe_metadata, <tuple idx>, <metric name>)
             metric = names[-1]
             layer = "/".join(n for n in names[: names.index("moe_metadata")])
-            out[f"{layer}/{metric}"] = float(np.asarray(leaf))
-    return out
+            if int(np.prod(getattr(leaf, "shape", ()))) != 1:
+                continue
+            yield f"{layer}/{metric}", leaf
+
+
+def collect_moe_metadata(intermediates: Dict[str, Any]) -> Dict[str, float]:
+    """Flatten every sown ``moe_metadata`` dict into ``layer_path/metric``
+    host floats. Collect with ``model.apply(..., mutable=["intermediates"])``."""
+    return {
+        key: float(np.asarray(leaf).reshape(()))
+        for key, leaf in iter_moe_metadata(intermediates)
+    }
 
 
 def compiled_flops(fn, *args) -> Optional[float]:

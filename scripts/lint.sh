@@ -6,7 +6,10 @@
 #
 # Scans gigapath_tpu/ + scripts/ + tests/ — the same scope
 # tests/test_gigalint.py enforces on every tier-1 run — honoring the
-# GIGALINT_WAIVERS file at the repo root.
+# GIGALINT_WAIVERS file at the repo root. Also runs the obs selftest
+# (scripts/obs_report.py --selftest): RunLog -> watchdog -> forced stall
+# -> rendered report, so a broken telemetry pipeline fails lint too.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+python scripts/obs_report.py --selftest 1>&2
 exec python -m tools.gigalint gigapath_tpu scripts tests "$@"

@@ -1,0 +1,266 @@
+#!/usr/bin/env python
+"""Fold a gigapath_tpu.obs run JSONL into a human report.
+
+    python scripts/obs_report.py <run.jsonl> [<run2.jsonl> ...]
+    python scripts/obs_report.py --run <run-id> <stream.jsonl>   # multi-run streams
+    python scripts/obs_report.py --selftest
+
+Sections: run manifest, throughput (steps/s + step-wall percentiles,
+synced vs unsynced), compile (total seconds, share of wall, per-key
+retrace table with unexpected retraces flagged), eval history, timeline
+(heartbeats, stalls, silent gaps between consecutive events).
+
+Pure stdlib — no jax import — so it runs anywhere the JSONL lands
+(including on a workstation far from the TPU that produced it). Exit 0
+on a rendered report, 2 on unreadable/empty input, 1 on --selftest
+failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+GAP_THRESHOLD_S = 30.0  # silence longer than this lands in the timeline
+
+
+def load_events(path: str, run_id: Optional[str] = None) -> List[dict]:
+    events = []
+    with open(path, encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError as e:
+                print(f"warning: {path}:{lineno}: bad JSON skipped ({e})",
+                      file=sys.stderr)
+                continue
+            if run_id is not None and ev.get("run") != run_id:
+                continue
+            events.append(ev)
+    return events
+
+
+def percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile on an already-sorted list."""
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def _fmt_s(x) -> str:
+    return "-" if x is None else f"{x:.3f}s"
+
+
+def render(events: List[dict], out=None) -> int:
+    out = out or sys.stdout
+    w = out.write
+    if not events:
+        w("no events\n")
+        return 2
+
+    by_kind: Dict[str, List[dict]] = {}
+    for ev in events:
+        by_kind.setdefault(ev.get("kind", "?"), []).append(ev)
+
+    runs = sorted({ev.get("run", "?") for ev in events})
+    t0, t1 = events[0].get("t", 0.0), events[-1].get("t", 0.0)
+    span = max(t1 - t0, 0.0)
+
+    # -- manifest ---------------------------------------------------------
+    w("== run ==\n")
+    w(f"run(s): {', '.join(runs)}\n")
+    for ev in by_kind.get("run_start", []):
+        bits = [f"driver={ev.get('driver')}"]
+        for key in ("jax_version", "backend", "device_kind", "device_count"):
+            if ev.get(key) is not None:
+                bits.append(f"{key}={ev[key]}")
+        w("start: " + " ".join(bits) + "\n")
+        if isinstance(ev.get("config"), dict):
+            cfg = ", ".join(f"{k}={v}" for k, v in sorted(ev["config"].items()))
+            w(f"config: {cfg}\n")
+    for ev in by_kind.get("run_end", []):
+        extras = [
+            f"{k}={v}" for k, v in ev.items()
+            if k not in ("v", "run", "kind", "t") and v is not None
+        ]
+        w("end: " + " ".join(extras) + "\n")
+    w(f"events: {len(events)} over {span:.1f}s\n\n")
+
+    # -- throughput -------------------------------------------------------
+    steps = by_kind.get("step", [])
+    w("== throughput ==\n")
+    if steps:
+        walls = sorted(
+            float(ev["wall_s"]) for ev in steps if ev.get("wall_s") is not None
+        )
+        synced = [ev for ev in steps if ev.get("synced")]
+        w(f"steps: {len(steps)} ({len(synced)} synced)")
+        if span > 0:
+            w(f", {len(steps) / span:.3f} steps/s overall")
+        w("\n")
+        if walls:
+            w(
+                "step wall: p50 {} p90 {} p99 {} max {}\n".format(
+                    _fmt_s(percentile(walls, 0.50)),
+                    _fmt_s(percentile(walls, 0.90)),
+                    _fmt_s(percentile(walls, 0.99)),
+                    _fmt_s(walls[-1]),
+                )
+            )
+            if len(synced) < len(steps):
+                w(
+                    "note: unsynced step walls are host dispatch times "
+                    "(async dispatch) — device truth lives at synced steps\n"
+                )
+        losses = [ev["loss"] for ev in steps if isinstance(ev.get("loss"), (int, float))]
+        if losses:
+            w(f"loss: first {losses[0]:.4f} last {losses[-1]:.4f}\n")
+    else:
+        w("no step events\n")
+    w("\n")
+
+    # -- compile ----------------------------------------------------------
+    compiles = by_kind.get("compile", [])
+    w("== compile ==\n")
+    if compiles:
+        total_compile = sum(
+            float(ev["seconds"]) for ev in compiles if ev.get("seconds") is not None
+        )
+        w(f"compiles: {len(compiles)}, {total_compile:.2f}s total")
+        if span > 0:
+            w(f" ({100.0 * total_compile / span:.1f}% of run wall)")
+        w("\n")
+        w("retrace table (fn / key / count / seconds):\n")
+        for ev in compiles:
+            flag = "  UNEXPECTED RETRACE" if ev.get("unexpected") else ""
+            w(
+                f"  {ev.get('fn', '?')}  {ev.get('key', '?')}  "
+                f"#{ev.get('count', 1)}  {_fmt_s(ev.get('seconds'))}{flag}\n"
+            )
+        unexpected = [ev for ev in compiles if ev.get("unexpected")]
+        if unexpected:
+            w(f"WARNING: {len(unexpected)} unexpected retrace(s)\n")
+    else:
+        w("no compile events\n")
+    w("\n")
+
+    # -- eval -------------------------------------------------------------
+    evals = by_kind.get("eval", [])
+    if evals:
+        w("== eval ==\n")
+        for ev in evals:
+            metrics = ", ".join(
+                f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in sorted(ev.items())
+                if k not in ("v", "run", "kind", "t", "step")
+            )
+            w(f"  step {ev.get('step')}: {metrics}\n")
+        w("\n")
+
+    # -- timeline ---------------------------------------------------------
+    w("== timeline ==\n")
+    stalls = by_kind.get("stall", [])
+    heartbeats = by_kind.get("heartbeat", [])
+    errors = by_kind.get("error", [])
+    w(f"heartbeats: {len(heartbeats)}, stalls: {len(stalls)}, "
+      f"errors: {len(errors)}\n")
+    for ev in stalls:
+        w(
+            f"  STALL at +{ev.get('t', 0.0) - t0:.1f}s: no progress for "
+            f"{ev.get('since_progress_s')}s (deadline {ev.get('deadline_s')}s), "
+            f"last step {ev.get('last_step')}\n"
+        )
+    for ev in errors:
+        w(f"  ERROR at +{ev.get('t', 0.0) - t0:.1f}s in {ev.get('where')}: "
+          f"{ev.get('error')}\n")
+    prev_t = None
+    for ev in events:
+        t = ev.get("t")
+        if t is None:
+            continue
+        if prev_t is not None and t - prev_t > GAP_THRESHOLD_S:
+            w(f"  gap: {t - prev_t:.1f}s of silence ending at +{t - t0:.1f}s "
+              f"(before a '{ev.get('kind')}' event)\n")
+        prev_t = t
+    return 0
+
+
+def selftest() -> int:
+    """Synthesize a run (RunLog + watchdog + a forced stall) in a temp
+    dir, render it, and assert every section materializes — the obs
+    half of scripts/lint.sh."""
+    import io
+    import tempfile
+    import time as _time
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from gigapath_tpu.obs import Heartbeat, RunLog
+    from gigapath_tpu.obs.watchdog import CompileWatchdog
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "run.jsonl")
+        log = RunLog(path, driver="selftest", echo=False)
+        log.run_start(config={"purpose": "obs selftest"}, probe_devices=False)
+        wd = CompileWatchdog("selftest.step", log)
+        for i in range(25):
+            key = (1, 128 if i < 20 else 256)
+            wd.record(key, 0.5 if wd.is_new(key) else None)
+            log.step(i, wall_s=0.01 * (i + 1), synced=i % 5 == 0, loss=1.0 / (i + 1))
+        log.eval_event(24, auroc=0.99)
+        with Heartbeat(log, interval_s=0.05, stall_after_s=0.15,
+                       name="selftest") as hb:
+            hb.beat(24)
+            _time.sleep(0.4)  # exceed the stall deadline -> stall event
+        log.run_end(status="ok")
+
+        buf = io.StringIO()
+        rc = render(load_events(path), out=buf)
+        text = buf.getvalue()
+    required = ("== throughput ==", "== compile ==", "== timeline ==",
+                "retrace table", "STALL", "p50")
+    missing = [s for s in required if s not in text]
+    if rc != 0 or missing:
+        print(text)
+        print(f"obs selftest FAILED: rc={rc}, missing sections: {missing}",
+              file=sys.stderr)
+        return 1
+    print("obs selftest OK")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python scripts/obs_report.py",
+        description="Render a human report from gigapath_tpu.obs run JSONL",
+    )
+    ap.add_argument("paths", nargs="*", help="run JSONL file(s)")
+    ap.add_argument("--run", default=None,
+                    help="filter to one run id (for multi-run streams like "
+                    "BENCH_OBS.jsonl)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="synthesize a run and verify the report renders")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return selftest()
+    if not args.paths:
+        ap.error("provide at least one run JSONL (or --selftest)")
+    events: List[dict] = []
+    for path in args.paths:
+        if not os.path.exists(path):
+            print(f"error: no such file: {path}", file=sys.stderr)
+            return 2
+        events.extend(load_events(path, run_id=args.run))
+    events.sort(key=lambda ev: ev.get("t", 0.0))
+    return render(events)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,13 @@ import pytest
 import bench
 
 
+@pytest.fixture(autouse=True)
+def _obs_stream_in_tmp(tmp_path, monkeypatch):
+    # bench.main() appends telemetry to the repo-root BENCH_OBS.jsonl;
+    # tests must not pollute the committed provenance stream
+    monkeypatch.setattr(bench, "OBS_STREAM", str(tmp_path / "BENCH_OBS.jsonl"))
+
+
 @pytest.fixture
 def no_snapshot(tmp_path, monkeypatch):
     monkeypatch.setattr(bench, "LOCAL_SNAPSHOT", str(tmp_path / "BENCH_LOCAL.json"))

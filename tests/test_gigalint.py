@@ -49,8 +49,9 @@ def test_fixture_tree_fires_every_rule_class():
     result = run_lint([FIXTURE], root=REPO_ROOT, waiver_file=None)
     assert result.exit_code != 0
     fired = {f.rule for f in result.findings}
-    assert fired >= {"GL001", "GL002", "GL003", "GL004", "GL005"}, (
-        f"missing rule classes: {sorted({'GL001','GL002','GL003','GL004','GL005'} - fired)}"
+    expected = {"GL001", "GL002", "GL003", "GL004", "GL005", "GL006"}
+    assert fired >= expected, (
+        f"missing rule classes: {sorted(expected - fired)}"
     )
 
 
@@ -78,6 +79,8 @@ def test_fixture_specific_findings():
         ("GL004", "net.py", "except"),
         ("GL005", "test_hygiene.py", "test_fixture_flag_parity_slow"),
         ("GL005", "test_hygiene.py", "test_fixture_seq_parallel_slow"),
+        ("GL006", "driver.py", "noisy_train_loop"),
+        ("GL006", "driver.py", "<module>"),
     }
     assert expected <= got, f"missing: {sorted(expected - got)}"
 

@@ -48,3 +48,51 @@ def test_cost_analysis():
     assert flops is None or flops > 0
     mem = compiled_memory(fn, x)
     assert mem is None or "argument_bytes" in mem
+
+
+# ---------------------------------------------------------------------------
+# edge cases: telemetry must never take a run down (ISSUE 2 satellite)
+# ---------------------------------------------------------------------------
+
+def test_collect_moe_metadata_empty_intermediates():
+    assert collect_moe_metadata({}) == {}
+    # intermediates without any sown moe_metadata: nothing matches
+    assert collect_moe_metadata({"layer_0": {"other": (jnp.ones(()),)}}) == {}
+
+
+def test_collect_moe_metadata_skips_non_scalar_leaves():
+    """A non-scalar leaf under moe_metadata (unexpected by design) is
+    skipped, not crashed on and not silently reduced to a fake scalar."""
+    inter = {
+        "moe": {
+            "moe_metadata": (
+                {
+                    "entropy_gating": jnp.float32(0.7),
+                    "bogus_vector": jnp.ones((4,)),  # non-scalar
+                    "shaped_scalar": jnp.ones((1, 1)),  # size 1: still fine
+                },
+            )
+        }
+    }
+    meta = collect_moe_metadata(inter)
+    assert meta["moe/entropy_gating"] == np.float32(0.7)
+    assert meta["moe/shaped_scalar"] == 1.0
+    assert not any("bogus_vector" in k for k in meta)
+
+
+def test_cost_analysis_returns_none_when_unavailable(monkeypatch):
+    """compiled_flops/compiled_memory degrade to None when XLA cost
+    analysis is unavailable (bench.py's analytic-fallback trigger)."""
+
+    def broken_jit(fn):
+        raise RuntimeError("cost analysis unavailable on this backend")
+
+    monkeypatch.setattr(jax, "jit", broken_jit)
+    assert compiled_flops(lambda x: x, jnp.ones(())) is None
+    assert compiled_memory(lambda x: x, jnp.ones(())) is None
+
+
+def test_cost_analysis_none_on_unloweratable_input():
+    # a non-array argument fails at lower time -> swallowed into None
+    assert compiled_flops(lambda x: x, object()) is None
+    assert compiled_memory(lambda x: x, object()) is None

@@ -218,6 +218,48 @@ def check_tracer_leaks(project: Project) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# GL006 — bare print in library code
+# ---------------------------------------------------------------------------
+
+# Path segments that mark host-side tooling, not library code: drivers
+# under scripts/, the test tree, demos. Test files are exempt wherever
+# they live (the selftest fixture's tests/ subtree included).
+_GL006_EXEMPT_SEGMENTS = frozenset({"scripts", "tests", "demo"})
+_GL006_MSG = (
+    "bare print() in library code: route console output through the obs "
+    "layer (RunLog.echo for run-scoped drivers, gigapath_tpu.obs.console "
+    "for one-off notices) so every run stays a machine-readable artifact"
+)
+
+
+@register(
+    "GL006",
+    "bare print() in library code — console output must flow through the "
+    "obs layer (RunLog.echo / console); scripts, tests and demos exempt",
+)
+def check_library_prints(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.modules.values():
+        segments = mod.path.split("/")[:-1]
+        if mod.is_test_file or any(
+            s in _GL006_EXEMPT_SEGMENTS for s in segments
+        ):
+            continue
+        for fn in mod.functions.values():
+            for site in fn.calls:
+                if site.callee == "print":
+                    findings.append(Finding(
+                        "GL006", mod.path, site.lineno, fn.qualname, _GL006_MSG,
+                    ))
+        for site in mod.module_calls:
+            if site.callee == "print":
+                findings.append(Finding(
+                    "GL006", mod.path, site.lineno, "<module>", _GL006_MSG,
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # GL004 — forbidden APIs
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,18 @@
+"""Seeded GL006 violations (never imported — parsed only)."""
+
+
+def noisy_train_loop(steps):
+    for step in range(steps):
+        print(f"step {step}")  # GL006: bare print in library code
+    return steps
+
+
+print("module import side-effect chatter")  # GL006: module-level print
+
+
+def negative_control_console(msg):
+    # NEGATIVE CONTROL: routed console output is the sanctioned path —
+    # no GL006 finding.
+    from gigapath_tpu.obs import console
+
+    console(msg)

@@ -20,4 +20,5 @@ def test_fixture_seq_parallel_slow():
 def test_fixture_fast_without_features():
     # NEGATIVE CONTROL: a fast test without the features does not satisfy
     # the sibling requirement, and itself produces no finding.
+    print("test chatter is fine")  # NEGATIVE CONTROL: tests are GL006-exempt
     assert True
