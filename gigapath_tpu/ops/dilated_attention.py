@@ -501,9 +501,20 @@ def dilated_attention_fused(
     valid_len=None,
     streaming_fusion: bool = False,
     interpret: bool = False,
+    flags=None,
 ) -> jnp.ndarray:
     """Fastest path: per-branch phase-major Pallas kernels on dense
     [B, L, E] activations (see :mod:`gigapath_tpu.ops.pallas_dilated`).
+
+    ``flags``: one :class:`~gigapath_tpu.ops.pallas_dilated.PipelineFlags`
+    snapshot shared by every branch of this op (None: snapshot the
+    environment here, once). ``flags.stream_fusion``
+    (``GIGAPATH_STREAM_FUSION``) routes the whole op through the
+    streaming fusion epilogue: branch results stay in the packed
+    phase-major layout end to end and one epilogue kernel chain emits the
+    fused dense output — the per-branch dense out/lse scatter (the
+    round-4 glue) never runs. The dense scatter + stacked-softmax path
+    below remains the fallback and the parity oracle.
 
     ``streaming_fusion``: fold each branch's (out, lse) into running
     (acc, m, l) instead of stacking all branch outputs — each branch's
@@ -520,12 +531,40 @@ def dilated_attention_fused(
     whose ratio does not divide the head count (never the case for LongNet's
     power-of-two schedules) fall back to the head-major path.
     """
-    from gigapath_tpu.ops.pallas_dilated import dilated_branch_attention
+    from gigapath_tpu.ops.pallas_dilated import (
+        dilated_attention_stream_fused,
+        dilated_branch_attention,
+        plan_stream_fusion,
+        snapshot_flags,
+    )
 
     B, L, H, Dh = q.shape
     E = H * Dh
+    if flags is None:
+        flags = snapshot_flags()
     qE, kE, vE = (x.reshape(B, L, E) for x in (q, k, v))
     real_len, valid_dyn = _normalize_valid_len(valid_len, B, L)
+
+    if flags.stream_fusion and len(segment_lengths) > 1:
+        plan = plan_stream_fusion(
+            L, E, H, segment_lengths, dilated_ratios, interpret=interpret,
+        )
+        if plan is not None:
+            out = dilated_attention_stream_fused(
+                qE, kE, vE, segment_lengths, dilated_ratios, H,
+                real_len=real_len, valid_len_dyn=valid_dyn,
+                is_causal=is_causal, interpret=interpret, flags=flags,
+                plan=plan,
+            )
+            return out.reshape(B, L, H, Dh)
+        # visible, once per schedule: the epilogue silently not engaging
+        # would otherwise be indistinguishable from it being slow
+        _warn_once(
+            "GIGAPATH_STREAM_FUSION requested but schedule %s/%s at L=%d "
+            "admits no epilogue blocking (ratio not dividing H=%d/E=%d, or "
+            "no legal dense-block alignment): using the dense fusion path"
+            % (list(segment_lengths), list(dilated_ratios), L, H, E)
+        )
 
     def branch(sl, r):
         sl, r = int(sl), int(r)
@@ -533,7 +572,7 @@ def dilated_attention_fused(
             return dilated_branch_attention(
                 qE, kE, vE, sl, r, H,
                 real_len=real_len, valid_len_dyn=valid_dyn,
-                is_causal=is_causal, interpret=interpret,
+                is_causal=is_causal, interpret=interpret, flags=flags,
             )
         qh, kh, vh = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
         o4, l = _branch_bhld(
@@ -749,7 +788,18 @@ def dilated_attention(
     ``custom_*`` files). A static Python int (same for every row) folds into
     the trace-time tail masks; a traced [B] array (ragged batches) rides the
     Pallas kernels' runtime SMEM valid-count tables — both keep the compiled
-    fast path.
+    fast path. Under sequence parallelism ``valid_len`` is the LOCAL
+    per-shard spec — an int bounds every shard's own suffix (correct for
+    counts derived from the sharded mask, NOT for a global single-device
+    bound carried into ``shard_map`` unchanged), and a traced [B] array is
+    each shard's own valid count (sum the sharded ``key_padding_mask`` per
+    shard, as :class:`DilatedAttention` does): segment-local branches
+    consume it
+    directly on the fused kernels, and gathered branches all-gather every
+    rank's counts to mask the concatenated keys (global suffix padding
+    keeps validity a contiguous prefix). A static int (same partial count
+    on every shard — not a contiguous prefix) and causal + ``valid_len``
+    both remain unsupported on gathered branches.
     """
     attn_fn_was_default = attn_fn is None
     if attn_fn_was_default:
@@ -825,7 +875,14 @@ def dilated_attention(
             # GIGAPATH_STREAMING_FUSION=1: fold branches into running
             # (acc, m, l) instead of stacking all branch outputs — lower
             # peak HBM, the enabler for the 1M-token operating point.
+            # GIGAPATH_STREAM_FUSION=1 rides the PipelineFlags snapshot
+            # (one consistent host-side read per op, shared by every
+            # branch) and engages the packed streaming fusion epilogue
+            # inside dilated_attention_fused.
+            from gigapath_tpu.ops.pallas_dilated import snapshot_flags
+
             streaming = _env_flag("GIGAPATH_STREAMING_FUSION")
+            flags = snapshot_flags()
             fused_ok = all(
                 H % int(rr) == 0 and (H * Dh) % int(rr) == 0
                 for rr in dilated_ratios
@@ -834,7 +891,7 @@ def dilated_attention(
                 return dilated_attention_fused(
                     q, k, v, segment_lengths, dilated_ratios,
                     is_causal=is_causal, valid_len=valid_len,
-                    streaming_fusion=streaming,
+                    streaming_fusion=streaming, flags=flags,
                 )
             # visible, once per schedule: this fallback is a perf cliff
             # (head-major re-tiles activations per branch) that no log
@@ -881,14 +938,30 @@ def dilated_attention(
             return False
         return True
 
+    # Ragged slides no longer force the generic fallback here: a traced
+    # [B] valid_len (the module derives it from the SHARDED
+    # key_padding_mask, so under shard_map it is the per-shard LOCAL
+    # valid count) rides the fused kernels' SMEM valid-count tables
+    # exactly as on a single device, and gathered branches combine the
+    # all-gathered per-rank counts below (_dilated_branch).
     fused_local = (
         kernels_eligible
         and seq_axis_name is not None
         and seq_axis_size > 1
-        and valid_len is None
         and _tpu_default_dispatch()
         and _vma_transparent()
     )
+    sp_real_len, sp_valid_dyn = (
+        _normalize_valid_len(valid_len, B, L) if fused_local else (L, None)
+    )
+    sp_flags = None
+    if fused_local:
+        # ONE flag snapshot shared by every fused-local branch of this op
+        # (same invariant as the single-device dispatch above: branches of
+        # one op must never observe different env flag values)
+        from gigapath_tpu.ops.pallas_dilated import snapshot_flags
+
+        sp_flags = snapshot_flags()
 
     outs, lses = [], []
     for i, (sl, r) in enumerate(zip(segment_lengths, dilated_ratios)):
@@ -904,7 +977,8 @@ def dilated_attention(
             oE, l = dilated_branch_attention(
                 q.reshape(B, L, H * Dh), k.reshape(B, L, H * Dh),
                 v.reshape(B, L, H * Dh), sl_i, r_i, H,
-                real_len=L, is_causal=is_causal,
+                real_len=sp_real_len, valid_len_dyn=sp_valid_dyn,
+                is_causal=is_causal, flags=sp_flags,
             )
             outs.append(oE.reshape(B, L, H, Dh))
             lses.append(l)
@@ -990,12 +1064,52 @@ def _dilated_branch(
     kv_valid_len = None
     sp_causal_bias = None
     if gather_kv:
-        if valid_len is not None:
-            raise NotImplementedError(
-                "dynamic padding masks + sequence parallelism are not "
-                "supported together yet"
-            )
         local_len = k.shape[1]
+        if valid_len is not None:
+            if is_causal:
+                raise NotImplementedError(
+                    "causal + padding masks + sequence parallelism are not "
+                    "supported together yet"
+                )
+            # Ragged gathered branch: ``valid_len`` is the LOCAL per-shard
+            # suffix valid count (the module sums the sharded
+            # key_padding_mask per shard). All-gather every rank's counts
+            # and keep the ranks of my segment — mirroring
+            # _gather_kv_seq_parallel's key selection — then count valid
+            # sparse slots per (rank block, head phase): local slot j of
+            # head phase p sits at local position p + r*j, valid iff
+            # < that rank's count. GLOBAL suffix padding makes validity a
+            # contiguous prefix of the concatenated key axis (every rank
+            # before the cut is full), so a single per-(batch, head)
+            # count is exact. A static int CANNOT express that: it is the
+            # same partial count on EVERY rank, i.e. holes mid-axis that
+            # a prefix count would silently mis-mask — refuse it.
+            if isinstance(valid_len, (int, np.integer)):
+                raise NotImplementedError(
+                    "a static-int valid_len on a gathered sequence-parallel "
+                    "branch would mask the same suffix on every shard — not "
+                    "a contiguous prefix of the concatenated key axis; pass "
+                    "the traced per-shard counts of a suffix-padded batch "
+                    "(sum the sharded key_padding_mask) instead"
+                )
+            rps = sl // local_len
+            m_loc = ks.shape[1]
+            vl_local = jnp.asarray(valid_len, jnp.int32).reshape(B)
+            all_counts = jax.lax.all_gather(
+                vl_local, seq_axis_name, axis=0
+            )  # [W, B]
+            rank = jax.lax.axis_index(seq_axis_name)
+            seg_counts = jax.lax.dynamic_slice_in_dim(
+                all_counts, rank // rps * rps, rps, axis=0
+            )  # [rps, B]
+            heads_per_group = -(-H // r)
+            phases = jnp.arange(H) // heads_per_group  # [H]
+            per_rank = jnp.ceil(
+                (seg_counts[:, :, None] - phases[None, None, :]) / r
+            )
+            per_rank = jnp.clip(per_rank, 0, m_loc).astype(jnp.int32)
+            kv_valid_len = per_rank.sum(axis=0)  # [B, H] == [B*n_seg, H]
+            valid_len = None  # consumed
         ks = _gather_kv_seq_parallel(ks, sl, local_len, seq_axis_name)
         vs = _gather_kv_seq_parallel(vs, sl, local_len, seq_axis_name)
         if is_causal:

@@ -839,11 +839,13 @@ class PipelineFlags(NamedTuple):
     pipe_block_k: Optional[int] = None  # None: VMEM-budget auto choice
     pipe_bwd_block_k: Optional[int] = None
     pack_direct: bool = False
+    stream_fusion: bool = False
 
 
 def snapshot_flags() -> PipelineFlags:
-    """Read GIGAPATH_PIPELINED_ATTN/_BWD, GIGAPATH_PIPE(_BWD)_BLOCK_K and
-    GIGAPATH_PACK_DIRECT from the environment, once."""
+    """Read GIGAPATH_PIPELINED_ATTN/_BWD, GIGAPATH_PIPE(_BWD)_BLOCK_K,
+    GIGAPATH_PACK_DIRECT and GIGAPATH_STREAM_FUSION from the environment,
+    once."""
     import os
 
     from gigapath_tpu.ops.common import env_flag
@@ -858,6 +860,7 @@ def snapshot_flags() -> PipelineFlags:
         pipe_block_k=_int("GIGAPATH_PIPE_BLOCK_K"),
         pipe_bwd_block_k=_int("GIGAPATH_PIPE_BWD_BLOCK_K"),
         pack_direct=env_flag("GIGAPATH_PACK_DIRECT"),
+        stream_fusion=env_flag("GIGAPATH_STREAM_FUSION"),
     )
 
 
@@ -1269,8 +1272,13 @@ def _pipe_block_k(block_q: int, override: Optional[int]) -> int:
     return max(LANES, min(bk, block_q))
 
 
-def _dilated_branch_fwd_impl(q, k, v, vl_dyn, sl, r, H, real_len, causal,
-                             interpret, flags):
+def _branch_packed_fwd_impl(q, k, v, vl_dyn, sl, r, H, real_len, causal,
+                            interpret, flags):
+    """Shared forward core: dense [B, L, E] q/k/v -> PACKED
+    ``(out6 [B, S, r, hb, Mp, Dh], lse5 [B, S, r, Mp, LANES])`` — the
+    kernel-native layout, consumed either by the dense unpack/scatter pair
+    (:func:`_dilated_branch_fwd_impl`) or directly by the streaming fusion
+    epilogue (which never materializes the dense per-branch tensors)."""
     B, L, E = q.shape
     Dh = E // H
     g, S, gp, m, Mp, block = _branch_geometry(L, E, sl, r)
@@ -1289,6 +1297,16 @@ def _dilated_branch_fwd_impl(q, k, v, vl_dyn, sl, r, H, real_len, causal,
             q6, k6, v6, kvlen, causal, Dh ** -0.5, hb, Dh, block, block,
             interpret,
         )
+    return out6, lse5
+
+
+def _dilated_branch_fwd_impl(q, k, v, vl_dyn, sl, r, H, real_len, causal,
+                             interpret, flags):
+    B, L, E = q.shape
+    g, S, gp, m, Mp, block = _branch_geometry(L, E, sl, r)
+    out6, lse5 = _branch_packed_fwd_impl(
+        q, k, v, vl_dyn, sl, r, H, real_len, causal, interpret, flags
+    )
     # off-band lanes come back as exact zeros from the unpack kernel — the
     # branch's cover pattern needs no separate select
     out = _unpack_phases(out6, L, E, g, S, r, interpret, flags.pack_direct)
@@ -1309,17 +1327,20 @@ def _dilated_branch_fwd(q, k, v, vl_dyn, sl, r, H, real_len, causal,
     return (out, lse), ((q, k, v, vl_dyn) + res, q.shape)
 
 
-def _dilated_branch_bwd(sl, r, H, real_len, causal, interpret, flags, saved,
-                        cotangents):
-    (q, k, v, vl_dyn, out6, lse5), (B, L, E) = saved
-    do, _dlse = cotangents  # no gradient flows through the lse output
+def _branch_bwd_core(q, k, v, vl_dyn, do6, out6, lse5, sl, r, H, real_len,
+                     causal, interpret, flags):
+    """Shared backward core: PACKED cotangent ``do6`` (plus the saved
+    packed forward results) -> dense ``(dq, dk, dv, vl_ct)``. Callers:
+    the dense branch VJP (packs its dense ``do`` first) and the packed
+    branch VJP behind the streaming fusion epilogue (whose epilogue
+    backward emits ``do6`` already packed — no dense round-trip)."""
+    B, L, E = q.shape
     Dh = E // H
     hb = H // r
     g, S, gp, m, Mp, block = _branch_geometry(L, E, sl, r)
     q6 = _pack_phases(q, g, S, r, Mp, H, interpret, flags.pack_direct)
     k6 = _pack_phases(k, g, S, r, Mp, H, interpret, flags.pack_direct)
     v6 = _pack_phases(v, g, S, r, Mp, H, interpret, flags.pack_direct)
-    do6 = _pack_phases(do, g, S, r, Mp, H, interpret, flags.pack_direct)
     # delta = rowsum(do * out) per (token, head), in the kernel's lse
     # layout [B, S, r, Mp, LANES] — the packed arrays ARE the diagonal
     delta = (do6.astype(jnp.float32) * out6.astype(jnp.float32)).sum(axis=-1)
@@ -1348,6 +1369,18 @@ def _dilated_branch_bwd(sl, r, H, real_len, causal, interpret, flags, saved,
         else np.zeros(vl_dyn.shape, dtype=jax.dtypes.float0)
     )
     return undo(dq6), undo(dk6), undo(dv6), vl_ct
+
+
+def _dilated_branch_bwd(sl, r, H, real_len, causal, interpret, flags, saved,
+                        cotangents):
+    (q, k, v, vl_dyn, out6, lse5), (B, L, E) = saved
+    do, _dlse = cotangents  # no gradient flows through the lse output
+    g, S, gp, m, Mp, block = _branch_geometry(L, E, sl, r)
+    do6 = _pack_phases(do, g, S, r, Mp, H, interpret, flags.pack_direct)
+    return _branch_bwd_core(
+        q, k, v, vl_dyn, do6, out6, lse5, sl, r, H, real_len, causal,
+        interpret, flags,
+    )
 
 
 _dilated_branch.defvjp(_dilated_branch_fwd, _dilated_branch_bwd)
@@ -1392,3 +1425,544 @@ def dilated_branch_attention(
         q, k, v, valid_len_dyn, int(sl), int(r), num_heads, rl, is_causal,
         interpret, flags,
     )
+
+
+# ---------------------------------------------------------------------------
+# packed-boundary branch op (for the streaming fusion epilogue)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _dilated_branch_packed(q, k, v, vl_dyn, sl, r, H, real_len, causal,
+                           interpret, flags):
+    """Branch op with a PACKED output boundary: dense q/k/v in, packed
+    ``(out6, lse5)`` out. Twin of :func:`_dilated_branch` whose backward
+    accepts the cotangent *already in the packed layout* (the epilogue
+    backward emits it there), so neither direction ever materializes the
+    dense per-branch out/lse tensors."""
+    out6, lse5 = _branch_packed_fwd_impl(
+        q, k, v, vl_dyn, sl, r, H, real_len, causal, interpret, flags
+    )
+    return out6, lse5
+
+
+def _dilated_branch_packed_fwd(q, k, v, vl_dyn, sl, r, H, real_len, causal,
+                               interpret, flags):
+    out6, lse5 = _branch_packed_fwd_impl(
+        q, k, v, vl_dyn, sl, r, H, real_len, causal, interpret, flags
+    )
+    # Residuals mirror _dilated_branch_fwd: dense q/k/v (shared across
+    # branches — XLA stores one copy) + this branch's packed results.
+    return (out6, lse5), (q, k, v, vl_dyn, out6, lse5)
+
+
+def _dilated_branch_packed_bwd(sl, r, H, real_len, causal, interpret, flags,
+                               saved, cotangents):
+    q, k, v, vl_dyn, out6, lse5 = saved
+    do6, _dlse5 = cotangents  # no gradient flows through the lse output
+    return _branch_bwd_core(
+        q, k, v, vl_dyn, do6, out6, lse5, sl, r, H, real_len, causal,
+        interpret, flags,
+    )
+
+
+_dilated_branch_packed.defvjp(_dilated_branch_packed_fwd,
+                              _dilated_branch_packed_bwd)
+
+
+def dilated_branch_attention_packed(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    sl: int,
+    r: int,
+    num_heads: int,
+    *,
+    real_len: Optional[int] = None,
+    valid_len_dyn: Optional[jnp.ndarray] = None,
+    is_causal: bool = False,
+    interpret: bool = False,
+    flags: Optional[PipelineFlags] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One dilated branch returning the PACKED phase-major results
+    ``(out6 [B, S, r, hb, Mp, Dh], lse5 [B, S, r, Mp, LANES])`` — the
+    streaming fusion epilogue's input contract. Same eligibility rules as
+    :func:`dilated_branch_attention`."""
+    B, L, E = q.shape
+    assert E % num_heads == 0
+    assert num_heads % r == 0 and E % r == 0, (num_heads, E, r)
+    rl = L if real_len is None else min(int(real_len), L)
+    if flags is None:
+        flags = snapshot_flags()
+    return _dilated_branch_packed(
+        q, k, v, valid_len_dyn, int(sl), int(r), num_heads, rl, is_causal,
+        interpret, flags,
+    )
+
+
+# ---------------------------------------------------------------------------
+# streaming cross-branch fusion epilogue
+# ---------------------------------------------------------------------------
+#
+# The dense fusion path scatters every branch's packed (out, lse) back to
+# dense [B, L, E] / [B, H, L] (one re-tile pass per packed tensor,
+# ~40-53 us each, plus the lse scatter) and only then runs the
+# cross-branch LSE-softmax — the ~1.7 ms/layer residual glue of the
+# round-4 decomposition. The epilogue below consumes every branch's
+# results directly in the packed phase-major layout: for each dense token
+# block it reads the covering (phase, band-head) lanes of each branch,
+# folds them through an online softmax over the BRANCH axis (the same
+# "combine partials via stored log-sum-exp" trick flash attention uses
+# inside one kernel), and writes only the final fused [B, L, E] output.
+# The per-branch dense out/lse tensors are never materialized.
+#
+# Alignment: a single epilogue pass needs every consumed branch to map a
+# dense token block of BT tokens onto whole packed row blocks — i.e.
+# r | BT, BT/r >= the 8-row fp32 sublane tile, and (for multi-segment
+# branches) BT | g so blocks never straddle a segment boundary. Schedules
+# whose branches cannot share one BT (the flagship's 5792-token segment:
+# 2^5 * 181) are split into alignment CLASSES: one pass per class,
+# chained through compact running state (acc [B, L, E] f32 + per-head
+# (m, l) [B, L, H] f32), the last pass finalizing out = acc / l and the
+# fused lse = m + log(l) (the backward's only residual besides the
+# branch lse tables themselves).
+
+
+class EpiloguePlan(NamedTuple):
+    """Static geometry of one streaming-fusion epilogue instance. Hashable
+    (participates in jit cache keys via the custom_vjp's nondiff args)."""
+
+    L: int
+    E: int
+    H: int
+    Dh: int
+    branches: Tuple[Tuple[int, int, int, int, int], ...]  # (r, hb, S, g, Mp)
+    classes: Tuple[Tuple[int, Tuple[int, ...]], ...]  # (BT_tokens, members)
+    bwd_bt: Tuple[int, ...]  # per-branch backward packed-row block
+    interpret: bool = False
+
+
+_EPILOGUE_BT_CANDIDATES = (512, 256, 128, 64, 32, 16, 8)
+# fwd per-cell fp32 dense temps: acc/m/l running state + 2 transient
+# assemblies + the out block => keep ~6 [BT, E] fp32 buffers under budget
+_EPILOGUE_VMEM_BUDGET = 10 * 2 ** 20
+
+
+def _epilogue_bt_feasible(BT: int, r: int, S: int, g: int, Mp: int) -> bool:
+    bt = BT // r
+    return (
+        BT % r == 0
+        and bt >= 8
+        and bt % 8 == 0
+        and bt <= Mp
+        and (S == 1 or g % BT == 0)
+    )
+
+
+def plan_stream_fusion(
+    L: int, E: int, H: int,
+    segment_lengths, dilated_ratios,
+    interpret: bool = False,
+) -> Optional[EpiloguePlan]:
+    """Build the epilogue's static plan, or None when the schedule's
+    geometry admits no legal blocking (callers fall back to the dense
+    scatter + stacked fusion path, which stays the parity oracle)."""
+    n = len(segment_lengths)
+    if n < 2:
+        return None
+    Dh = E // H
+    branches = []
+    for sl, r in zip(segment_lengths, dilated_ratios):
+        sl, r = int(sl), int(r)
+        if H % r != 0 or E % r != 0:
+            return None
+        g, S, gp, m, Mp, block = _branch_geometry(L, E, sl, r)
+        branches.append((r, H // r, S, g, Mp))
+
+    def feasible(bi: int, BT: int) -> bool:
+        r, hb, S, g, Mp = branches[bi]
+        return _epilogue_bt_feasible(BT, r, S, g, Mp)
+
+    # greedy alignment classes: largest BT covering the most branches
+    # first; leftovers get their own (largest feasible) class each
+    remaining = set(range(n))
+    classes = []
+    while remaining:
+        best_bt, best_members = None, []
+        for BT in _EPILOGUE_BT_CANDIDATES:
+            members = [i for i in sorted(remaining) if feasible(i, BT)]
+            if len(members) > len(best_members):
+                best_bt, best_members = BT, members
+        if not best_members:
+            return None
+        # shrink BT while the class's fp32 dense temps overflow the VMEM
+        # budget (halving preserves feasibility only while bt stays >= 8)
+        BT = best_bt
+
+        def est(bt_tokens: int) -> int:
+            state = 6 * bt_tokens * E * 4
+            packed = sum(
+                3 * bt_tokens * E * 4 // branches[i][0] for i in best_members
+            )
+            return state + packed
+
+        while (
+            est(BT) > _EPILOGUE_VMEM_BUDGET
+            and BT // 2 >= 8
+            and all(feasible(i, BT // 2) for i in best_members)
+        ):
+            BT //= 2
+        classes.append((BT, tuple(best_members)))
+        remaining -= set(best_members)
+
+    # per-branch backward row blocks: the backward is one independent
+    # pallas_call per branch over ITS packed rows, so only that branch's
+    # own geometry constrains the block
+    bwd_bt = []
+    for r, hb, S, g, Mp in branches:
+        bt = None
+        for cand in (128, 64, 32, 16, 8):
+            if (
+                cand <= Mp
+                and r * cand <= 512
+                and (S == 1 or g % (cand * r) == 0)
+            ):
+                bt = cand
+                break
+        if bt is None:
+            return None
+        bwd_bt.append(bt)
+
+    return EpiloguePlan(
+        L=L, E=E, H=H, Dh=Dh,
+        branches=tuple(branches),
+        classes=tuple(classes),
+        bwd_bt=tuple(bwd_bt),
+        interpret=bool(interpret),
+    )
+
+
+def _head_lane_mask(H: int, E: int, Dh: int) -> jnp.ndarray:
+    """Static [H, E] 0/1 matrix: lane e belongs to head e // Dh. Built from
+    iotas on-device (host constants show up as per-step pred[] DMAs).
+    One matmul against it expands per-head [*, H] stats to the [*, E]
+    broadcast form; the transposed contraction (scaled by 1/Dh) compresses
+    the lane-duplicated [*, E] form back to [*, H] exactly."""
+    hh = jax.lax.broadcasted_iota(jnp.int32, (H, E), 0)
+    ee = jax.lax.broadcasted_iota(jnp.int32, (H, E), 1)
+    return (ee // Dh == hh).astype(jnp.float32)
+
+
+def _expand_heads(x, mask):
+    """[BT, H] -> [BT, E] (each head's value broadcast over its Dh lanes)."""
+    return jax.lax.dot_general(
+        x, mask, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _compress_heads(x, mask, Dh):
+    """[BT, E] lane-duplicated -> [BT, H] (exact: mean over the Dh copies)."""
+    return jax.lax.dot_general(
+        x, mask, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * (1.0 / Dh)
+
+
+def _assemble_lse(l_ref, r, hb, Dh, E, bt):
+    """Packed lse block [.., r, bt, LANES] -> dense row-block [bt, r*E]
+    fp32 with the branch lse broadcast over each band head's Dh lanes and
+    NEG_INF everywhere off-band — the lse twin of :func:`_assemble_bands`
+    (same _band_lanes layout; the two must never diverge)."""
+    lane_iota = jax.lax.broadcasted_iota(jnp.int32, (bt, LANES), 1)
+    pieces = []
+    cursor = 0
+    for p, t, lane in _band_lanes(r, hb, Dh, E):
+        if lane > cursor:
+            pieces.append(jnp.full((bt, lane - cursor), NEG_INF, jnp.float32))
+        # lane t of the [bt, LANES] block, extracted mask-and-rowsum (no
+        # lane shuffles — same idiom as the backward kernels' _lane)
+        col = jnp.sum(
+            jnp.where(lane_iota == t, l_ref[0, 0, p], 0.0),
+            axis=1, keepdims=True,
+        )
+        pieces.append(jnp.broadcast_to(col, (bt, Dh)))
+        cursor = lane + Dh
+    if r * E > cursor:
+        pieces.append(jnp.full((bt, r * E - cursor), NEG_INF, jnp.float32))
+    return jnp.concatenate(pieces, axis=-1)
+
+
+def _epilogue_fwd_kernel(*refs, brs, E, H, Dh, BT, first, final):
+    """One dense [BT, E] token block: fold every class branch's packed
+    (out, lse) into the running (acc, m, l) online softmax over branches.
+
+    refs layout: per branch (out6 block, lse5 block); then, unless
+    ``first``, the incoming (acc [BT,E] f32, m [BT,H] f32, l [BT,H] f32)
+    state; then the outputs — (out [BT,E] dtype, fused_lse [BT,H] f32)
+    when ``final``, else the outgoing (acc, m, l) state."""
+    n = len(brs)
+    pos = 2 * n
+    mask = _head_lane_mask(H, E, Dh)
+    acc = m_run = l_run = None
+    if not first:
+        acc_in, m_in, l_in = refs[pos:pos + 3]
+        pos += 3
+        acc = acc_in[0]
+        m_run = _expand_heads(m_in[0], mask)
+        l_run = _expand_heads(l_in[0], mask)
+    out_refs = refs[pos:]
+
+    for bi, (r, hb, bt) in enumerate(brs):
+        o_ref, l_ref = refs[2 * bi], refs[2 * bi + 1]
+        o_d = _assemble_bands(o_ref, r, hb, Dh, E, bt, jnp.float32)
+        o_d = o_d.reshape(BT, E)
+        l_d = _assemble_lse(l_ref, r, hb, Dh, E, bt).reshape(BT, E)
+        if acc is None:
+            acc, m_run, l_run = o_d, l_d, jnp.ones_like(l_d)
+        else:
+            m_new = jnp.maximum(m_run, l_d)
+            a = jnp.exp(m_run - m_new)
+            b_ = jnp.exp(l_d - m_new)
+            acc = acc * a + o_d * b_
+            l_run = l_run * a + b_
+            m_run = m_new
+
+    if final:
+        o_out, lse_out = out_refs
+        o_out[0] = (acc / l_run).astype(o_out.dtype)
+        lse_out[0] = _compress_heads(m_run + jnp.log(l_run), mask, Dh)
+    else:
+        acc_out, m_out, l_out = out_refs
+        acc_out[0] = acc
+        m_out[0] = _compress_heads(m_run, mask, Dh)
+        l_out[0] = _compress_heads(l_run, mask, Dh)
+
+
+def _epilogue_pass_call(operands, geoms, B, plan, BT, first, final,
+                        out_dtype):
+    """One class pass: grid over (batch, dense token blocks)."""
+    L, E, H, Dh = plan.L, plan.E, plan.H, plan.Dh
+    NB = -(-L // BT)
+    brs = []
+    in_specs = []
+    for (r, hb, S, g, Mp) in geoms:
+        bt = BT // r
+        brs.append((r, hb, bt))
+        bps = g // BT if S > 1 else 0
+
+        def o_map(b, i, bps=bps):
+            if bps:
+                return (b, i // bps, 0, 0, i % bps, 0)
+            return (b, 0, 0, 0, i, 0)
+
+        def l_map(b, i, bps=bps):
+            if bps:
+                return (b, i // bps, 0, i % bps, 0)
+            return (b, 0, 0, i, 0)
+
+        in_specs.append(pl.BlockSpec(
+            (1, 1, r, hb, bt, Dh), o_map, memory_space=pltpu.VMEM,
+        ))
+        in_specs.append(pl.BlockSpec(
+            (1, 1, r, bt, LANES), l_map, memory_space=pltpu.VMEM,
+        ))
+    dense_spec = pl.BlockSpec(
+        (1, BT, E), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM,
+    )
+    stat_spec = pl.BlockSpec(
+        (1, BT, H), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM,
+    )
+    if not first:
+        in_specs += [dense_spec, stat_spec, stat_spec]
+    if final:
+        out_specs = [dense_spec, stat_spec]
+        out_shape = [
+            jax.ShapeDtypeStruct((B, L, E), out_dtype),
+            jax.ShapeDtypeStruct((B, L, H), jnp.float32),
+        ]
+    else:
+        out_specs = [dense_spec, stat_spec, stat_spec]
+        out_shape = [
+            jax.ShapeDtypeStruct((B, L, E), jnp.float32),
+            jax.ShapeDtypeStruct((B, L, H), jnp.float32),
+            jax.ShapeDtypeStruct((B, L, H), jnp.float32),
+        ]
+    kernel = functools.partial(
+        _epilogue_fwd_kernel, brs=tuple(brs), E=E, H=H, Dh=Dh, BT=BT,
+        first=first, final=final,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(B, NB),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=plan.interpret,
+    )(*operands)
+
+
+def _epilogue_bwd_kernel(dy_ref, fl_ref, lse_ref, do_ref, *, r, hb, Dh, E,
+                         bt, g, S, L):
+    """One branch's packed cotangent block: d_out6 = w (x) extract(dY),
+    where w = exp(lse_branch - fused_lse) re-derives the cross-branch
+    softmax weight from the saved per-branch lse table and the fused
+    (m + log l) residual — weights are constants in the backward
+    (stop-gradient parity with the dense path / reference torch.no_grad).
+    Rows past the real sequence (or the segment's dense extent) are
+    zeroed by LOGICAL index, matching _pack_phases' zero padding — the
+    downstream dK/dV kernels rely on padded query rows of do6 being
+    exact zeros."""
+    s = pl.program_id(1)
+    i = pl.program_id(2)
+    BT = bt * r
+    H = r * hb
+    mask = _head_lane_mask(H, E, Dh)
+    fused = _expand_heads(fl_ref[0], mask)  # [BT, E]
+    lse_d = _assemble_lse(lse_ref, r, hb, Dh, E, bt).reshape(BT, E)
+    w = jnp.exp(lse_d - fused)
+    x = dy_ref[0].astype(jnp.float32) * w
+    rows = jax.lax.broadcasted_iota(jnp.int32, (BT, 1), 0) + i * BT
+    limit = jnp.minimum(g, L - s * g)  # in-segment AND inside the sequence
+    x = jnp.where(rows < limit, x, 0.0)
+    _extract_bands(x.astype(do_ref.dtype).reshape(bt, r * E), do_ref,
+                   r, hb, Dh)
+
+
+def _epilogue_bwd_call(dy, fused_lse, lse5, geom, bt, plan):
+    """One branch's backward pass: grid over (batch, segment, packed row
+    blocks) — covering EVERY packed row (rows beyond the dense extent are
+    written as exact zeros), so no uninitialized slot ever reaches the
+    branch backward kernels."""
+    L, E, Dh = plan.L, plan.E, plan.Dh
+    r, hb, S, g, Mp = geom
+    B = dy.shape[0]
+    BT = bt * r
+    bps = g // BT if S > 1 else 0
+
+    def dense_map(b, s, i, bps=bps):
+        if bps:
+            return (b, s * bps + i, 0)
+        return (b, i, 0)
+
+    dy_spec = pl.BlockSpec((1, BT, E), dense_map, memory_space=pltpu.VMEM)
+    fl_spec = pl.BlockSpec(
+        (1, BT, r * hb), dense_map, memory_space=pltpu.VMEM,
+    )
+    lse_spec = pl.BlockSpec(
+        (1, 1, r, bt, LANES), lambda b, s, i: (b, s, 0, i, 0),
+        memory_space=pltpu.VMEM,
+    )
+    do_spec = pl.BlockSpec(
+        (1, 1, r, hb, bt, Dh), lambda b, s, i: (b, s, 0, 0, i, 0),
+        memory_space=pltpu.VMEM,
+    )
+    kernel = functools.partial(
+        _epilogue_bwd_kernel, r=r, hb=hb, Dh=Dh, E=E, bt=bt, g=g, S=S, L=L,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(B, S, Mp // bt),
+        in_specs=[dy_spec, fl_spec, lse_spec],
+        out_specs=do_spec,
+        out_shape=jax.ShapeDtypeStruct((B, S, r, hb, Mp, Dh), dy.dtype),
+        interpret=plan.interpret,
+    )(dy, fused_lse, lse5)
+
+
+def _fusion_epilogue_fwd_impl(outs, lses, plan):
+    B = outs[0].shape[0]
+    out_dtype = outs[0].dtype
+    ncls = len(plan.classes)
+    state = None
+    for ci, (BT, members) in enumerate(plan.classes):
+        first, final = ci == 0, ci == ncls - 1
+        geoms = [plan.branches[bi] for bi in members]
+        operands = []
+        for bi in members:
+            operands += [outs[bi], lses[bi]]
+        if not first:
+            operands += list(state)
+        state = _epilogue_pass_call(
+            operands, geoms, B, plan, BT, first, final, out_dtype,
+        )
+    out, fused_lse = state
+    return out, fused_lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _fusion_epilogue(outs, lses, plan):
+    """Fused cross-branch softmax over PACKED branch results -> dense
+    [B, L, E]. Same math as the stacked dense fusion (softmax of the
+    branch LSEs, NEG_INF at uncovered slots -> weight 0, all-uncovered
+    slots -> 0 output), weights constant in the backward."""
+    out, _ = _fusion_epilogue_fwd_impl(outs, lses, plan)
+    return out
+
+
+def _fusion_epilogue_fwd(outs, lses, plan):
+    out, fused_lse = _fusion_epilogue_fwd_impl(outs, lses, plan)
+    # residuals: the branches' packed lse tables (shared with the branch
+    # ops' own residuals — XLA stores one copy) + the compact fused
+    # (m + log l) per (token, head); no dense per-branch tensor is saved
+    return out, (lses, fused_lse)
+
+
+def _fusion_epilogue_bwd(plan, res, dy):
+    lses, fused_lse = res
+    d_outs = tuple(
+        _epilogue_bwd_call(
+            dy, fused_lse, lses[bi], plan.branches[bi], plan.bwd_bt[bi], plan,
+        )
+        for bi in range(len(plan.branches))
+    )
+    # the fusion weights are constants in the backward: zero cotangent
+    # into every branch lse (packed shape — never a dense [B, H, L])
+    d_lses = tuple(jnp.zeros(l.shape, l.dtype) for l in lses)
+    return d_outs, d_lses
+
+
+_fusion_epilogue.defvjp(_fusion_epilogue_fwd, _fusion_epilogue_bwd)
+
+
+def dilated_attention_stream_fused(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    segment_lengths,
+    dilated_ratios,
+    num_heads: int,
+    *,
+    real_len: Optional[int] = None,
+    valid_len_dyn: Optional[jnp.ndarray] = None,
+    is_causal: bool = False,
+    interpret: bool = False,
+    flags: Optional[PipelineFlags] = None,
+    plan: Optional[EpiloguePlan] = None,
+) -> jnp.ndarray:
+    """Multi-branch dilated attention on dense [B, L, E] with the
+    streaming fusion epilogue: every branch runs the packed-boundary op
+    and the packed results flow straight into :func:`_fusion_epilogue` —
+    no dense per-branch out/lse is ever materialized, forward or
+    backward. Callers must have checked :func:`plan_stream_fusion`
+    feasibility (pass the plan in to avoid recomputing it)."""
+    B, L, E = q.shape
+    if flags is None:
+        flags = snapshot_flags()
+    if plan is None or plan.interpret != bool(interpret):
+        # a caller-built plan must agree with this call's interpret mode —
+        # the epilogue pallas_calls read it from the plan (rebuilding keeps
+        # e.g. interpret-forcing test wrappers honest)
+        plan = plan_stream_fusion(
+            L, E, num_heads, segment_lengths, dilated_ratios,
+            interpret=interpret,
+        )
+    assert plan is not None, "caller must gate on plan_stream_fusion"
+    outs, lses = [], []
+    for sl, r in zip(segment_lengths, dilated_ratios):
+        o6, l5 = dilated_branch_attention_packed(
+            q, k, v, int(sl), int(r), num_heads,
+            real_len=real_len, valid_len_dyn=valid_len_dyn,
+            is_causal=is_causal, interpret=interpret, flags=flags,
+        )
+        outs.append(o6)
+        lses.append(l5)
+    return _fusion_epilogue(tuple(outs), tuple(lses), plan)

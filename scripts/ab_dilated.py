@@ -6,10 +6,21 @@ numbers are incomparable) and prints ms per 5-branch op plus effective
 TFLOPS on the intrinsic branch FLOPs. Variants via --variants, e.g.::
 
     python scripts/ab_dilated.py --variants bhld,fused
+    python scripts/ab_dilated.py --variants fused,stream --grad
     python scripts/ab_dilated.py --variants bhld --branches 0,1,2,3,4
+
+``--json PATH`` additionally writes a machine-checkable DECISION TABLE
+(per-variant ms/TFLOPS + the fused-vs-stream verdict) and emits the same
+payload as a ``run_end`` event through the obs runlog (stream
+``AB_DILATED_OBS.jsonl`` next to the repo's bench stream), so the
+epilogue adoption decision is one command the moment a chip answers::
+
+    python scripts/ab_dilated.py --variants fused,stream --json AB_EPILOGUE.json
+    python scripts/ab_dilated.py --variants fused,stream --grad --json AB_EPILOGUE_GRAD.json
 """
 
 import argparse
+import json
 import os
 import sys
 
@@ -42,6 +53,11 @@ def main():
         "--pipebwd", action="store_true",
         help="with --grad: also run a GIGAPATH_PIPELINED_BWD twin of each "
         "fused variant",
+    )
+    ap.add_argument(
+        "--json", default="",
+        help="write the decision-table JSON here (also emitted as a "
+        "run_end obs event)",
     )
     args = ap.parse_args()
 
@@ -98,6 +114,10 @@ def main():
         )
     if "fused" in args.variants:
         variants["fused"] = fused
+    if "stream" in args.variants:
+        # streaming cross-branch fusion epilogue: packed branch results,
+        # one epilogue kernel chain, no per-branch dense out/lse scatter
+        variants["stream"] = with_env(fused, GIGAPATH_STREAM_FUSION=1)
     if "pipe" in args.variants:
         for bk in (int(b) for b in args.pipe_bk.split(",") if b):
             variants[f"pipe{bk}"] = with_env(
@@ -147,12 +167,53 @@ def main():
                 make_step(fn), q, args=(k, v), iters_low=2, iters_high=2 + args.iters
             )
             results[name].append(sec)
+    table = {}
     for name, secs in results.items():
         best = min(secs)
+        table[name] = {
+            "ms_per_op": round(best * 1e3, 3),
+            "tflops": round(flops / best / 1e12, 1),
+            "rounds_ms": [round(s * 1e3, 3) for s in secs],
+        }
         print(
             f"{name:8s} {best * 1e3:8.3f} ms/op   {flops / best / 1e12:6.1f} TFLOPS"
             f"   (rounds: {', '.join(f'{s * 1e3:.3f}' for s in secs)})"
         )
+
+    if args.json:
+        payload = {
+            "metric": "ab_dilated_grad" if args.grad else "ab_dilated_fwd",
+            "n": L, "heads": H, "head_dim": Dh,
+            "branches": [[int(s), int(r)] for s, r in zip(SEGS, RATIOS)],
+            "variants": table,
+        }
+        # the decision row the epilogue A/B exists for: adopt the
+        # streaming epilogue when it beats the dense-scatter fused path
+        # by more than measurement noise (>= 3%)
+        if "fused" in table and "stream" in table:
+            f_ms = table["fused"]["ms_per_op"]
+            s_ms = table["stream"]["ms_per_op"]
+            payload["decision"] = {
+                "fused_ms": f_ms,
+                "stream_ms": s_ms,
+                "stream_over_fused": round(s_ms / f_ms, 4),
+                "adopt_stream_fusion": bool(s_ms <= f_ms * 0.97),
+            }
+        with open(args.json, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+        # decision provenance rides the obs stream like bench.py's
+        # snapshots: one run_end event per A/B invocation
+        from gigapath_tpu.obs import get_run_log
+
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        log = get_run_log(
+            "ab_dilated", config={"argv": sys.argv[1:]},
+            path=os.path.join(repo_root, "AB_DILATED_OBS.jsonl"),
+            echo=False,
+        )
+        log.run_end(status="ok", **payload)  # run_end closes the log
+        print(json.dumps(payload))
 
 
 if __name__ == "__main__":

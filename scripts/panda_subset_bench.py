@@ -193,17 +193,26 @@ def main():
     total = time.perf_counter() - t0
 
     # steady-state = epochs after the buckets compiled (epoch prints carry
-    # wall time per epoch); compile cost is the first-epoch difference
+    # wall time per epoch); compile cost is the first-epoch difference.
+    # sec/epoch and sec/it are taken from the SAME (fastest) steady epoch
+    # — independently minimizing the two produced an internally
+    # inconsistent artifact once (the round-5 PANDA_SUBSET.json carried
+    # 4.8 s/epoch next to 1.595 sec/it over 5 its), which is exactly the
+    # class of silent contradiction a machine-checkable artifact exists
+    # to prevent.
     epoch_lines = re.findall(
         r"Epoch time: ([0-9.]+)s \(([0-9.]+) sec/it\)", tee.buf.getvalue()
     )
-    epoch_secs = [float(a) for a, _ in epoch_lines[1:]]  # epoch 0 = compiles
-    steady_sec_per_epoch = round(min(epoch_secs), 1) if epoch_secs else None
-    steady_sec_per_it = (
-        round(min(float(b) for _, b in epoch_lines[1:]), 3)
-        if len(epoch_lines) > 1
-        else None
-    )
+    steady = [(float(a), float(b)) for a, b in epoch_lines[1:]]  # 0 = compiles
+    if steady:
+        steady_epoch_raw, steady_it_raw = min(steady)
+        steady_sec_per_epoch = round(steady_epoch_raw, 1)
+        steady_sec_per_it = round(steady_it_raw, 3)
+    else:
+        steady_sec_per_epoch = steady_sec_per_it = None
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    artifact = os.path.join(repo_root, "PANDA_SUBSET.json")
 
     result = {
         "metric": "panda_subset_finetune",
@@ -214,9 +223,15 @@ def main():
         "sec_per_epoch": round(total / args.epochs, 1),
         "steady_sec_per_epoch": steady_sec_per_epoch,
         "steady_sec_per_it": steady_sec_per_it,
+        # ALWAYS present, null when not measured: the machine-checkable
+        # form of README's "steady epochs within ~1.1x of the bare device
+        # step" claim (checked with ~measurement-noise headroom at 1.15)
+        "in_harness_ratio": None,
+        "ratio_claim_max": 1.15,
+        "ratio_claim_met": None,
     }
 
-    if not args.no_bare:
+    if not args.no_bare and steady_sec_per_epoch:
         # the harness's own bucket policy, not a re-derivation
         from gigapath_tpu.data.collate import next_power_of_two
 
@@ -227,15 +242,38 @@ def main():
             f"{b}x{t}": round(v, 3) for (b, t), v in bare.items()
         }
         result["bare_epoch_sec"] = round(bare_epoch, 2)
-        if steady_sec_per_epoch:
-            result["in_harness_ratio"] = round(
-                steady_sec_per_epoch / bare_epoch, 3
-            )
+        ratio = round(steady_epoch_raw / bare_epoch, 3)
+        result["in_harness_ratio"] = ratio
+        result["ratio_claim_met"] = bool(ratio <= result["ratio_claim_max"])
+
+    if steady_sec_per_epoch is None:
+        # same degradation contract as bench.py: never launder a stale
+        # or incomplete run into the headline fields — keep the previous
+        # snapshot under last_good with stale: true and the reason
+        last_good = None
+        try:
+            with open(artifact) as f:
+                prev = json.load(f)
+            if prev.get("stale"):
+                # the previous artifact is itself a stale wrapper: carry
+                # its last_good FORWARD instead of nesting wrappers (the
+                # real measurements must stay one level deep, always)
+                last_good = prev.get("last_good")
+            else:
+                last_good = prev
+        except (OSError, ValueError):
+            pass
+        result["stale"] = True
+        result["stale_reason"] = (
+            "run produced no steady-state epoch timings (harness output "
+            "missing 'Epoch time:' lines after epoch 0)"
+        )
+        result["last_good"] = last_good
+
     print(json.dumps(result))
     # driver-visible artifact next to bench.py's line (VERDICT r3 #9):
     # train-path regressions show up in the round diff, not just prose
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    with open(os.path.join(repo_root, "PANDA_SUBSET.json"), "w") as f:
+    with open(artifact, "w") as f:
         json.dump(result, f, indent=1)
         f.write("\n")
 

@@ -29,9 +29,16 @@ def main():
     ap.add_argument(
         "--flags", default="",
         help="comma list of GIGAPATH_* env flags set for the trace, e.g. "
-        "PIPELINED_ATTN,PACK_DIRECT,PIPELINED_BWD",
+        "PIPELINED_ATTN,PACK_DIRECT,STREAM_FUSION,PIPELINED_BWD",
     )
     ap.add_argument("--n", type=int, default=10241)
+    ap.add_argument(
+        "--json", default="",
+        help="write the kernel/glue decomposition JSON here (also emitted "
+        "as a run_end obs event, stream AB_DILATED_OBS.jsonl) — the "
+        "before/after glue table of the epilogue decision is two "
+        "invocations of this flag",
+    )
     args = ap.parse_args()
     for flag in args.flags.split(","):
         if flag:
@@ -77,8 +84,38 @@ def main():
     print(f"total XLA-op time: {total / iters / 1e3:.3f} ms/op over {iters} iters")
     print(f"  pallas kernels:  {kernels / iters / 1e3:.3f} ms/op")
     print(f"  XLA glue:        {glue / iters / 1e3:.3f} ms/op")
-    for name, us in sorted(totals.items(), key=lambda kv: -kv[1])[:12]:
+    top = sorted(totals.items(), key=lambda kv: -kv[1])[:12]
+    for name, us in top:
         print(f"  {us / iters:9.1f} us  {100 * us / total:5.1f}%  {name[:100]}")
+
+    if args.json:
+        import json
+
+        payload = {
+            "metric": "profile_op",
+            "variant": args.variant,
+            "flags": sorted(f for f in args.flags.split(",") if f),
+            "n": args.n,
+            "iters": iters,
+            "total_ms_per_op": round(total / iters / 1e3, 3),
+            "kernels_ms_per_op": round(kernels / iters / 1e3, 3),
+            "glue_ms_per_op": round(glue / iters / 1e3, 3),
+            "top_ops_us_per_op": {
+                name[:160]: round(us / iters, 1) for name, us in top
+            },
+        }
+        with open(args.json, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+        from gigapath_tpu.obs import get_run_log
+
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        log = get_run_log(
+            "profile_op", config={"argv": sys.argv[1:]},
+            path=os.path.join(repo_root, "AB_DILATED_OBS.jsonl"), echo=False,
+        )
+        log.run_end(status="ok", **payload)  # run_end closes the log
+        print(json.dumps(payload))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,59 @@
+#!/bin/bash
+# Round-6 on-chip measurement checklist, in priority order — round 5's
+# successor, folding in the streaming-fusion-epilogue A/B. Each step is
+# timeout-bounded and logs to /tmp/r6_*.log; artifacts land in the repo.
+# Run when the axon tunnel is up:  bash scripts/round6_measure.sh
+set -x
+cd "$(dirname "$0")/.."
+
+# 1. headline bench -> BENCH_LOCAL.json (the round's survivable record)
+timeout 1800 python bench.py 2>/tmp/r6_bench.err | tee /tmp/r6_bench.log
+
+# 2. gate the kernels at the bench geometry (incl. flagged combos)
+timeout 2400 python scripts/tpu_selfcheck.py > /tmp/r6_selfcheck.log 2>&1
+tail -5 /tmp/r6_selfcheck.log
+
+# 3. THE round-6 decision: dense fusion vs streaming epilogue, forward.
+#    Decision-table JSON (adopt_stream_fusion verdict) + obs run_end
+#    event -> AB_DILATED_OBS.jsonl
+timeout 1800 python scripts/ab_dilated.py --variants fused,stream --direct \
+  --json AB_EPILOGUE.json > /tmp/r6_ab_fwd.log 2>&1
+tail -12 /tmp/r6_ab_fwd.log
+
+# 4. same decision for the grad step
+timeout 1800 python scripts/ab_dilated.py --variants fused,stream --direct \
+  --grad --json AB_EPILOGUE_GRAD.json > /tmp/r6_ab_grad.log 2>&1
+tail -12 /tmp/r6_ab_grad.log
+
+# 5. glue decomposition before/after (op-time attribution twin of the
+#    jaxpr-scan table in PERFORMANCE.md round 6)
+timeout 1200 python scripts/profile_op.py --variant fused \
+  --json PROFILE_FUSED.json > /tmp/r6_prof_dense.log 2>&1
+timeout 1200 python scripts/profile_op.py --variant fused --flags STREAM_FUSION \
+  --json PROFILE_STREAM.json > /tmp/r6_prof_stream.log 2>&1
+tail -4 /tmp/r6_prof_dense.log /tmp/r6_prof_stream.log
+
+# 6. carried-over round-5 A/Bs (pipelined kernels, still env-flagged)
+timeout 1800 python scripts/ab_dilated.py --variants fused,pipe \
+  --pipe-bk 512,640,896 --direct > /tmp/r6_ab_pipe.log 2>&1
+tail -12 /tmp/r6_ab_pipe.log
+
+# 7. per-shard 1M-token slice -> SEQ_SHARD.json
+timeout 2400 python scripts/seq_shard_slice.py --out SEQ_SHARD.json \
+  > /tmp/r6_seqshard.log 2>&1
+tail -2 /tmp/r6_seqshard.log
+
+# 8. long-context envelope: streaming branch fusion + the packed epilogue
+GIGAPATH_STREAMING_FUSION=1 GIGAPATH_STREAM_FUSION=1 timeout 2400 \
+  python scripts/long_context_smoke.py 393216 524288 > /tmp/r6_envelope.log 2>&1
+tail -4 /tmp/r6_envelope.log
+
+# 9. PANDA-subset regen (consistent steady fields + bare-step ratio,
+#    replaces the stale round-5 snapshot) -> PANDA_SUBSET.json
+timeout 3600 python scripts/panda_subset_bench.py > /tmp/r6_panda.log 2>&1
+tail -3 /tmp/r6_panda.log
+
+# 10. wall vs op-time reconciliation -> RECONCILE.json
+timeout 1200 python scripts/reconcile_walltime.py --out RECONCILE.json \
+  > /tmp/r6_reconcile.log 2>&1
+tail -2 /tmp/r6_reconcile.log

@@ -49,7 +49,7 @@ def test_fixture_tree_fires_every_rule_class():
     result = run_lint([FIXTURE], root=REPO_ROOT, waiver_file=None)
     assert result.exit_code != 0
     fired = {f.rule for f in result.findings}
-    expected = {"GL001", "GL002", "GL003", "GL004", "GL005", "GL006"}
+    expected = {"GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007"}
     assert fired >= expected, (
         f"missing rule classes: {sorted(expected - fired)}"
     )
@@ -81,6 +81,7 @@ def test_fixture_specific_findings():
         ("GL005", "test_hygiene.py", "test_fixture_seq_parallel_slow"),
         ("GL006", "driver.py", "noisy_train_loop"),
         ("GL006", "driver.py", "<module>"),
+        ("GL007", "driver.py", "undocumented_flag_knob"),
     }
     assert expected <= got, f"missing: {sorted(expected - got)}"
 

@@ -95,7 +95,7 @@ def run_lint(
             # UnicodeDecodeError on non-UTF-8 — report per-file and keep
             # linting the rest instead of dying with a traceback
             errors.append(f"{rel}: GL000 unparseable file: {e}")
-    project = build_project(modules)
+    project = build_project(modules, root=os.path.abspath(root))
 
     cfg = WaiverConfig()
     if waiver_file:

@@ -28,6 +28,10 @@ from tools.gigalint.walker import FunctionInfo, ModuleInfo
 @dataclasses.dataclass
 class Project:
     modules: Dict[str, ModuleInfo]  # modname -> ModuleInfo
+    # filesystem root the repo-relative module paths resolve against —
+    # lets cross-artifact rules (GL007: README flag table) read non-Python
+    # files without re-plumbing paths through every rule signature
+    root: str = "."
 
     def all_functions(self) -> Iterable[FunctionInfo]:
         for mod in self.modules.values():
@@ -119,8 +123,8 @@ class Project:
         return reached
 
 
-def build_project(modules: Iterable[ModuleInfo]) -> Project:
-    return Project(modules={m.modname: m for m in modules})
+def build_project(modules: Iterable[ModuleInfo], root: str = ".") -> Project:
+    return Project(modules={m.modname: m for m in modules}, root=root)
 
 
 def env_reader_functions(project: Project) -> Set[FunctionInfo]:

@@ -9,6 +9,8 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import os
+import re
 from typing import Callable, Dict, List, Optional, Set
 
 from tools.gigalint.astutils import (
@@ -256,6 +258,108 @@ def check_library_prints(project: Project) -> List[Finding]:
                 findings.append(Finding(
                     "GL006", mod.path, site.lineno, "<module>", _GL006_MSG,
                 ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# GL007 — undocumented GIGAPATH_* flags
+# ---------------------------------------------------------------------------
+
+# Exact-match flag-name string literals only: docstrings and log messages
+# mentioning a flag inline are prose, not a reference that creates a knob.
+_GL007_FLAG = re.compile(r"\AGIGAPATH_[A-Z0-9_]+\Z")
+_GL007_EXEMPT_SEGMENTS = _GL006_EXEMPT_SEGMENTS  # same host-tooling carve-out
+
+
+def _gl007_readme_flags(readme_path: str) -> Optional[Set[str]]:
+    """Flags documented in a README's flag table(s): GIGAPATH_* tokens on
+    markdown table rows that also note the read-at semantics ("trace" or
+    "host" in the row). None when the file does not exist."""
+    if not os.path.isfile(readme_path):
+        return None
+    flags: Set[str] = set()
+    with open(readme_path, "r", encoding="utf-8") as f:
+        for line in f:
+            stripped = line.strip()
+            if not stripped.startswith("|"):
+                continue
+            low = stripped.lower()
+            if "trace" not in low and "host" not in low:
+                continue
+            flags.update(re.findall(r"GIGAPATH_[A-Z0-9_]+", stripped))
+    return flags
+
+
+def _gl007_nearest_readme(project: Project, mod_path: str) -> Optional[str]:
+    """Nearest ancestor README.md of a module (fixture trees carry their
+    own), falling back to the project root's."""
+    parts = mod_path.split("/")[:-1]
+    for depth in range(len(parts), -1, -1):
+        cand = os.path.join(project.root, *parts[:depth], "README.md")
+        if os.path.isfile(cand):
+            return cand
+    return None
+
+
+@register(
+    "GL007",
+    "GIGAPATH_* flag referenced in library code but absent from the README "
+    "flag table — every flag must document its read-at (trace/host) "
+    "semantics where users will look for it",
+)
+def check_flag_documentation(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    readme_cache: Dict[str, Optional[Set[str]]] = {}
+    for mod in project.modules.values():
+        segments = mod.path.split("/")[:-1]
+        if mod.is_test_file or any(
+            s in _GL007_EXEMPT_SEGMENTS for s in segments
+        ):
+            continue
+        refs: List[tuple] = []  # (lineno, flag)
+        for node in ast.walk(mod.tree):
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and _GL007_FLAG.match(node.value)
+            ):
+                refs.append((node.lineno, node.value))
+        if not refs:
+            continue
+        readme = _gl007_nearest_readme(project, mod.path)
+        key = readme or ""
+        if key not in readme_cache:
+            readme_cache[key] = (
+                _gl007_readme_flags(readme) if readme else None
+            )
+        documented = readme_cache[key]
+        # innermost enclosing function for the finding symbol
+        spans = sorted(
+            (
+                (fn.lineno, getattr(fn.node, "end_lineno", fn.lineno), fn)
+                for fn in mod.functions.values()
+            ),
+            key=lambda t: t[1] - t[0],
+        )
+        for lineno, flag in refs:
+            if documented is not None and flag in documented:
+                continue
+            symbol = "<module>"
+            for lo, hi, fn in spans:
+                if lo <= lineno <= hi:
+                    symbol = fn.qualname
+                    break
+            where = (
+                f"the flag table in {os.path.relpath(readme, project.root)}"
+                if readme
+                else "any README.md flag table (none found above this file)"
+            )
+            findings.append(Finding(
+                "GL007", mod.path, lineno, symbol,
+                f"flag {flag} referenced in library code is missing from "
+                f"{where}: add a table row noting its trace-time (or "
+                "host-side) read semantics",
+            ))
     return findings
 
 

@@ -16,3 +16,19 @@ def negative_control_console(msg):
     from gigapath_tpu.obs import console
 
     console(msg)
+
+
+def undocumented_flag_knob():
+    import os
+
+    # GL007: flag-name literal in library code, absent from the fixture
+    # README's flag table (the nearest README.md above this file)
+    return os.environ.get("GIGAPATH_FIXTURE_UNDOCUMENTED", "")
+
+
+def negative_control_documented_flag():
+    import os
+
+    # NEGATIVE CONTROL: this flag has a table row (with read-at
+    # semantics) in the fixture README — no GL007 finding.
+    return os.environ.get("GIGAPATH_FIXTURE_DOCUMENTED", "")
