@@ -66,6 +66,13 @@ LOCAL_SNAPSHOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH
 # queryable long after the one-line stdout contract scrolled away.
 OBS_STREAM = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_OBS.jsonl")
 
+# Per-run perf ledger (gigapath_tpu.obs.ledger): the compiled artifact's
+# cost/memory analysis + jaxpr fingerprints for the bench workloads,
+# diffable across commits with scripts/ledger_diff.py. The path rides the
+# JSON line ("ledger") so every published number carries a pointer to its
+# compiled-artifact profile.
+BENCH_LEDGER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_LEDGER.json")
+
 N = 10240
 TILE_BATCH = 128  # reference pipeline.py:141
 
@@ -201,13 +208,15 @@ def tile_workload_flops(model) -> float:
     return float(model.depth * per_layer + 2 * L * 3 * p * p * d)
 
 
-def bench_tile_encoder(peak_flops: float):
+def bench_tile_encoder(peak_flops: float, ledger=None):
     """Batch-128 bf16 ViT-G/14 forward: (tiles/sec, mfu)."""
     import jax
 
     from gigapath_tpu.models.tile_encoder import gigapath_tile_enc
-    from gigapath_tpu.utils.profiling import compiled_flops
+    from gigapath_tpu.obs.ledger import NullLedger
     from gigapath_tpu.utils.timing import chained_seconds_per_iter
+
+    ledger = ledger if ledger is not None else NullLedger()
 
     model = gigapath_tile_enc(dtype=jnp.bfloat16)
     rng = jax.random.PRNGKey(0)
@@ -231,9 +240,10 @@ def bench_tile_encoder(peak_flops: float):
 
     # params as an ARG: closed-over params become 4.5 GB of inline constants
     # in the lowered HLO (and overflow the remote-compile request)
-    flops = compiled_flops(
-        lambda x, p: model.apply({"params": p}, x), imgs, params
+    entry = ledger.capture_full(
+        "tile_forward", lambda x, p: model.apply({"params": p}, x), imgs, params
     )
+    flops = ((entry or {}).get("cost") or {}).get("flops")
     mfu_source = "compiled_hlo"
     if not flops or not np.isfinite(flops):
         print(
@@ -250,15 +260,16 @@ def bench_tile_encoder(peak_flops: float):
     return tiles_per_sec, mfu, baseline_tiles_per_sec, mfu_source
 
 
-def run_bench(runlog=None) -> dict:
+def run_bench(runlog=None, ledger=None) -> dict:
     import jax
 
     from gigapath_tpu.models import slide_encoder
-    from gigapath_tpu.obs import NullRunLog
-    from gigapath_tpu.utils.profiling import compiled_memory
+    from gigapath_tpu.obs import NullRunLog, span
+    from gigapath_tpu.obs.ledger import NullLedger
     from gigapath_tpu.utils.timing import chained_seconds_per_iter
 
     runlog = runlog if runlog is not None else NullRunLog(driver="bench")
+    ledger = ledger if ledger is not None else NullLedger()
 
     # retried init FIRST, unconditionally: with TPU_PEAK_FLOPS set,
     # chip_peak_flops alone would never touch jax and the first (un-retried)
@@ -283,17 +294,26 @@ def run_bench(runlog=None) -> dict:
         # the input so the loop body cannot be hoisted out of fori_loop
         return x + (out.sum() * 1e-30).astype(x.dtype)
 
-    sec_per_iter, overhead = chained_seconds_per_iter(step, x, args=(params, coords))
+    with span("slide_forward", runlog):
+        sec_per_iter, overhead = chained_seconds_per_iter(step, x, args=(params, coords))
     tokens_per_sec = N / sec_per_iter
     mfu = (workload_flops(N) / sec_per_iter) / peak
     runlog.step(0, wall_s=sec_per_iter, synced=True, workload="slide_forward",
                 tokens_per_sec=tokens_per_sec, mfu=mfu)
 
-    mem = compiled_memory(
-        lambda x, p: model.apply({"params": p}, x, coords)[0], x, params
+    # compiled-artifact profile of the headline workload: cost analysis
+    # (FLOPs) + memory analysis (peak HBM) + jaxpr fingerprint, ledgered
+    # under "slide_forward" and surfaced as headline JSON fields
+    entry = ledger.capture_full(
+        "slide_forward", lambda x, p: model.apply({"params": p}, x, coords)[0],
+        x, params,
     )
+    mem = (entry or {}).get("memory")
+    # the ledger already sanitizes non-finite analysis values to None, so
+    # nothing here can leak a NaN into the contractual JSON line
+    slide_flops = ((entry or {}).get("cost") or {}).get("flops")
     peak_hbm_gb = None
-    if mem and np.isfinite(mem["temp_bytes"]) and np.isfinite(mem["argument_bytes"]):
+    if mem and mem.get("temp_bytes") is not None and mem.get("argument_bytes") is not None:
         peak_hbm_gb = round((mem["temp_bytes"] + mem["argument_bytes"]) / 2**30, 2)
 
     # train-step variant (fwd+bwd, the reference's actual hot loop —
@@ -308,17 +328,19 @@ def run_bench(runlog=None) -> dict:
         total = sum(g.sum().astype(jnp.float32) for g in jax.tree.leaves(grads))
         return x + (total * 1e-30).astype(x.dtype)
 
-    sec_train, _ = chained_seconds_per_iter(
-        train_step, x, args=(params, coords), iters_low=2, iters_high=8
-    )
+    with span("slide_train", runlog):
+        sec_train, _ = chained_seconds_per_iter(
+            train_step, x, args=(params, coords), iters_low=2, iters_high=8
+        )
     train_tokens_per_sec = N / sec_train
     runlog.step(1, wall_s=sec_train, synced=True, workload="slide_train",
                 tokens_per_sec=train_tokens_per_sec)
 
     try:
-        tile_tiles_per_sec, tile_mfu, tile_baseline, tile_mfu_source = (
-            bench_tile_encoder(peak)
-        )
+        with span("tile_forward", runlog):
+            tile_tiles_per_sec, tile_mfu, tile_baseline, tile_mfu_source = (
+                bench_tile_encoder(peak, ledger=ledger)
+            )
         tile_vs_baseline = round(tile_tiles_per_sec / tile_baseline, 3)
         runlog.step(2, wall_s=TILE_BATCH / tile_tiles_per_sec, synced=True,
                     workload="tile_forward", tiles_per_sec=tile_tiles_per_sec,
@@ -343,6 +365,8 @@ def run_bench(runlog=None) -> dict:
         "train_tokens_per_sec": round(train_tokens_per_sec, 1),
         "mfu": round(mfu, 3),
         "peak_hbm_gb": peak_hbm_gb,
+        "compiled_flops": slide_flops,
+        "ledger": ledger.path,
         "tile_tiles_per_sec": tile_tiles_per_sec,
         "tile_mfu": tile_mfu,
         "tile_mfu_source": tile_mfu_source,
@@ -368,6 +392,7 @@ def main():
     "unmeasured number that looks fresh".
     """
     from gigapath_tpu.obs import get_run_log
+    from gigapath_tpu.obs.ledger import PerfLedger
 
     # telemetry stream rides stderr + BENCH_OBS.jsonl: stdout stays the
     # one contractual JSON line. probe_devices=False — backend init is
@@ -377,8 +402,17 @@ def main():
         config={"n_tokens": N, "tile_batch": TILE_BATCH,
                 "baseline_version": BASELINE_VERSION},
     )
+    # the ledger always CAPTURES (compiled_flops/peak_hbm_gb are bench
+    # measurements, not telemetry); GIGAPATH_OBS=0 only suppresses the
+    # artifact file + events ("ledger" stays null in the JSON line).
+    # autowrite=False: the file lands only on SUCCESS, so a failed run
+    # cannot overwrite the last good run's ledger with a partial one
+    # (the failure JSON deliberately carries no "ledger" pointer).
+    recording = getattr(runlog, "path", None) is not None
+    ledger = PerfLedger(runlog, path=BENCH_LEDGER if recording else None,
+                        autowrite=False)
     try:
-        payload = run_bench(runlog)
+        payload = run_bench(runlog, ledger=ledger)
     except Exception as e:  # noqa: BLE001 — contract: always print the JSON line
         import traceback
 
@@ -413,6 +447,14 @@ def main():
         print(json.dumps(payload))
         return
     payload["snapshot_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    try:
+        ledger.write()  # success: publish the run's compiled-artifact ledger
+    except Exception as ledger_err:
+        print(f"bench: ledger write failed: {ledger_err}", file=sys.stderr)
+        # without the file, the pointer would name the PREVIOUS run's
+        # ledger — stale provenance masquerading as this run's profile
+        if payload.get("ledger") is not None:
+            payload["ledger"] = None
     snapshot_written = True
     try:
         with open(LOCAL_SNAPSHOT, "w") as f:

@@ -41,7 +41,14 @@ from gigapath_tpu.finetune.utils import (
     make_writer,
 )
 from gigapath_tpu.models.classification_head import get_model
-from gigapath_tpu.obs import CompileWatchdog, Heartbeat, NullRunLog, get_run_log
+from gigapath_tpu.obs import (
+    CompileWatchdog,
+    Heartbeat,
+    NullRunLog,
+    get_ledger,
+    get_run_log,
+    span,
+)
 from gigapath_tpu.obs.telemetry import step_scalars
 from gigapath_tpu.utils.checkpoint import MonitorScore, restore_checkpoint, save_checkpoint
 
@@ -203,7 +210,12 @@ def train(dataloader, fold: int, args):
     rng = jax.random.PRNGKey(args.seed)
     val_records, test_records = None, None
 
-    compile_log = CompileWatchdog("train_step", runlog, fn=train_step)
+    # perf ledger: each new bucket's compiled train step lands a
+    # compile_profile event (cost/memory analysis for the first bucket,
+    # jaxpr fingerprints for the rest) in <fold_dir>/obs/*.ledger.json
+    ledger = get_ledger(runlog)
+    compile_log = CompileWatchdog("train_step", runlog, fn=train_step,
+                                  ledger=ledger)
     heartbeat = Heartbeat(
         runlog,
         interval_s=float(getattr(args, "obs_heartbeat_s", 60.0)),
@@ -215,17 +227,19 @@ def train(dataloader, fold: int, args):
             for epoch in range(args.epochs):
                 runlog.echo(f"Epoch: {epoch}")
                 rng, epoch_rng = jax.random.split(rng)
-                params, opt_state, train_records = train_one_epoch(
-                    train_loader, train_step, params, opt_state, epoch,
-                    epoch_rng, args, compile_log=compile_log, runlog=runlog,
-                    heartbeat=heartbeat,
-                )
+                with span("epoch", runlog, epoch=epoch):
+                    params, opt_state, train_records = train_one_epoch(
+                        train_loader, train_step, params, opt_state, epoch,
+                        epoch_rng, args, compile_log=compile_log, runlog=runlog,
+                        heartbeat=heartbeat,
+                    )
 
                 if val_loader is not None:
-                    val_records = evaluate(
-                        val_loader, eval_step, params, loss_fn, epoch, args,
-                        runlog=runlog, heartbeat=heartbeat,
-                    )
+                    with span("eval", runlog, epoch=epoch):
+                        val_records = evaluate(
+                            val_loader, eval_step, params, loss_fn, epoch, args,
+                            runlog=runlog, heartbeat=heartbeat,
+                        )
                     log_dict = {
                         "train_" + k: v
                         for k, v in train_records.items()
@@ -250,10 +264,11 @@ def train(dataloader, fold: int, args):
             # on the device too (fresh eval_step compiles for unseen
             # buckets) and must not be a stall-monitoring blind spot
             params = restore_checkpoint(ckpt_path, {"params": jax.device_get(params)})["params"]
-            test_records = evaluate(
-                test_loader, eval_step, params, loss_fn, args.epochs, args,
-                runlog=runlog, heartbeat=heartbeat,
-            )
+            with span("test", runlog):
+                test_records = evaluate(
+                    test_loader, eval_step, params, loss_fn, args.epochs, args,
+                    runlog=runlog, heartbeat=heartbeat,
+                )
 
         log_dict = {
             "test_" + k: v
@@ -274,6 +289,7 @@ def train(dataloader, fold: int, args):
         test_macro_auroc=float(test_records.get("macro_auroc", float("nan"))),
         compile_seconds_total=compile_log.compile_seconds_total(),
         stalls=heartbeat.stall_count,
+        ledger_path=ledger.path,
     )
     return val_records, test_records
 
@@ -323,6 +339,13 @@ def train_one_epoch(
         if new_bucket:
             jax.block_until_ready(loss)  # isolate the compile cost
             compile_log.record(bucket, time.time() - t0)
+            # ledger this bucket's compiled artifact (loops driving the
+            # is_new/record surface call profile() themselves; wrap()
+            # users get it automatically)
+            compile_log.profile(
+                bucket, train_step, params, opt_state, images, coords,
+                labels, pad_mask, step_rng,
+            )
         elif compile_log is not None:
             compile_log.record(bucket, None)
         # fp32 accumulation: a few hundred bf16 adds of ~1.x losses round

@@ -12,13 +12,19 @@ from __future__ import annotations
 import argparse
 import glob
 import os
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from gigapath_tpu.obs import CompileWatchdog, Heartbeat, console, get_run_log
+from gigapath_tpu.obs import (
+    CompileWatchdog,
+    Heartbeat,
+    console,
+    get_ledger,
+    get_run_log,
+    span,
+)
 
 
 def load_model(
@@ -99,8 +105,10 @@ def run_inference(
         return model.apply({"params": params}, embeds, coords, deterministic=True)
 
     # variable-length slides -> one compile per distinct N; the watchdog
-    # turns that invisible first-slide pause into compile events
-    watchdog = CompileWatchdog("inference.forward", runlog)
+    # turns that invisible first-slide pause into compile events and the
+    # ledger records each new shape's compiled cost/memory profile
+    ledger = get_ledger(runlog)
+    watchdog = CompileWatchdog("inference.forward", runlog, ledger=ledger)
     instrumented_forward = watchdog.wrap(forward)
 
     results = []
@@ -108,22 +116,26 @@ def run_inference(
     try:
         with Heartbeat(runlog, name="inference") as heartbeat:
             for idx, path in enumerate(feature_files):
-                t0 = time.time()
-                feats, coords = _load_features(path)
-                feats = feats[None]  # [1, N, D]
-                if coords is None:
-                    if not warned:
-                        runlog.echo(
-                            "Warning: feature files carry no coords; using zeros "
-                            "(positional signal collapses to one grid cell)"
-                        )
-                        warned = True
-                    coords = np.zeros((feats.shape[1], 2), np.float32)
-                coords = np.asarray(coords, np.float32)[None]
-                logits = np.asarray(
-                    instrumented_forward(params, jnp.asarray(feats), jnp.asarray(coords)),
-                    np.float32,
-                )
+                # fenced span (GL008): dur_s covers load + dispatch +
+                # device execution for this slide
+                with span("slide", runlog, fence=True) as sp:
+                    feats, coords = _load_features(path)
+                    feats = feats[None]  # [1, N, D]
+                    if coords is None:
+                        if not warned:
+                            runlog.echo(
+                                "Warning: feature files carry no coords; using zeros "
+                                "(positional signal collapses to one grid cell)"
+                            )
+                            warned = True
+                        coords = np.zeros((feats.shape[1], 2), np.float32)
+                    coords = np.asarray(coords, np.float32)[None]
+                    logits = np.asarray(
+                        sp.fence(instrumented_forward(
+                            params, jnp.asarray(feats), jnp.asarray(coords)
+                        )),
+                        np.float32,
+                    )
                 probs = np.asarray(jax.nn.softmax(logits, axis=-1))[0]
                 pred = int(probs.argmax())
                 results.append(
@@ -134,7 +146,7 @@ def run_inference(
                     }
                 )
                 runlog.step(
-                    idx, wall_s=round(time.time() - t0, 6), synced=True,
+                    idx, wall_s=sp.dur_s, synced=True,
                     n_tiles=int(feats.shape[1]), predicted_label=pred,
                     confidence=float(probs[pred]),
                 )
@@ -157,6 +169,7 @@ def run_inference(
         status="ok", n_slides=len(results), label_distribution=str(label_counts),
         mean_confidence=float(results_df["confidence"].mean()),
         compile_seconds_total=watchdog.compile_seconds_total(),
+        ledger_path=ledger.path,
     )
     return results_df
 

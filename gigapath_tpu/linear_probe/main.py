@@ -27,7 +27,14 @@ import optax
 
 from gigapath_tpu.data.pcam import EmbeddingDataset, Processor
 from gigapath_tpu.finetune.utils import log_writer, make_writer, seed_everything
-from gigapath_tpu.obs import CompileWatchdog, Heartbeat, console, get_run_log
+from gigapath_tpu.obs import (
+    CompileWatchdog,
+    Heartbeat,
+    console,
+    get_ledger,
+    get_run_log,
+    span,
+)
 from gigapath_tpu.utils.checkpoint import restore_checkpoint, save_checkpoint
 
 
@@ -182,7 +189,8 @@ def train(
     val_loader = lambda: _batches(val_dataset, batch_size, rng, infinite=False)  # noqa: E731
     test_loader = lambda: _batches(test_dataset, batch_size, rng, infinite=False)  # noqa: E731
 
-    watchdog = CompileWatchdog("linear_probe.step", runlog)
+    ledger = get_ledger(runlog)
+    watchdog = CompileWatchdog("linear_probe.step", runlog, ledger=ledger)
     instrumented_step = watchdog.wrap(step)
     runlog.echo("Start training")
     try:
@@ -216,6 +224,7 @@ def train(
         status="ok", val_f1=val_f1, test_f1=f1, test_auroc=auroc,
         test_auprc=auprc,
         compile_seconds_total=watchdog.compile_seconds_total(),
+        ledger_path=ledger.path,
     )
     return {"val_f1": val_f1, "test_f1": f1, "test_auroc": auroc, "test_auprc": auprc}
 
@@ -250,7 +259,8 @@ def _train_loop(
                 log_writer({"Train Loss": float(loss), "Learning Rate": cur_lr}, i, report_to, writer)
             if (i + 1) % eval_interval == 0 or (i + 1) == train_iters:
                 runlog.echo("Start evaluating ...")
-                accuracy, f1, precision, recall, auroc, auprc = evaluate(params, val_loader)
+                with span("eval", runlog, iteration=i):
+                    accuracy, f1, precision, recall, auroc, auprc = evaluate(params, val_loader)
                 runlog.eval_event(
                     i, accuracy=accuracy, f1=f1, precision=precision,
                     recall=recall, auroc=auroc, auprc=auprc,

@@ -6,12 +6,27 @@
   accounting (subsumes the old finetune ``BucketCompileLog``);
 - :mod:`gigapath_tpu.obs.heartbeat` — ``Heartbeat`` liveness/stall monitor;
 - :mod:`gigapath_tpu.obs.telemetry` — in-graph scalar helpers (grad/param
-  norms, MoE gating stats) that add no device round-trips or retraces.
+  norms, MoE gating stats) that add no device round-trips or retraces;
+- :mod:`gigapath_tpu.obs.ledger` — compiled-artifact perf ledger: XLA
+  cost/memory analysis + jaxpr fingerprints as ``compile_profile``
+  events, folded into a canonical per-run ledger JSON that
+  ``scripts/ledger_diff.py`` diffs across commits;
+- :mod:`gigapath_tpu.obs.spans` — nestable ``span`` context manager
+  (monotonic wall time, optional device fence, per-host rank tag) plus
+  the ``jax.profiler`` trace/annotate passthroughs.
 
 Fold a run's JSONL into a human report with ``scripts/obs_report.py``.
 """
 
 from gigapath_tpu.obs.heartbeat import Heartbeat
+from gigapath_tpu.obs.ledger import (
+    LEDGER_SCHEMA_VERSION,
+    NullLedger,
+    PerfLedger,
+    capture_profile,
+    get_ledger,
+    jaxpr_fingerprint,
+)
 from gigapath_tpu.obs.runlog import (
     EVENT_KINDS,
     SCHEMA_VERSION,
@@ -20,15 +35,26 @@ from gigapath_tpu.obs.runlog import (
     console,
     get_run_log,
 )
+from gigapath_tpu.obs.spans import Span, annotate, span, trace
 from gigapath_tpu.obs.watchdog import CompileWatchdog
 
 __all__ = [
     "EVENT_KINDS",
+    "LEDGER_SCHEMA_VERSION",
     "SCHEMA_VERSION",
     "CompileWatchdog",
     "Heartbeat",
+    "NullLedger",
     "NullRunLog",
+    "PerfLedger",
     "RunLog",
+    "Span",
+    "annotate",
+    "capture_profile",
     "console",
+    "get_ledger",
     "get_run_log",
+    "jaxpr_fingerprint",
+    "span",
+    "trace",
 ]

@@ -18,6 +18,12 @@ Event kinds (schema v1, one JSON object per line, every record carries
   dispatch times under async dispatch), plus free-form scalars;
 - ``compile``    — XLA compile observed by the watchdog (fn, key,
   seconds, running count, ``unexpected`` retrace flag);
+- ``compile_profile`` — compiled-artifact perf profile (XLA cost/memory
+  analysis + jaxpr fingerprint) captured by the perf ledger
+  (:mod:`gigapath_tpu.obs.ledger`);
+- ``span``       — one closed host span (:mod:`gigapath_tpu.obs.spans`):
+  name, nesting path/depth, monotonic ``dur_s``, ``fenced`` (device
+  sync before the clock read), per-host ``rank``;
 - ``eval``       — evaluation metrics at an epoch/step;
 - ``heartbeat``  — periodic liveness from the background monitor;
 - ``stall``      — no progress within the deadline (the axon-tunnel-hang
@@ -44,8 +50,8 @@ from typing import Any, Dict, Optional
 SCHEMA_VERSION = 1
 
 EVENT_KINDS = (
-    "run_start", "step", "compile", "eval", "heartbeat", "stall",
-    "error", "run_end",
+    "run_start", "step", "compile", "compile_profile", "span", "eval",
+    "heartbeat", "stall", "error", "run_end",
 )
 
 
@@ -133,10 +139,7 @@ class RunLog(NullRunLog):
                  echo_stream=None):
         super().__init__(driver=driver, echo=echo, echo_stream=echo_stream)
         self.path = path
-        self.run_id = run_id or (
-            f"{driver}-{time.strftime('%Y%m%dT%H%M%S', time.gmtime())}"
-            f"-p{os.getpid()}"
-        )
+        self.run_id = run_id or _default_run_id(driver)
         parent = os.path.dirname(path)
         if parent:
             os.makedirs(parent, exist_ok=True)
@@ -183,6 +186,7 @@ class RunLog(NullRunLog):
                 manifest["backend"] = devices[0].platform
                 manifest["device_kind"] = devices[0].device_kind
                 manifest["device_count"] = len(devices)
+                manifest["process_index"] = int(jax.process_index())
         except Exception as e:  # manifest is best-effort, never fatal
             manifest["manifest_error"] = f"{type(e).__name__}: {e}"
         if config is not None:
@@ -234,6 +238,14 @@ def _key_str(key) -> str:
     return repr(key)
 
 
+def _default_run_id(driver: str) -> str:
+    """The one run-id format (shared by RunLog and get_run_log)."""
+    return (
+        f"{driver}-{time.strftime('%Y%m%dT%H%M%S', time.gmtime())}"
+        f"-p{os.getpid()}"
+    )
+
+
 def _obs_enabled() -> bool:
     """GIGAPATH_OBS semantics: unset -> ON (telemetry is cheap); set to
     ''/'0'/'false'/'no' -> OFF; anything else -> ON. Matches the repo's
@@ -256,9 +268,21 @@ def get_run_log(driver: str, out_dir: Optional[str] = None, *,
     File placement: explicit ``path`` wins; else ``<out_dir>/obs/`` (or
     ``$GIGAPATH_OBS_DIR``, or the system temp dir) gets a per-run file
     named after the run id.
+
+    Multi-host runs: ``GIGAPATH_OBS_RUN_ID`` (host-side, read here once)
+    pins one shared run id across ranks, so per-rank JSONL files merge
+    on run id in ``scripts/obs_report.py``; each rank still writes its
+    own file (the shared-id filename gains a ``-<host>-p<pid>`` suffix —
+    hostname because containerized ranks commonly share pid 1, and
+    deliberately NOT the rank: reading ``jax.process_index()`` here
+    would initialize the backend at driver start, exactly the hang
+    ``probe_devices=False`` exists to avoid, and before distributed init
+    every rank would answer 0. Rank tagging rides the span events, which
+    fire once device work is already underway).
     """
     if not _obs_enabled():
         return NullRunLog(driver=driver, echo=echo, echo_stream=echo_stream)
+    shared_id = os.environ.get("GIGAPATH_OBS_RUN_ID") or None
     if path is None:
         if out_dir is not None:
             base = os.path.join(out_dir, "obs")
@@ -268,15 +292,21 @@ def get_run_log(driver: str, out_dir: Optional[str] = None, *,
             import tempfile
 
             base = os.path.join(tempfile.gettempdir(), "gigapath_obs")
-        run_id = (
-            f"{driver}-{time.strftime('%Y%m%dT%H%M%S', time.gmtime())}"
-            f"-p{os.getpid()}"
-        )
-        path = os.path.join(base, f"{run_id}.jsonl")
+        run_id = shared_id or _default_run_id(driver)
+        if shared_id:
+            import re
+            import socket
+
+            host = re.sub(r"[^A-Za-z0-9.-]", "-", socket.gethostname())[:32]
+            fname = f"{run_id}-{host}-p{os.getpid()}"
+        else:
+            fname = run_id
+        path = os.path.join(base, f"{fname}.jsonl")
         log = RunLog(path, driver=driver, run_id=run_id, echo=echo,
                      echo_stream=echo_stream)
     else:
-        log = RunLog(path, driver=driver, echo=echo, echo_stream=echo_stream)
+        log = RunLog(path, driver=driver, run_id=shared_id, echo=echo,
+                     echo_stream=echo_stream)
     if run_start:
         log.run_start(config=config, probe_devices=probe_devices)
     return log

@@ -1,0 +1,244 @@
+"""Nestable host-side spans: honest wall timing as obs events.
+
+A ``span`` brackets a region of driver code and lands one ``span`` event
+(schema v1) in the run's JSONL when it closes:
+
+    with span("epoch", runlog, epoch=3):
+        with span("step", runlog, fence=True) as sp:
+            out = step_fn(params, batch)
+            sp.fence(out)          # block_until_ready(out) at span exit
+
+Fields: ``name``, ``path`` (dotted nesting, e.g. ``epoch/step``),
+``depth``, ``dur_s`` (``time.monotonic`` delta), ``fenced``, ``rank``
+(``jax.process_index()`` for multi-host skew analysis —
+``scripts/obs_report.py`` folds per-rank spans into a straggler table),
+plus any free-form keyword fields.
+
+Why ``fence``: under async dispatch a wall-clock delta around a jitted
+call measures *dispatch*, not execution (gigalint GL008 flags exactly
+that). ``fence=True`` makes the span call ``jax.block_until_ready`` on
+every value registered via :meth:`Span.fence` (or passed directly as
+``fence=value``) before reading the clock, so ``dur_s`` is device truth.
+
+Zero-overhead contract: against a :class:`~gigapath_tpu.obs.runlog.NullRunLog`
+(``GIGAPATH_OBS=0``) a span is a true no-op — no event, no clock reads,
+no ``TraceAnnotation``, and no fence sync (there is no timing consumer,
+and an opt-out run must behave byte-identically minus obs artifacts).
+Spans never touch the traced program either way, so they can add no
+retraces (pinned by tests/test_obs.py).
+
+This module is also the home of the ``jax.profiler`` passthroughs that
+``gigapath_tpu.utils.profiling`` used to own (thin shims remain there):
+:func:`trace` captures a full XLA device trace, :func:`annotate` names a
+host region inside one, and ``span(..., annotate=True)`` nests a
+``TraceAnnotation`` so obs spans and profiler traces line up.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any, List, Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, *, create_perfetto_link: bool = False):
+    """Capture a device trace for the enclosed block:
+
+    >>> with trace("/tmp/profile"):
+    ...     step(params, batch)  # compiled work is recorded
+    """
+    import jax
+
+    jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named host region inside a trace (``with annotate("collate"): ...``)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+_RANK: Optional[int] = None
+
+# span-event schema keys; caller fields colliding with these are emitted
+# under a "field_" prefix instead of crashing the emitting finally block
+_RESERVED_SPAN_KEYS = (
+    "name", "path", "depth", "dur_s", "fenced", "rank", "status",
+    "fence_error",
+)
+
+
+def process_index() -> int:
+    """``jax.process_index()`` with a cautious cache; 0 when jax/backends
+    are unavailable (spans must never be the thing that takes a run down
+    on a flaky backend). The value is cached only once
+    ``jax.process_count() > 1`` — before ``jax.distributed.initialize``
+    both calls SUCCEED and answer 0/1 on every rank, so caching that
+    premature answer would freeze every later rank tag at 0. Single-host
+    runs simply re-read the (cheap, post-init) value each time."""
+    global _RANK
+    if _RANK is not None:
+        return _RANK
+    try:
+        import jax
+
+        idx = int(jax.process_index())
+        if int(jax.process_count()) > 1:
+            _RANK = idx  # definitely post-distributed-init: safe to pin
+        return idx
+    except Exception:
+        return 0
+
+
+class _SpanStack(threading.local):
+    def __init__(self):
+        self.names: List[str] = []
+
+
+_STACK = _SpanStack()
+
+
+class Span:
+    """Live span handle yielded by :func:`span`.
+
+    ``dur_s`` is populated at exit (None until then, and always None for
+    the no-op span), so drivers can reuse the span's measurement::
+
+        with span("step", runlog, fence=True) as sp:
+            out = step_fn(...)
+            sp.fence(out)
+        runlog.step(i, wall_s=sp.dur_s, synced=True)
+    """
+
+    __slots__ = ("name", "fenced", "dur_s", "_fence_values", "_fields")
+
+    def __init__(self, name: str, fenced: bool):
+        self.name = name
+        self.fenced = fenced
+        self.dur_s: Optional[float] = None
+        self._fence_values: List[Any] = []
+        self._fields: dict = {}
+
+    def fence(self, value: Any) -> Any:
+        """Register a value to ``block_until_ready`` at span exit (only
+        honored when the span was opened with ``fence=...``); returns the
+        value so it can be used inline."""
+        self._fence_values.append(value)
+        return value
+
+    def note(self, **fields) -> None:
+        """Attach free-form fields to the span event."""
+        self._fields.update(fields)
+
+
+class _NullSpan(Span):
+    """Absorbs fence()/note() without recording anything."""
+
+    def fence(self, value: Any) -> Any:
+        return value
+
+    def note(self, **fields) -> None:
+        return None
+
+
+_NULL_SPAN = _NullSpan("null", fenced=False)
+
+
+def _is_recording(runlog) -> bool:
+    # RunLog always has a file path; NullRunLog (and None) does not.
+    return runlog is not None and getattr(runlog, "path", None) is not None
+
+
+@contextlib.contextmanager
+def span(name: str, runlog=None, *, fence: Any = None, annotate: bool = False,
+         **fields):
+    """Nestable timed region emitting one ``span`` event at exit.
+
+    ``fence``: falsy -> no sync (dur_s is host dispatch time, marked
+    ``fenced: false``); ``True`` -> block on values registered via
+    ``Span.fence``; any other value -> block on it (plus registered
+    values). ``annotate=True`` additionally wraps the region in a
+    ``jax.profiler.TraceAnnotation`` so it shows up in captured traces.
+
+    Against a ``NullRunLog`` (``GIGAPATH_OBS=0``) the whole thing is a
+    no-op: the yielded span absorbs ``fence``/``note`` calls and nothing
+    is timed, synced, annotated, or written.
+    """
+    if not _is_recording(runlog):
+        yield _NULL_SPAN
+        return
+
+    # NOTE: no bool() on fence — it may be a device array (forcing a sync
+    # here would defeat the point of deferring it to span exit)
+    fenced = fence is not None and fence is not False
+    sp = Span(name, fenced=fenced)
+    if fence is not None and fence is not True and fence is not False:
+        sp._fence_values.append(fence)
+    _STACK.names.append(name)
+    path = "/".join(_STACK.names)
+    depth = len(_STACK.names)
+    annotate_ctx = None
+    if annotate:
+        try:
+            import jax
+
+            annotate_ctx = jax.profiler.TraceAnnotation(name)
+            annotate_ctx.__enter__()
+        except Exception:
+            annotate_ctx = None
+    t0 = time.monotonic()
+    status = "ok"
+    try:
+        yield sp
+    except BaseException:
+        status = "error"
+        raise
+    finally:
+        try:
+            fence_error = None
+            # fence only on the clean path: if the body raised (incl.
+            # KeyboardInterrupt during a device stall — the exact hang
+            # this obs layer exists to diagnose), blocking on the stuck
+            # computation here would turn an interruptible stall into a
+            # hard hang. The span is emitted unfenced instead.
+            if sp.fenced and sp._fence_values and status == "ok":
+                # a failing fence (device error surfacing at the sync
+                # point) must still leave a span event — the obs layer
+                # exists precisely for the failure moment — and must not
+                # replace an exception already in flight from the body
+                try:
+                    import jax
+
+                    jax.block_until_ready(sp._fence_values)
+                except Exception as e:
+                    fence_error = f"{type(e).__name__}: {e}"
+                    status = "error"
+            sp.dur_s = round(time.monotonic() - t0, 6)
+            if annotate_ctx is not None:
+                annotate_ctx.__exit__(None, None, None)
+            merged = dict(fields)
+            merged.update(sp._fields)
+            # caller fields must not shadow the span schema (a collision
+            # would TypeError inside this finally and crash the driver)
+            for reserved in _RESERVED_SPAN_KEYS:
+                if reserved in merged:
+                    merged[f"field_{reserved}"] = merged.pop(reserved)
+            if fence_error is not None:
+                merged["fence_error"] = fence_error
+            # a swallowed fence error is recorded, not raised: without the
+            # span there would be no sync here at all, so surfacing it
+            # would introduce a new failure site the bare driver lacks
+            runlog.event(
+                "span", name=name, path=path, depth=depth, dur_s=sp.dur_s,
+                fenced=sp.fenced, rank=process_index(), status=status,
+                **merged,
+            )
+        finally:
+            _STACK.names.pop()

@@ -67,9 +67,15 @@ class CompileWatchdog:
     default shape signature under :meth:`wrap`).
     """
 
-    def __init__(self, name: str, runlog=None, *, fn: Optional[Callable] = None):
+    def __init__(self, name: str, runlog=None, *, fn: Optional[Callable] = None,
+                 ledger=None):
         self.name = name
         self.runlog = runlog if runlog is not None else NullRunLog()
+        # perf-ledger hook (gigapath_tpu.obs.ledger): when set, every new
+        # key under wrap() — and every explicit profile() call from loops
+        # driving the is_new/record surface — lands a compile_profile
+        # event + ledger entry. None / NullLedger = no capture work.
+        self.ledger = ledger
         self._fn = fn
         self.first_call_sec: Dict[Any, float] = {}
         self.step_sec: Dict[Any, list] = {}
@@ -161,12 +167,25 @@ class CompileWatchdog:
                 out = fn(*args, **kwargs)
                 jax.block_until_ready(out)
                 self.record(key, time.time() - t0)
+                self.profile(key, fn, *args, **kwargs)
             else:
                 out = fn(*args, **kwargs)
                 self.record(key, None)
             return out
 
         return wrapped
+
+    # -- perf-ledger capture ----------------------------------------------
+    def profile(self, key, fn, *args, **kwargs) -> None:
+        """Ledger this key's compiled artifact (cost/memory analysis +
+        jaxpr fingerprint) under the watchdog's name, tagged with the
+        bucket key so compile and compile_profile events join. Called by
+        :meth:`wrap` on every new key; loops that drive the
+        ``is_new``/``record`` surface directly (finetune) call it
+        themselves right after the first-call ``record``. No-ops without
+        a ledger; capture failures are contained by the ledger."""
+        if self.ledger is not None:
+            self.ledger.capture_for_key(self.name, key, fn, *args, **kwargs)
 
     # -- summaries --------------------------------------------------------
     def compile_seconds_total(self) -> float:

@@ -35,7 +35,14 @@ import optax
 from flax import linen as nn
 
 from gigapath_tpu.models.tile_encoder import VisionTransformer
-from gigapath_tpu.obs import CompileWatchdog, Heartbeat, console, get_run_log
+from gigapath_tpu.obs import (
+    CompileWatchdog,
+    Heartbeat,
+    console,
+    get_ledger,
+    get_run_log,
+    span,
+)
 from gigapath_tpu.utils.checkpoint import restore_checkpoint, save_checkpoint
 
 
@@ -138,7 +145,8 @@ def pretrain_tile_encoder(
                 "learning_rate": learning_rate, "mask_ratio": mask_ratio,
                 "n_images": len(image_paths), "seed": seed},
     )
-    watchdog = CompileWatchdog("pretrain_tile.step", runlog)
+    ledger = get_ledger(runlog)
+    watchdog = CompileWatchdog("pretrain_tile.step", runlog, ledger=ledger)
     instrumented_step = watchdog.wrap(step)
     order_rng = np.random.default_rng(seed)
     best_loss = float("inf")
@@ -158,15 +166,17 @@ def pretrain_tile_encoder(
                         _load_tile_batch([image_paths[i] for i in idx], encoder.img_size)
                     )
                     rng, mask_rng = jax.random.split(rng)
-                    t0 = time.time()
-                    params, opt_state, loss = instrumented_step(
-                        params, opt_state, imgs, mask_rng
-                    )
+                    # fenced span (GL008): honest per-step device timing
+                    with span("step", runlog, fence=True) as sp:
+                        params, opt_state, loss = instrumented_step(
+                            params, opt_state, imgs, mask_rng
+                        )
+                        sp.fence(loss)
                     loss = float(loss)  # host sync (tiny batches)
                     epoch_loss += loss
                     n_steps += 1
                     runlog.step(
-                        global_step, wall_s=round(time.time() - t0, 6),
+                        global_step, wall_s=sp.dur_s,
                         synced=True, epoch=epoch, loss=loss,
                     )
                     heartbeat.beat(global_step)
@@ -199,6 +209,7 @@ def pretrain_tile_encoder(
     runlog.run_end(
         status="ok", best_loss=best_loss,
         compile_seconds_total=watchdog.compile_seconds_total(),
+        ledger_path=ledger.path,
     )
     return best_path
 
@@ -299,18 +310,21 @@ def pretrain_slide_encoder(
                 "max_tiles": max_tiles, "n_slides": int(batch.shape[0]),
                 "seed": seed},
     )
-    watchdog = CompileWatchdog("pretrain_slide.step", runlog)
+    ledger = get_ledger(runlog)
+    watchdog = CompileWatchdog("pretrain_slide.step", runlog, ledger=ledger)
     instrumented_step = watchdog.wrap(step)
     best_loss = float("inf")
     best_path = os.path.join(output_dir, "best_slide_encoder")
     try:
         with Heartbeat(runlog, name="pretrain_slide") as heartbeat:
             for epoch in range(num_epochs):
-                t0 = time.time()
-                params, opt_state, loss = instrumented_step(params, opt_state)
+                # fenced span (GL008): honest per-epoch-step device timing
+                with span("step", runlog, fence=True) as sp:
+                    params, opt_state, loss = instrumented_step(params, opt_state)
+                    sp.fence(loss)
                 loss = float(loss)
                 runlog.step(
-                    epoch, wall_s=round(time.time() - t0, 6), synced=True,
+                    epoch, wall_s=sp.dur_s, synced=True,
                     loss=loss,
                 )
                 heartbeat.beat(epoch)
@@ -330,6 +344,7 @@ def pretrain_slide_encoder(
     runlog.run_end(
         status="ok", best_loss=best_loss,
         compile_seconds_total=watchdog.compile_seconds_total(),
+        ledger_path=ledger.path,
     )
     return best_path
 

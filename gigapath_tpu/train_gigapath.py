@@ -20,7 +20,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from gigapath_tpu.obs import CompileWatchdog, Heartbeat, console, get_run_log
+from gigapath_tpu.obs import (
+    CompileWatchdog,
+    Heartbeat,
+    console,
+    get_ledger,
+    get_run_log,
+    span,
+)
 
 
 def rename_slide_files(data_dir: str, ext: str = ".ndpi") -> List[str]:
@@ -177,8 +184,10 @@ def train_model(
                 "n_slides": len(feats)},
     )
     # per-slide sequence lengths vary -> one compile per distinct [1, N, D];
-    # the watchdog times each first call and flags unexpected retraces
-    watchdog = CompileWatchdog("train_gigapath.step", runlog)
+    # the watchdog times each first call and flags unexpected retraces,
+    # and the perf ledger captures each new shape's compiled artifact
+    ledger = get_ledger(runlog)
+    watchdog = CompileWatchdog("train_gigapath.step", runlog, ledger=ledger)
     instrumented_step = watchdog.wrap(step)
     history = []
     # run seed; a fresh per-step dropout key is split off below (a constant
@@ -192,18 +201,21 @@ def train_model(
                 t_epoch = time.time()
                 for x, c, y in zip(feats, coords, labels):
                     rng, step_rng = jax.random.split(rng)
-                    t0 = time.time()
-                    params, opt_state, loss = instrumented_step(
-                        params,
-                        opt_state,
-                        jnp.asarray(x[None]),
-                        jnp.asarray(c[None]),
-                        jnp.asarray([y]),
-                        step_rng,
-                    )
+                    # the fenced span is the honest step clock (GL008):
+                    # dur_s covers dispatch AND execution of this step
+                    with span("step", runlog, fence=True) as sp:
+                        params, opt_state, loss = instrumented_step(
+                            params,
+                            opt_state,
+                            jnp.asarray(x[None]),
+                            jnp.asarray(c[None]),
+                            jnp.asarray([y]),
+                            step_rng,
+                        )
+                        sp.fence(loss)
                     total += float(loss)  # per-slide sync (tiny model)
                     runlog.step(
-                        global_step, wall_s=round(time.time() - t0, 6),
+                        global_step, wall_s=sp.dur_s,
                         synced=True, epoch=epoch, loss=float(loss),
                     )
                     heartbeat.beat(global_step)
@@ -225,6 +237,7 @@ def train_model(
     runlog.run_end(
         status="ok", final_loss=history[-1] if history else None,
         compile_seconds_total=watchdog.compile_seconds_total(),
+        ledger_path=ledger.path,
     )
     return {"loss_history": history, "n_classes": n_classes}
 

@@ -1,46 +1,37 @@
-"""Profiling & telemetry hooks.
+"""Profiling & telemetry hooks (thin shims over the obs layer).
 
 Superset of the reference's instrumentation (SURVEY §5.1): the reference
 records CPU wall-clock + CUDA events around each MoE all-to-all
 (``xmoe/moe_layer.py:276-307``) and prints sec/it in the train loop; here
+the implementations live in the obs subsystem and this module re-exports
+the historical names:
 
-- :func:`trace` wraps ``jax.profiler`` — one context manager captures a
-  full XLA trace (collectives included, which covers the a2a timing the
-  reference hand-rolls) viewable in TensorBoard/Perfetto;
-- :func:`annotate` names host-side regions inside a trace;
-- :func:`collect_moe_metadata` surfaces the gating telemetry MoE layers sow
-  (entropy, unused experts, balance fractions — ``xmoe/routing.py:53,72-87``)
-  as a flat scalar dict ready for ``log_writer``;
-- :func:`compiled_flops` / :func:`compiled_memory` read XLA cost analysis
-  (the thop replacement, reference ``finetune/training.py:14,53``).
+- :func:`trace` / :func:`annotate` — ``jax.profiler`` passthroughs, now
+  owned by :mod:`gigapath_tpu.obs.spans` (which also provides the
+  nestable, event-emitting ``span`` context manager);
+- :func:`compiled_flops` / :func:`compiled_memory` — XLA cost/memory
+  analysis (the thop replacement), now owned by
+  :mod:`gigapath_tpu.obs.ledger`, which additionally folds full
+  ``compile_profile`` captures into the per-run perf ledger;
+- :func:`collect_moe_metadata` surfaces the gating telemetry MoE layers
+  sow (entropy, unused experts, balance fractions —
+  ``xmoe/routing.py:53,72-87``) as a flat scalar dict — still defined
+  here (it is host-side pytree flattening, not a compiled-artifact
+  concern), shared with the in-graph ``gigapath_tpu.obs.telemetry`` twin.
 """
 
 from __future__ import annotations
 
-import contextlib
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 import jax
 import numpy as np
 
-
-@contextlib.contextmanager
-def trace(log_dir: str, *, create_perfetto_link: bool = False):
-    """Capture a device trace for the enclosed block:
-
-    >>> with trace("/tmp/profile"):
-    ...     step(params, batch)  # compiled work is recorded
-    """
-    jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
-
-
-def annotate(name: str):
-    """Named host region inside a trace (``with annotate("collate"): ...``)."""
-    return jax.profiler.TraceAnnotation(name)
+from gigapath_tpu.obs.ledger import (  # noqa: F401  (re-exported shims)
+    compiled_flops,
+    compiled_memory,
+)
+from gigapath_tpu.obs.spans import annotate, trace  # noqa: F401
 
 
 def iter_moe_metadata(intermediates: Dict[str, Any]):
@@ -73,31 +64,6 @@ def collect_moe_metadata(intermediates: Dict[str, Any]) -> Dict[str, float]:
         key: float(np.asarray(leaf).reshape(()))
         for key, leaf in iter_moe_metadata(intermediates)
     }
-
-
-def compiled_flops(fn, *args) -> Optional[float]:
-    """FLOPs of the jitted computation, from XLA cost analysis."""
-    try:
-        analysis = jax.jit(fn).lower(*args).compile().cost_analysis()
-        if isinstance(analysis, list):
-            analysis = analysis[0]
-        return float(analysis.get("flops", float("nan")))
-    except Exception:
-        return None
-
-
-def compiled_memory(fn, *args) -> Optional[Dict[str, float]]:
-    """Peak/argument/output memory of the compiled computation (bytes)."""
-    try:
-        compiled = jax.jit(fn).lower(*args).compile()
-        mem = compiled.memory_analysis()
-        return {
-            "temp_bytes": float(getattr(mem, "temp_size_in_bytes", float("nan"))),
-            "argument_bytes": float(getattr(mem, "argument_size_in_bytes", float("nan"))),
-            "output_bytes": float(getattr(mem, "output_size_in_bytes", float("nan"))),
-        }
-    except Exception:
-        return None
 
 
 def xla_op_totals(trace_dir: str) -> Dict[str, Dict[str, float]]:

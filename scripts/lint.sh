@@ -6,10 +6,31 @@
 #
 # Scans gigapath_tpu/ + scripts/ + tests/ — the same scope
 # tests/test_gigalint.py enforces on every tier-1 run — honoring the
-# GIGALINT_WAIVERS file at the repo root. Also runs the obs selftest
-# (scripts/obs_report.py --selftest): RunLog -> watchdog -> forced stall
-# -> rendered report, so a broken telemetry pipeline fails lint too.
+# GIGALINT_WAIVERS file at the repo root. Also runs:
+#   - the obs selftest (scripts/obs_report.py --selftest): RunLog ->
+#     watchdog -> spans -> forced stall -> rendered report (incl. the
+#     per-rank merge path), so a broken telemetry pipeline fails lint;
+#   - the ledger-diff selftest (scripts/ledger_diff.py --selftest): the
+#     perf regression verdict must flip on injected regressions;
+#   - the gigalint GL008 selftest: the seeded timing-hygiene fixture
+#     must fire (and only on the seeded violations — the negative
+#     controls are covered by tests/test_gigalint.py).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 python scripts/obs_report.py --selftest 1>&2
+python scripts/ledger_diff.py --selftest 1>&2
+
+# GL008 selftest: the seeded fixture violations MUST be found (exit 1 =
+# findings; 0 or 2 mean the rule went blind or crashed)
+set +e
+python -m tools.gigalint --no-waivers --select GL008 \
+    tools/gigalint/selftest/fixture/models/timing.py 1>&2
+gl008_rc=$?
+set -e
+if [ "$gl008_rc" -ne 1 ]; then
+    echo "GL008 selftest FAILED: expected findings (rc=1), got rc=$gl008_rc" 1>&2
+    exit 1
+fi
+echo "gigalint GL008 selftest OK" 1>&2
+
 exec python -m tools.gigalint gigapath_tpu scripts tests "$@"

@@ -56,7 +56,8 @@ def run(n: int) -> dict:
         lambda p, x, c: model.apply({"params": p}, x, c)[0], params, x, coords
     )
     peak_hbm_gb = None
-    if mem and np.isfinite(mem["temp_bytes"]) and np.isfinite(mem["argument_bytes"]):
+    # compiled_memory sanitizes unavailable fields to None (obs.ledger)
+    if mem and mem.get("temp_bytes") is not None and mem.get("argument_bytes") is not None:
         peak_hbm_gb = round(
             (mem["temp_bytes"] + mem["argument_bytes"]) / 2**30, 2
         )

@@ -1,14 +1,24 @@
 #!/usr/bin/env python
-"""Fold a gigapath_tpu.obs run JSONL into a human report.
+"""Fold gigapath_tpu.obs run JSONL (one file, or per-rank files of one
+run) into a human report.
 
     python scripts/obs_report.py <run.jsonl> [<run2.jsonl> ...]
     python scripts/obs_report.py --run <run-id> <stream.jsonl>   # multi-run streams
+    python scripts/obs_report.py run-r0.jsonl run-r1.jsonl       # per-rank merge
     python scripts/obs_report.py --selftest
 
 Sections: run manifest, throughput (steps/s + step-wall percentiles,
 synced vs unsynced), compile (total seconds, share of wall, per-key
-retrace table with unexpected retraces flagged), eval history, timeline
-(heartbeats, stalls, silent gaps between consecutive events).
+retrace table with unexpected retraces flagged), spans (per-name
+durations; with multi-host input a per-rank skew/straggler table —
+max/median step span per rank, worst rank called out), eval history,
+timeline (heartbeats, stalls, silent gaps between consecutive events).
+
+Multi-host runs: launch with ``GIGAPATH_OBS_RUN_ID`` pinned so every
+rank logs under ONE run id, hand all per-rank files to this script, and
+they merge on that id (``--run`` filters when a stream carries several).
+Passing files from different runs without ``--run`` still renders, with
+a warning — the rank table is only meaningful within one run.
 
 Pure stdlib — no jax import — so it runs anywhere the JSONL lands
 (including on a workstation far from the TPU that produced it). Exit 0
@@ -56,6 +66,39 @@ def percentile(sorted_vals: List[float], q: float) -> float:
 
 def _fmt_s(x) -> str:
     return "-" if x is None else f"{x:.3f}s"
+
+
+def _rank_table(spans_by_name: Dict[str, List[dict]], w) -> None:
+    """Per-rank skew/straggler table for multi-host runs: for each span
+    name seen on >= 2 ranks, median/max span wall per rank plus the
+    straggler rank (worst median vs the fleet median of medians)."""
+    for name in sorted(spans_by_name):
+        by_rank: Dict[int, List[float]] = {}
+        for ev in spans_by_name[name]:
+            if ev.get("dur_s") is None:
+                continue
+            by_rank.setdefault(int(ev.get("rank", 0)), []).append(
+                float(ev["dur_s"])
+            )
+        if len(by_rank) < 2:
+            continue
+        w(f"per-rank skew (span '{name}'):\n")
+        medians: Dict[int, float] = {}
+        for rank in sorted(by_rank):
+            durs = sorted(by_rank[rank])
+            med = percentile(durs, 0.50)
+            medians[rank] = med
+            w(
+                f"  rank {rank}: n={len(durs)} median {_fmt_s(med)} "
+                f"max {_fmt_s(durs[-1])} (max-median "
+                f"{_fmt_s(durs[-1] - med)})\n"
+            )
+        fleet = percentile(sorted(medians.values()), 0.50)
+        worst = max(medians, key=lambda r: medians[r])
+        w(
+            f"  straggler: rank {worst} median {_fmt_s(medians[worst])} "
+            f"(+{medians[worst] - fleet:.3f}s vs fleet median {_fmt_s(fleet)})\n"
+        )
 
 
 def render(events: List[dict], out=None) -> int:
@@ -151,6 +194,26 @@ def render(events: List[dict], out=None) -> int:
         w("no compile events\n")
     w("\n")
 
+    # -- spans ------------------------------------------------------------
+    spans = by_kind.get("span", [])
+    if spans:
+        w("== spans ==\n")
+        by_name: Dict[str, List[dict]] = {}
+        for ev in spans:
+            by_name.setdefault(str(ev.get("name", "?")), []).append(ev)
+        for name in sorted(by_name):
+            durs = sorted(
+                float(ev["dur_s"]) for ev in by_name[name]
+                if ev.get("dur_s") is not None
+            )
+            fenced = sum(1 for ev in by_name[name] if ev.get("fenced"))
+            if durs:
+                w(f"  {name}: n={len(by_name[name])} ({fenced} fenced) "
+                  f"p50 {_fmt_s(percentile(durs, 0.50))} "
+                  f"max {_fmt_s(durs[-1])}\n")
+        _rank_table(by_name, w)
+        w("\n")
+
     # -- eval -------------------------------------------------------------
     evals = by_kind.get("eval", [])
     if evals:
@@ -193,15 +256,16 @@ def render(events: List[dict], out=None) -> int:
 
 
 def selftest() -> int:
-    """Synthesize a run (RunLog + watchdog + a forced stall) in a temp
-    dir, render it, and assert every section materializes — the obs
-    half of scripts/lint.sh."""
+    """Synthesize a run (RunLog + watchdog + spans + a forced stall) in a
+    temp dir, render it, and assert every section materializes; then a
+    two-rank merge of one run id must render the per-rank skew table —
+    the obs half of scripts/lint.sh."""
     import io
     import tempfile
     import time as _time
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    from gigapath_tpu.obs import Heartbeat, RunLog
+    from gigapath_tpu.obs import Heartbeat, RunLog, span
     from gigapath_tpu.obs.watchdog import CompileWatchdog
 
     with tempfile.TemporaryDirectory() as tmp:
@@ -211,7 +275,8 @@ def selftest() -> int:
         wd = CompileWatchdog("selftest.step", log)
         for i in range(25):
             key = (1, 128 if i < 20 else 256)
-            wd.record(key, 0.5 if wd.is_new(key) else None)
+            with span("step", log, bucket=str(key)):
+                wd.record(key, 0.5 if wd.is_new(key) else None)
             log.step(i, wall_s=0.01 * (i + 1), synced=i % 5 == 0, loss=1.0 / (i + 1))
         log.eval_event(24, auroc=0.99)
         with Heartbeat(log, interval_s=0.05, stall_after_s=0.15,
@@ -223,12 +288,34 @@ def selftest() -> int:
         buf = io.StringIO()
         rc = render(load_events(path), out=buf)
         text = buf.getvalue()
+
+        # -- per-rank merge path: two files, ONE run id, rank 1 straggles
+        paths = [os.path.join(tmp, f"mh-r{r}.jsonl") for r in (0, 1)]
+        for rank, p in enumerate(paths):
+            rlog = RunLog(p, driver="selftest", run_id="selftest-mh",
+                          echo=False)
+            for i in range(10):
+                rlog.event("span", name="step", path="step", depth=1,
+                           dur_s=0.01 + rank * (0.02 + 0.002 * i),
+                           fenced=True, rank=rank)
+            rlog.close()
+        merged = [ev for p in paths for ev in load_events(p)]
+        merged.sort(key=lambda ev: ev.get("t", 0.0))
+        buf2 = io.StringIO()
+        rc2 = render(merged, out=buf2)
+        text2 = buf2.getvalue()
+
     required = ("== throughput ==", "== compile ==", "== timeline ==",
-                "retrace table", "STALL", "p50")
+                "retrace table", "STALL", "p50", "== spans ==")
     missing = [s for s in required if s not in text]
-    if rc != 0 or missing:
+    required_mh = ("per-rank skew (span 'step')", "rank 1:",
+                   "straggler: rank 1")
+    missing_mh = [s for s in required_mh if s not in text2]
+    if rc != 0 or missing or rc2 != 0 or missing_mh:
         print(text)
-        print(f"obs selftest FAILED: rc={rc}, missing sections: {missing}",
+        print(text2)
+        print(f"obs selftest FAILED: rc={rc}/{rc2}, missing sections: "
+              f"{missing}, missing rank sections: {missing_mh}",
               file=sys.stderr)
         return 1
     print("obs selftest OK")
@@ -259,6 +346,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
         events.extend(load_events(path, run_id=args.run))
     events.sort(key=lambda ev: ev.get("t", 0.0))
+    if args.run is None and len(args.paths) > 1:
+        runs = sorted({str(ev.get("run")) for ev in events})
+        if len(runs) > 1:
+            print(
+                f"warning: merged {len(runs)} distinct run ids "
+                f"({', '.join(runs)}); per-rank files of one run share an "
+                "id (GIGAPATH_OBS_RUN_ID) — pass --run to isolate one",
+                file=sys.stderr,
+            )
     return render(events)
 
 

@@ -267,7 +267,7 @@ def main():
     if FAILED:
         print("FAILED:", FAILED)
         sys.exit(1)
-    print(f"all on-chip checks passed in {time.time() - _T0:.1f}s")
+    print(f"all on-chip checks passed in {time.time() - _T0:.1f}s")  # gigalint: waive GL008 -- whole-script wall; every check() already fetched its operands to the host
 
 
 if __name__ == "__main__":

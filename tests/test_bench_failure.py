@@ -20,9 +20,11 @@ import bench
 
 @pytest.fixture(autouse=True)
 def _obs_stream_in_tmp(tmp_path, monkeypatch):
-    # bench.main() appends telemetry to the repo-root BENCH_OBS.jsonl;
-    # tests must not pollute the committed provenance stream
+    # bench.main() appends telemetry to the repo-root BENCH_OBS.jsonl and
+    # writes the perf ledger to BENCH_LEDGER.json; tests must not pollute
+    # the committed provenance artifacts
     monkeypatch.setattr(bench, "OBS_STREAM", str(tmp_path / "BENCH_OBS.jsonl"))
+    monkeypatch.setattr(bench, "BENCH_LEDGER", str(tmp_path / "BENCH_LEDGER.json"))
 
 
 @pytest.fixture
@@ -74,6 +76,11 @@ def test_failure_emits_contractual_json_without_snapshot(no_snapshot, capsys):
     assert "error" in payload
     assert "stale" not in payload
     assert "last_good" not in payload
+    # an unmeasured round has no compiled-artifact profile to point at:
+    # the ledger fields must not leak into the failure payload
+    assert "ledger" not in payload
+    assert "compiled_flops" not in payload
+    assert not os.path.exists(bench.BENCH_LEDGER)
 
 
 def test_failure_reports_snapshot_only_as_last_good(no_snapshot, capsys):
@@ -120,6 +127,40 @@ def test_failure_strips_error_and_stale_from_last_good(no_snapshot, capsys):
     assert "error" not in payload["last_good"]
     assert "stale" not in payload["last_good"]
     assert payload["last_good_value"] == 99.0
+
+
+def test_success_embeds_ledger_and_headline_profile_fields(
+    no_snapshot, capsys, monkeypatch
+):
+    """ISSUE 4 satellite: the success JSON line carries the ledger path
+    plus headline compiled-FLOPs / peak-HBM fields WITHOUT breaking the
+    one-line-stdout contract."""
+
+    def fake_run_bench(runlog=None, ledger=None):
+        # what run_bench returns after ledgering the slide forward
+        return {
+            "metric": "slide_embed_tokens_per_sec",
+            "value": 138400.0,
+            "unit": "tokens/s",
+            "peak_hbm_gb": 0.63,
+            "compiled_flops": 3.0e12,
+            "ledger": ledger.path if ledger is not None else None,
+        }
+
+    monkeypatch.setattr(bench, "run_bench", fake_run_bench)
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, f"stdout must be exactly one JSON line, got {out}"
+    payload = json.loads(out[0])
+    assert payload["value"] == 138400.0
+    assert payload["compiled_flops"] == 3.0e12
+    assert payload["peak_hbm_gb"] == 0.63
+    assert payload["ledger"] == bench.BENCH_LEDGER
+    # the snapshot carries the same provenance fields
+    with open(bench.LOCAL_SNAPSHOT) as f:
+        snap = json.load(f)
+    assert snap["ledger"] == bench.BENCH_LEDGER
+    assert snap["compiled_flops"] == 3.0e12
 
 
 def test_success_memoizes_backend(monkeypatch):

@@ -49,7 +49,8 @@ def test_fixture_tree_fires_every_rule_class():
     result = run_lint([FIXTURE], root=REPO_ROOT, waiver_file=None)
     assert result.exit_code != 0
     fired = {f.rule for f in result.findings}
-    expected = {"GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007"}
+    expected = {"GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
+                "GL007", "GL008"}
     assert fired >= expected, (
         f"missing rule classes: {sorted(expected - fired)}"
     )
@@ -82,6 +83,12 @@ def test_fixture_specific_findings():
         ("GL006", "driver.py", "noisy_train_loop"),
         ("GL006", "driver.py", "<module>"),
         ("GL007", "driver.py", "undocumented_flag_knob"),
+        # unfenced wall-clock deltas around device work (direct jit call
+        # and a watchdog.wrap-bound handle)
+        ("GL008", "timing.py", "timed_no_fence"),
+        ("GL008", "timing.py", "timed_wrapped_no_fence"),
+        # span(fence=None) is explicitly unfenced: no fence credit
+        ("GL008", "timing.py", "timed_span_fence_none"),
     }
     assert expected <= got, f"missing: {sorted(expected - got)}"
 

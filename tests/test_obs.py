@@ -29,7 +29,9 @@ from gigapath_tpu.obs import (
     Heartbeat,
     NullRunLog,
     RunLog,
+    get_ledger,
     get_run_log,
+    span,
 )
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -141,6 +143,24 @@ class TestGetRunLog:
         assert str(tmp_path / "central") == os.path.dirname(log.path)
         log.close()
 
+    def test_shared_run_id_pins_multihost_merge_key(self, tmp_path, monkeypatch):
+        """GIGAPATH_OBS_RUN_ID: every rank logs under ONE run id (the
+        obs_report merge key) while writing its own per-process file —
+        the suffix is host+pid, NOT the rank, so get_run_log never
+        touches the backend at driver start (and containerized ranks
+        that all run as pid 1 still get distinct files)."""
+        monkeypatch.delenv("GIGAPATH_OBS", raising=False)
+        monkeypatch.setenv("GIGAPATH_OBS_RUN_ID", "mh-run-1")
+        log = get_run_log("t", out_dir=str(tmp_path), echo=False,
+                          probe_devices=False)
+        assert log.run_id == "mh-run-1"
+        base = os.path.basename(log.path)
+        assert base.startswith("mh-run-1-")
+        assert base.endswith(f"-p{os.getpid()}.jsonl")
+        events = read_events(log.path)
+        assert events[0]["run"] == "mh-run-1"
+        log.close()
+
 
 # ---------------------------------------------------------------------------
 # CompileWatchdog
@@ -213,6 +233,166 @@ class TestCompileWatchdog:
         assert bare._cache_size() == instrumented._cache_size() == 2
         assert sum(wd.compile_count.values()) == 2
         assert wd.unexpected_retraces == []
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+class TestSpans:
+    def test_nested_spans_emit_path_depth_duration(self, tmp_path):
+        path = str(tmp_path / "run.jsonl")
+        log = RunLog(path, driver="t", echo=False)
+        with span("epoch", log, epoch=0):
+            with span("step", log) as sp:
+                sp.note(bucket="(1, 128)")
+        events = read_events(path)
+        # inner span closes first
+        assert [ev["name"] for ev in events] == ["step", "epoch"]
+        step, epoch = events
+        assert step["path"] == "epoch/step" and step["depth"] == 2
+        assert epoch["path"] == "epoch" and epoch["depth"] == 1
+        assert step["bucket"] == "(1, 128)" and epoch["epoch"] == 0
+        assert step["dur_s"] >= 0 and epoch["dur_s"] >= step["dur_s"]
+        assert step["rank"] == 0 and step["fenced"] is False
+        assert step["status"] == "ok"
+        log.close()
+
+    def test_fence_blocks_and_exposes_dur(self, tmp_path):
+        log = RunLog(str(tmp_path / "run.jsonl"), driver="t", echo=False)
+        fn = jax.jit(lambda x: (x * 2).sum())
+        with span("step", log, fence=True) as sp:
+            out = sp.fence(fn(jnp.ones((4,))))
+        assert float(out) == 8.0
+        assert sp.dur_s is not None and sp.dur_s >= 0
+        (ev,) = read_events(log.path)
+        assert ev["fenced"] is True and ev["dur_s"] == sp.dur_s
+        log.close()
+
+    def test_fence_value_passed_directly(self, tmp_path):
+        log = RunLog(str(tmp_path / "run.jsonl"), driver="t", echo=False)
+        x = jnp.ones((4,))
+        with span("sync", log, fence=x):
+            pass
+        (ev,) = read_events(log.path)
+        assert ev["fenced"] is True
+        log.close()
+
+    def test_fence_failure_still_emits_span_event(self, tmp_path, monkeypatch):
+        """A device error surfacing at the fence sync must not eat the
+        span event (the obs layer exists for the failure moment) and must
+        not raise a NEW exception the unfenced driver would never see."""
+        log = RunLog(str(tmp_path / "run.jsonl"), driver="t", echo=False)
+
+        def boom(_):
+            raise RuntimeError("device exploded at sync")
+
+        monkeypatch.setattr(jax, "block_until_ready", boom)
+        with span("step", log, fence=True) as sp:
+            sp.fence(jnp.ones(2))
+        (ev,) = read_events(log.path)
+        assert ev["status"] == "error"
+        assert "device exploded" in ev["fence_error"]
+        assert sp.dur_s is not None
+        log.close()
+
+    def test_error_status_recorded_and_reraised(self, tmp_path):
+        log = RunLog(str(tmp_path / "run.jsonl"), driver="t", echo=False)
+        with pytest.raises(ValueError):
+            with span("boom", log):
+                raise ValueError("x")
+        (ev,) = read_events(log.path)
+        assert ev["status"] == "error" and ev["dur_s"] >= 0
+        log.close()
+
+    def test_caller_fields_cannot_shadow_span_schema(self, tmp_path):
+        log = RunLog(str(tmp_path / "run.jsonl"), driver="t", echo=False)
+        with span("eval", log, status="pending", rank=99):
+            pass
+        (ev,) = read_events(log.path)
+        assert ev["status"] == "ok" and ev["rank"] == 0  # schema wins
+        assert ev["field_status"] == "pending" and ev["field_rank"] == 99
+        log.close()
+
+    def test_null_runlog_is_true_noop(self):
+        null = NullRunLog(driver="t", echo=False)
+        with span("step", null, fence=True) as sp:
+            sp.fence(jnp.ones(2))
+            sp.note(a=1)
+        assert sp.dur_s is None  # no clock reads, no event, no fence
+        with span("bare", None) as sp2:
+            pass
+        assert sp2.dur_s is None
+
+
+# ---------------------------------------------------------------------------
+# zero-overhead contracts (ISSUE 4 acceptance)
+# ---------------------------------------------------------------------------
+
+class TestZeroOverhead:
+    def test_obs_off_spans_and_ledger_add_zero_retraces_and_no_files(
+        self, tmp_path, monkeypatch
+    ):
+        """GIGAPATH_OBS=0: the fully instrumented loop (runlog + watchdog
+        + ledger + fenced spans) compiles exactly as often as the bare
+        loop and leaves NOTHING on disk."""
+        monkeypatch.setenv("GIGAPATH_OBS", "0")
+
+        def step(params, x):
+            return params["w"] * jnp.sum(x)
+
+        params = {"w": jnp.float32(2.0)}
+        buckets = [jnp.ones((1, 128)), jnp.ones((1, 256))]
+
+        bare = jax.jit(step)
+        for x in buckets * 3:
+            bare(params, x)
+
+        runlog = get_run_log("t", out_dir=str(tmp_path))
+        ledger = get_ledger(runlog)
+        instrumented = jax.jit(step)
+        wd = CompileWatchdog("step", runlog, fn=instrumented, ledger=ledger)
+        wrapped = wd.wrap(instrumented)
+        for i, x in enumerate(buckets * 3):
+            with span("step", runlog, fence=True) as sp:
+                out = sp.fence(wrapped(params, x))
+            runlog.step(i, wall_s=sp.dur_s, synced=True, loss=float(out))
+        runlog.run_end(status="ok", ledger_path=ledger.path)
+
+        assert bare._cache_size() == instrumented._cache_size() == 2
+        assert sum(wd.compile_count.values()) == 2
+        assert wd.unexpected_retraces == []
+        assert list(tmp_path.iterdir()) == [], "obs-off run left artifacts"
+
+    def test_obs_on_instrumented_hlo_is_identical(self, tmp_path):
+        """With obs ON, watching + ledgering a function must not alter
+        its traced program: the compiled HLO of the watched function is
+        byte-identical to an unwatched twin, and no extra call-cache
+        entries appear."""
+
+        def step(params, x):
+            return params["w"] * jnp.sum(x)
+
+        params = {"w": jnp.float32(2.0)}
+        x = jnp.ones((1, 128))
+
+        bare = jax.jit(step)
+        bare(params, x)
+
+        log = RunLog(str(tmp_path / "run.jsonl"), driver="t", echo=False)
+        ledger = get_ledger(log)
+        watched = jax.jit(step)
+        wd = CompileWatchdog("step", log, fn=watched, ledger=ledger)
+        wrapped = wd.wrap(watched)
+        with span("step", log, fence=True) as sp:
+            sp.fence(wrapped(params, x))
+        assert len(ledger.entries) == 1  # the profile was captured
+
+        assert watched._cache_size() == bare._cache_size() == 1
+        hlo_bare = bare.lower(params, x).compile().as_text()
+        hlo_watched = watched.lower(params, x).compile().as_text()
+        assert hlo_bare == hlo_watched
+        log.close()
 
 
 # ---------------------------------------------------------------------------

@@ -364,6 +364,130 @@ def check_flag_documentation(project: Project) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# GL008 — timing hygiene
+# ---------------------------------------------------------------------------
+
+# Wall-clock sources whose deltas are meaningless around async-dispatched
+# device work (resolved through the module's import aliases first).
+_GL008_TIME_CALLS = frozenset({
+    "time.time", "time.monotonic", "time.perf_counter",
+    "time.time_ns", "time.monotonic_ns", "time.perf_counter_ns",
+})
+# Sanctioned fences: any of these anywhere in the timing function means
+# the author thought about dispatch-vs-execution (function granularity —
+# per-statement regions would be all noise in loop-shaped drivers).
+_GL008_FENCE_SUFFIXES = ("block_until_ready", "chained_seconds_per_iter")
+# tests and demos are exempt; scripts/ and library code are NOT — the
+# measurement scripts are exactly where a dispatch-time number quietly
+# becomes a published benchmark.
+_GL008_EXEMPT_SEGMENTS = frozenset({"demo"})
+
+
+def _gl008_resolved_callee(mod, callee: str) -> str:
+    """Expand a leading import alias (``from time import monotonic`` ->
+    ``time.monotonic``; ``import time as t`` -> ``time.*``)."""
+    head, sep, rest = callee.partition(".")
+    target = mod.imports.get(head)
+    if target:
+        return f"{target}.{rest}" if sep else target
+    return callee
+
+
+def _gl008_scan_function(project, mod, fn, reached) -> Optional[Finding]:
+    """One GL008 verdict for a function: a wall-clock delta + a
+    jit-reachable (or jit/wrap-bound) call + no fence -> finding."""
+    timer_names: Set[str] = set()
+    wrapped_names: Set[str] = set()
+    delta_lineno: Optional[int] = None
+    device_call: Optional[str] = None
+    fenced = False
+
+    def is_time_call(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        name = dotted_name(node.func)
+        return bool(name) and _gl008_resolved_callee(mod, name) in _GL008_TIME_CALLS
+
+    from tools.gigalint.walker import TRACING_WRAPPERS
+
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            callee = dotted_name(node.value.func) or ""
+            if is_time_call(node.value):
+                for tgt in node.targets:
+                    for n in names_in(tgt):
+                        timer_names.add(n.id)
+            elif callee in TRACING_WRAPPERS or callee.endswith(".wrap"):
+                # x = jax.jit(f) / x = watchdog.wrap(step): calls through
+                # x dispatch compiled device work
+                for tgt in node.targets:
+                    for n in names_in(tgt):
+                        wrapped_names.add(n.id)
+        elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+            for side in (node.left, node.right):
+                if is_time_call(side) or (
+                    isinstance(side, ast.Name) and side.id in timer_names
+                ):
+                    delta_lineno = delta_lineno or node.lineno
+        elif isinstance(node, ast.Call):
+            callee = dotted_name(node.func)
+            if not callee:
+                continue
+            if callee.endswith(_GL008_FENCE_SUFFIXES):
+                fenced = True
+            elif (callee == "span" or callee.endswith(".span")) and any(
+                kw.arg == "fence"
+                and not (
+                    isinstance(kw.value, ast.Constant)
+                    and kw.value.value in (None, False)
+                )
+                for kw in node.keywords
+            ):
+                # span(..., fence=None/False) is explicitly unfenced and
+                # earns no credit; any other fence value counts
+                fenced = True
+            elif callee in wrapped_names:
+                device_call = device_call or callee
+            else:
+                target = project.resolve(mod, fn, callee)
+                if target is not None and target in reached:
+                    device_call = device_call or callee
+
+    if delta_lineno is None or device_call is None or fenced:
+        return None
+    return Finding(
+        "GL008", mod.path, delta_lineno, fn.qualname,
+        f"wall-clock delta around jit-reachable call '{device_call}()' "
+        "without a device fence: under async dispatch this measures "
+        "dispatch, not execution. Fence with block_until_ready, use "
+        "chained_seconds_per_iter, or wrap the region in "
+        "span(..., fence=True) (gigapath_tpu.obs.spans)",
+    )
+
+
+@register(
+    "GL008",
+    "timing hygiene: wall-clock delta around jit-reachable work without a "
+    "device fence (block_until_ready / chained_seconds_per_iter / "
+    "span(fence=True)) measures async dispatch, not execution",
+)
+def check_timing_hygiene(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    reached = project.trace_reachable()
+    for mod in project.modules.values():
+        segments = mod.path.split("/")[:-1]
+        if mod.is_test_file or any(
+            s in _GL008_EXEMPT_SEGMENTS for s in segments
+        ) or "tests" in segments:
+            continue
+        for fn in mod.functions.values():
+            finding = _gl008_scan_function(project, mod, fn, reached)
+            if finding is not None:
+                findings.append(finding)
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # GL004 — forbidden APIs
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,63 @@
+"""Seeded GL008 violations (never imported — parsed only)."""
+
+import time
+
+import jax
+
+
+@jax.jit
+def fixture_jit_step(x):
+    return x * 2
+
+
+def timed_no_fence(x):
+    t0 = time.time()
+    y = fixture_jit_step(x)
+    # GL008: under async dispatch this delta is host dispatch time, not
+    # device execution time — no fence anywhere in this function
+    return y, time.time() - t0
+
+
+def timed_wrapped_no_fence(watchdog, step, x):
+    instrumented = watchdog.wrap(step)
+    t0 = time.monotonic()
+    y = instrumented(x)
+    return y, time.monotonic() - t0  # GL008: wrap-bound call, no fence
+
+
+def timed_span_fence_none(runlog, x):
+    from gigapath_tpu.obs import span
+
+    t0 = time.time()
+    with span("step", runlog, fence=None):  # explicitly unfenced span
+        y = fixture_jit_step(x)
+    # GL008: fence=None earns no fence credit — the delta still measures
+    # dispatch only
+    return y, time.time() - t0
+
+
+def negative_control_fenced(x):
+    # NEGATIVE CONTROL: block_until_ready fences the timed region —
+    # no GL008 finding.
+    t0 = time.perf_counter()
+    y = fixture_jit_step(x)
+    jax.block_until_ready(y)
+    return y, time.perf_counter() - t0
+
+
+def negative_control_span_fence(runlog, x):
+    # NEGATIVE CONTROL: the obs span with an explicit fence is the
+    # sanctioned timing wrapper — no GL008 finding.
+    from gigapath_tpu.obs import span
+
+    t0 = time.monotonic()
+    with span("step", runlog, fence=True) as sp:
+        y = sp.fence(fixture_jit_step(x))
+    return y, time.monotonic() - t0
+
+
+def negative_control_no_device_work(n):
+    # NEGATIVE CONTROL: pure host code may time itself however it likes.
+    t0 = time.time()
+    total = sum(range(n))
+    return total, time.time() - t0
