@@ -44,10 +44,14 @@ from typing import Any, Dict, List, Optional, Tuple
 LEDGER_SCHEMA_VERSION = 1
 
 # Primitive columns every fingerprint reports explicitly (0 when absent):
-# the glue-op classes PERFORMANCE.md's round-6 table tracks, plus the
-# kernel count. Other primitives appear under their own names as seen.
+# the glue-op classes PERFORMANCE.md's round-6 table tracks, the kernel
+# count, and the sequence-parallel collectives (the ring-vs-gather
+# signal: the ring path must show ppermute > 0 and zero full-segment
+# all_gather of K/V — pinned by the golden ledger's dilated_ring_*
+# entries). Other primitives appear under their own names as seen.
 FINGERPRINT_COLUMNS = (
     "transpose", "slice", "broadcast_in_dim", "reshape", "pallas_call",
+    "ppermute", "all_gather",
 )
 
 
@@ -66,6 +70,14 @@ def _count_eqns(jaxpr, counts: Dict[str, int]) -> None:
                 if sub is not None:
                     # ClosedJaxpr has .jaxpr.eqns; Jaxpr has .eqns
                     _count_eqns(getattr(sub, "jaxpr", sub), counts)
+                elif hasattr(item, "eqns") and eqn.primitive.name != "pallas_call":
+                    # a RAW Jaxpr param (shard_map bodies ride as one):
+                    # without this arm the whole sharded program would
+                    # fingerprint as a single opaque eqn. pallas_call
+                    # kernel bodies stay opaque on purpose — the KERNEL
+                    # COUNT is the round-6 column's signal; Mosaic
+                    # kernel-internal ops are not XLA glue
+                    _count_eqns(item, counts)
 
 
 def jaxpr_fingerprint(fn, *args, **kwargs) -> Dict[str, Any]:

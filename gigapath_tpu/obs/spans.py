@@ -65,6 +65,27 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
+def ring_step(step: int, total: int, comm_bytes: int):
+    """IN-GRAPH annotation for one step of a ring collective schedule.
+
+    Unlike :func:`span` (host wall-time) and :func:`annotate` (host
+    region inside a profiler trace), a ring step is not a host region at
+    all — it is a slice of one traced program, so the right annotation
+    is a ``jax.named_scope``: the step name (with its per-step comm
+    byte count baked in, ``comm_bytes`` = the K/V chunk bytes the step's
+    ``ppermute`` moves per shard) lands on the HLO metadata of every op
+    the step emits, which is what XLA profiles and the ledger's jaxpr
+    render group by. Zero runtime cost, no obs event — the schedule's
+    host-level record is the ledger fingerprint (``ppermute`` /
+    ``all_gather`` columns, :data:`~gigapath_tpu.obs.ledger.FINGERPRINT_COLUMNS`).
+    """
+    import jax
+
+    return jax.named_scope(
+        f"ring_step_{step + 1}of{total}_comm{comm_bytes}B"
+    )
+
+
 _RANK: Optional[int] = None
 
 # span-event schema keys; caller fields colliding with these are emitted

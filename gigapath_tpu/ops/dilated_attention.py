@@ -26,7 +26,13 @@ TPU-native counterpart of reference
   to the ranks forming the current segment (``gather_kv:55-74``), queries
   staying local. The reference ships this dormant (never enabled); here it is
   a first-class code path driven by ``seq_axis_name`` inside ``shard_map``
-  and covered by multi-device tests.
+  and covered by multi-device tests. Under ``GIGAPATH_RING_ATTN``
+  (``PipelineFlags.ring_attn``) the oversized branches instead RING: local
+  sparse K/V chunks rotate around the segment's sub-ring via ``ppermute``,
+  partial attention runs per resident chunk, and partials merge through the
+  stored-LSE online softmax — per-shard memory O(local chunk) instead of
+  O(full segment), collectives overlapped with compute, with a custom VJP
+  that rings in reverse (see the ring section below).
 
 Everything is static-shape: the branch loop is a Python loop over a static
 tuple, so ``jit`` unrolls it (5 branches in the flagship configs).
@@ -754,6 +760,210 @@ def _gather_kv_seq_parallel(
     return segment.reshape(b, ranks_per_segment * segment.shape[2], *segment.shape[3:])
 
 
+# ---------------------------------------------------------------------------
+# ring-scheduled K/V exchange (GIGAPATH_RING_ATTN)
+# ---------------------------------------------------------------------------
+#
+# The all-gather path above materializes every oversized branch's ENTIRE
+# segment K/V on every shard — per-shard memory O(full segment), with the
+# collective serial on the critical path. The ring schedule below (Ring
+# Attention, Liu et al. 2023, arXiv:2310.01889) keeps per-shard memory
+# O(local chunk): each shard holds only its own sparse K/V chunk, the
+# chunks rotate around the segment's sub-ring via jax.lax.ppermute, each
+# step computes partial attention of the LOCAL queries against the
+# RESIDENT chunk, and partials fold through the stored-LSE online-softmax
+# combine (flash_attention.combine_partials — the same merge primitive
+# the stream-fusion epilogue applies across branches, here applied across
+# ring steps). The next chunk's ppermute is issued BEFORE the resident
+# chunk's compute, so the collective has no data dependence on the
+# attention math and XLA can overlap it with kernel time. The gather path
+# stays as the fallback and parity oracle.
+
+
+def _ring_perm(world: int, rps: int) -> Tuple[Tuple[int, int], ...]:
+    """Static ppermute (src, dst) pairs rotating every ``rps``-sized
+    sub-ring of the seq axis by one: rank r sends to the next rank of ITS
+    OWN segment's ring (``rps < world`` = several independent sub-rings,
+    the segment-spans-a-strict-subset-of-the-mesh case). After s
+    applications, rank r holds the chunk of rank
+    ``(r // rps) * rps + (r % rps - s) % rps``."""
+    assert world % rps == 0, (world, rps)
+    return tuple(
+        (src, (src // rps) * rps + ((src % rps) + 1) % rps)
+        for src in range(world)
+    )
+
+
+def _ring_step_counts(counts, my_rel, s: int, rps: int):
+    """Valid-key counts [B, H] for ring step ``s``: the row of the
+    per-origin-rank table [rps, B, H] belonging to the chunk resident at
+    step s (origin ``(my_rel - s) mod rps``, a traced index — the counts
+    stay in the table and the step selects its row, so the hoisted gather
+    is shared by every step of every gathered branch)."""
+    if counts is None:
+        return None
+    orig = jnp.mod(my_rel - s, rps)
+    return jax.lax.dynamic_slice_in_dim(counts, orig, 1, axis=0)[0]
+
+
+def _ring_attention_fwd_impl(qs, ks, vs, counts, axis_name, world, rps,
+                             allow_pallas):
+    """Forward ring: local sparse q [B, mq, H, D] against the rotating
+    chunks [B, mk, H, D] -> (out [B, mq, H, D], lse [B, H, mq])."""
+    from gigapath_tpu.obs.spans import ring_step
+    from gigapath_tpu.ops.flash_attention import (
+        combine_partials,
+        partial_attention,
+    )
+
+    perm = _ring_perm(world, rps)
+    my_rel = jnp.mod(jax.lax.axis_index(axis_name), rps)
+    comm_bytes = 2 * int(np.prod(ks.shape)) * ks.dtype.itemsize  # k + v
+    use_pallas = None if allow_pallas else False
+    out = lse = None
+    k_cur, v_cur = ks, vs
+    for s in range(rps):
+        with ring_step(s, rps, comm_bytes if s + 1 < rps else 0):
+            # double-buffer: the permute reads only the resident chunk,
+            # never this step's attention results — issued first, it can
+            # ride the interconnect while the partial attention computes
+            if s + 1 < rps:
+                k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+                v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+            cnt = _ring_step_counts(counts, my_rel, s, rps)
+            o_s, l_s = partial_attention(
+                qs, k_cur, v_cur, kv_valid_len=cnt, use_pallas=use_pallas
+            )
+            if out is None:
+                # fp32 accumulator from the first partial on: every later
+                # combine_partials keeps it fp32 (out_a's dtype)
+                out, lse = o_s.astype(jnp.float32), l_s
+            else:
+                out, lse = combine_partials(out, lse, o_s, l_s)
+            if s + 1 < rps:
+                k_cur, v_cur = k_nxt, v_nxt
+    return out.astype(qs.dtype), lse
+
+
+def _ring_partial_bwd(qs, k_c, v_c, do, lse, delta, cnt, scale):
+    """One ring step's gradient contributions, flash-backward style: the
+    chunk's probabilities are recomputed from the logits and the FINAL
+    combined lse (p = exp(s - lse_full) is already the full-softmax
+    probability restricted to this chunk's keys), so no per-step
+    normalization state needs saving. All math fp32; numerics mirror
+    attention_with_lse (mask before lse-subtract, masked probs zeroed)."""
+    q32 = qs.astype(jnp.float32)
+    k32 = k_c.astype(jnp.float32)
+    v32 = v_c.astype(jnp.float32)
+    do32 = do.astype(jnp.float32)
+    s_ = jnp.einsum("bqhd,bkhd->bhqk", q32, k32) * scale
+    if cnt is not None:
+        col_ok = (
+            jnp.arange(k_c.shape[1])[None, None, None, :]
+            < cnt[:, :, None, None]
+        )
+        s_ = jnp.where(col_ok, s_, NEG_INF)
+    p = jnp.exp(s_ - lse[..., None])  # [B, H, mq, mk]
+    if cnt is not None:
+        p = jnp.where(col_ok, p, 0.0)
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, do32)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", do32, v32)
+    ds = p * (dp - delta[..., None])
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, k32) * scale
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, q32) * scale
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _ring_attention(qs, ks, vs, counts, axis_name, world, rps, allow_pallas):
+    """Ring-scheduled attention of local sparse queries against the
+    segment's rotating sparse K/V chunks.
+
+    ``qs`` [B, mq, H, D] local queries; ``ks``/``vs`` [B, mk, H, D] the
+    LOCAL chunk (never gathered); ``counts`` optional [rps, B, H] valid
+    sparse-key counts per ORIGIN rank of the sub-ring (from the hoisted
+    per-call counts gather), or None when every slot is valid. Returns
+    ``(out [B, mq, H, D], lse [B, H, mq])`` — identical math to
+    attending the concatenated chunks (softmax is associative under the
+    stored-LSE combine), so the all-gather path stays the parity oracle.
+
+    The custom VJP rings in reverse order of memory, not of schedule:
+    the same forward rotation replays, each shard computes its
+    contribution to the RESIDENT chunk's dK/dV from the saved combined
+    lse (no per-step softmax state is stored), accumulates it into a
+    gradient buffer that rotates WITH the chunk, and after a full cycle
+    every buffer arrives home holding all ``rps`` shards' contributions
+    — the overlapped twin of the differentiable all-gather's implicit
+    backward reduce-scatter.
+    """
+    return _ring_attention_fwd_impl(
+        qs, ks, vs, counts, axis_name, world, rps, allow_pallas
+    )
+
+
+def _ring_attention_fwd(qs, ks, vs, counts, axis_name, world, rps,
+                        allow_pallas):
+    out, lse = _ring_attention_fwd_impl(
+        qs, ks, vs, counts, axis_name, world, rps, allow_pallas
+    )
+    # residuals: the local inputs plus the combined (out, lse) — nothing
+    # whose size scales with the segment, and no per-step state
+    return (out, lse), (qs, ks, vs, counts, out, lse)
+
+
+def _ring_attention_bwd(axis_name, world, rps, allow_pallas, res, cots):
+    from gigapath_tpu.obs.spans import ring_step
+
+    qs, ks, vs, counts, out, lse = res
+    do, _dlse = cots  # no gradient flows through the lse output
+    Dh = qs.shape[-1]
+    scale = Dh ** -0.5
+    perm = _ring_perm(world, rps)
+    my_rel = jnp.mod(jax.lax.axis_index(axis_name), rps)
+    kv_bytes = 2 * int(np.prod(ks.shape)) * ks.dtype.itemsize  # k + v
+    # delta = rowsum(do * out) per (token, head) — constant across steps
+    delta = jnp.einsum(
+        "bqhd,bqhd->bhq", do.astype(jnp.float32), out.astype(jnp.float32)
+    )
+    dq = jnp.zeros(qs.shape, jnp.float32)
+    dk_acc = jnp.zeros(ks.shape, jnp.float32)
+    dv_acc = jnp.zeros(vs.shape, jnp.float32)
+    k_cur, v_cur = ks, vs
+    # every step rotates the fp32 dk/dv accumulators; all but the last
+    # also rotate the k/v double-buffer
+    acc_bytes = 2 * int(np.prod(ks.shape)) * 4
+    for s in range(rps):
+        with ring_step(
+            s, rps, acc_bytes + (kv_bytes if s + 1 < rps else 0)
+        ):
+            if s + 1 < rps:  # double-buffer: permute before the compute
+                k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+                v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+            cnt = _ring_step_counts(counts, my_rel, s, rps)
+            dq_s, dk_s, dv_s = _ring_partial_bwd(
+                qs, k_cur, v_cur, do, lse, delta, cnt, scale
+            )
+            dq = dq + dq_s
+            # dK/dV accumulate where computed and rotate WITH the chunk:
+            # after the final step's permute each buffer is home (rotated
+            # rps times == identity) carrying every shard's contribution
+            dk_acc = jax.lax.ppermute(dk_acc + dk_s, axis_name, perm)
+            dv_acc = jax.lax.ppermute(dv_acc + dv_s, axis_name, perm)
+            if s + 1 < rps:
+                k_cur, v_cur = k_nxt, v_nxt
+    counts_ct = (
+        None if counts is None
+        else np.zeros(counts.shape, dtype=jax.dtypes.float0)
+    )
+    return (
+        dq.astype(qs.dtype), dk_acc.astype(ks.dtype),
+        dv_acc.astype(vs.dtype), counts_ct,
+    )
+
+
+_ring_attention.defvjp(_ring_attention_fwd, _ring_attention_bwd)
+
+
 def dilated_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -769,6 +979,7 @@ def dilated_attention(
     dropout_rate: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
     valid_len: Optional[jnp.ndarray] = None,
+    flags=None,
 ) -> jnp.ndarray:
     """Multi-branch dilated attention on [B, L, H, D] tensors -> [B, L, H, D].
 
@@ -800,6 +1011,17 @@ def dilated_attention(
     keeps validity a contiguous prefix). A static int (same partial count
     on every shard — not a contiguous prefix) and causal + ``valid_len``
     both remain unsupported on gathered branches.
+
+    ``flags``: one :class:`~gigapath_tpu.ops.pallas_dilated.PipelineFlags`
+    snapshot shared by every branch of this op (None: snapshot the
+    environment here, once — the same contract as
+    :func:`dilated_attention_fused`). ``flags.ring_attn``
+    (``GIGAPATH_RING_ATTN``) routes non-causal gathered branches through
+    the ring-scheduled K/V exchange (:func:`_ring_attention`): per-shard
+    memory O(local chunk) instead of O(full segment), ppermute overlapped
+    with partial attention, the all-gather path remaining the fallback
+    (causal gathered branches, custom ``attn_fn``, dropout) and the
+    parity oracle.
     """
     attn_fn_was_default = attn_fn is None
     if attn_fn_was_default:
@@ -882,7 +1104,8 @@ def dilated_attention(
             from gigapath_tpu.ops.pallas_dilated import snapshot_flags
 
             streaming = _env_flag("GIGAPATH_STREAMING_FUSION")
-            flags = snapshot_flags()
+            if flags is None:
+                flags = snapshot_flags()
             fused_ok = all(
                 H % int(rr) == 0 and (H * Dh) % int(rr) == 0
                 for rr in dilated_ratios
@@ -944,24 +1167,59 @@ def dilated_attention(
     # valid count) rides the fused kernels' SMEM valid-count tables
     # exactly as on a single device, and gathered branches combine the
     # all-gathered per-rank counts below (_dilated_branch).
+    seq_active = seq_axis_name is not None and seq_axis_size > 1
+    sp_flags = flags
+    if seq_active and sp_flags is None:
+        # ONE flag snapshot shared by every branch of this op — fused-local
+        # routing AND the ring dispatch below (same invariant as the
+        # single-device dispatch above: branches of one op must never
+        # observe different env flag values)
+        from gigapath_tpu.ops.pallas_dilated import snapshot_flags
+
+        sp_flags = snapshot_flags()
     fused_local = (
         kernels_eligible
-        and seq_axis_name is not None
-        and seq_axis_size > 1
+        and seq_active
         and _tpu_default_dispatch()
         and _vma_transparent()
     )
     sp_real_len, sp_valid_dyn = (
         _normalize_valid_len(valid_len, B, L) if fused_local else (L, None)
     )
-    sp_flags = None
-    if fused_local:
-        # ONE flag snapshot shared by every fused-local branch of this op
-        # (same invariant as the single-device dispatch above: branches of
-        # one op must never observe different env flag values)
-        from gigapath_tpu.ops.pallas_dilated import snapshot_flags
 
-        sp_flags = snapshot_flags()
+    # Ring schedule (GIGAPATH_RING_ATTN) for the gathered branches: same
+    # eligibility gate as the compiled kernels (default attn_fn, no
+    # dropout, no offset, self-attention shapes) — the ring VJP implements
+    # softmax-attention math and cannot honor an arbitrary attn_fn.
+    # Causal gathered branches keep the gather path (its rank-bias
+    # construction has no ring counterpart yet); _dilated_branch warns.
+    ring_attn = bool(
+        seq_active and kernels_eligible and sp_flags is not None
+        and sp_flags.ring_attn
+    )
+    ring_allow_pallas = False
+    if ring_attn:
+        # flash_attention's Pallas tier for the per-step partials is only
+        # reachable on TPU outside a vma-checking shard_map (same
+        # constraint as the fused-local routing); the jnp tier is always
+        # legal. Static: participates in the ring op's nondiff args.
+        ring_allow_pallas = _tpu_default_dispatch() and _vma_transparent()
+
+    # Hoisted per-call counts gather: the ragged valid counts are
+    # rank-local data, identical across branches — ONE all_gather serves
+    # every gathered branch (gather path and ring path alike) instead of
+    # one per branch.
+    gathered_counts = None
+    if (
+        seq_active
+        and valid_len is not None
+        and not isinstance(valid_len, (int, np.integer))
+        and any(int(sl) > k.shape[1] for sl in segment_lengths)
+    ):
+        vl_local = jnp.asarray(valid_len, jnp.int32).reshape(B)
+        gathered_counts = jax.lax.all_gather(
+            vl_local, seq_axis_name, axis=0
+        )  # [W, B]
 
     outs, lses = [], []
     for i, (sl, r) in enumerate(zip(segment_lengths, dilated_ratios)):
@@ -990,7 +1248,8 @@ def dilated_attention(
             q, k, v, sl_i, r_i,
             is_causal=is_causal, offset=offset, attn_fn=branch_fn,
             seq_axis_name=seq_axis_name, seq_axis_size=seq_axis_size,
-            valid_len=valid_len,
+            valid_len=valid_len, gathered_counts=gathered_counts,
+            ring=ring_attn, ring_allow_pallas=ring_allow_pallas,
         )
         outs.append(o)
         lses.append(l)
@@ -1022,8 +1281,17 @@ def _dilated_branch(
     seq_axis_name: Optional[str],
     seq_axis_size: int,
     valid_len: Optional[jnp.ndarray] = None,
+    gathered_counts: Optional[jnp.ndarray] = None,
+    ring: bool = False,
+    ring_allow_pallas: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """One (segment_length, ratio) branch -> (out [B,L,H,D], lse [B,H,L])."""
+    """One (segment_length, ratio) branch -> (out [B,L,H,D], lse [B,H,L]).
+
+    ``gathered_counts``: the caller's hoisted ``[W, B]`` all-gather of
+    per-rank valid counts (rank-local data, identical across branches —
+    gathered once per ``dilated_attention`` call, not per branch).
+    ``ring``: route a non-causal gathered branch through the
+    ring-scheduled K/V exchange instead of the all-gather."""
     B, L, H, Dh = q.shape
 
     if offset > 0:
@@ -1063,8 +1331,19 @@ def _dilated_branch(
 
     kv_valid_len = None
     sp_causal_bias = None
+    ring_result = None
+    ring_counts = None
     if gather_kv:
         local_len = k.shape[1]
+        use_ring = ring and not is_causal
+        if ring and is_causal:
+            # visible, once: silently taking the gather path would make
+            # the flag look broken exactly where memory matters most
+            _warn_once(
+                "GIGAPATH_RING_ATTN requested on a CAUSAL gathered branch: "
+                "the ring schedule has no rank-bias construction yet — "
+                "using the all-gather path for this branch"
+            )
         if valid_len is not None:
             if is_causal:
                 raise NotImplementedError(
@@ -1094,10 +1373,12 @@ def _dilated_branch(
                 )
             rps = sl // local_len
             m_loc = ks.shape[1]
-            vl_local = jnp.asarray(valid_len, jnp.int32).reshape(B)
-            all_counts = jax.lax.all_gather(
-                vl_local, seq_axis_name, axis=0
-            )  # [W, B]
+            all_counts = gathered_counts  # hoisted: ONE gather per call
+            if all_counts is None:  # direct/partial callers only
+                vl_local = jnp.asarray(valid_len, jnp.int32).reshape(B)
+                all_counts = jax.lax.all_gather(
+                    vl_local, seq_axis_name, axis=0
+                )  # [W, B]
             rank = jax.lax.axis_index(seq_axis_name)
             seg_counts = jax.lax.dynamic_slice_in_dim(
                 all_counts, rank // rps * rps, rps, axis=0
@@ -1108,10 +1389,28 @@ def _dilated_branch(
                 (seg_counts[:, :, None] - phases[None, None, :]) / r
             )
             per_rank = jnp.clip(per_rank, 0, m_loc).astype(jnp.int32)
-            kv_valid_len = per_rank.sum(axis=0)  # [B, H] == [B*n_seg, H]
+            if use_ring:
+                # keep the per-ORIGIN-rank table [rps, B, H]: each ring
+                # step selects the resident chunk's row; the prefix sum
+                # over concatenated keys never exists on the ring path
+                ring_counts = per_rank
+            else:
+                kv_valid_len = per_rank.sum(axis=0)  # [B, H] == [B*n_seg, H]
             valid_len = None  # consumed
-        ks = _gather_kv_seq_parallel(ks, sl, local_len, seq_axis_name)
-        vs = _gather_kv_seq_parallel(vs, sl, local_len, seq_axis_name)
+        if use_ring:
+            assert sl % local_len == 0, (sl, local_len)
+            rps = sl // local_len
+            assert rps <= seq_axis_size, (
+                f"gathered branch needs {rps} ranks but the seq axis has "
+                f"{seq_axis_size}"
+            )
+            ring_result = _ring_attention(
+                qs, ks, vs, ring_counts,
+                seq_axis_name, seq_axis_size, rps, ring_allow_pallas,
+            )
+        else:
+            ks = _gather_kv_seq_parallel(ks, sl, local_len, seq_axis_name)
+            vs = _gather_kv_seq_parallel(vs, sl, local_len, seq_axis_name)
         if is_causal:
             # Causal sequence parallelism (reference gather_kv:64-68): ranks
             # of my segment *ahead* of me must be invisible, earlier ranks
@@ -1165,7 +1464,9 @@ def _dilated_branch(
                 else jnp.minimum(counts, jnp.asarray(kv_valid_len, jnp.int32))
             )
 
-    if sp_causal_bias is not None:
+    if ring_result is not None:
+        out_s, lse_s = ring_result
+    elif sp_causal_bias is not None:
         out_s, lse_s = attn_fn(
             qs, ks, vs, is_causal=False, kv_valid_len=None, bias=sp_causal_bias
         )

@@ -84,3 +84,64 @@ def _pallas_available() -> bool:
         return True
     except ImportError:  # pragma: no cover
         return False
+
+
+def partial_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    kv_valid_len=None,
+    use_pallas: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Partial-softmax entry point for blockwise/ring schedules.
+
+    Attention of ``q`` against ONE resident K/V chunk, returning the
+    chunk-normalized ``(out [B,Lq,H,D], lse [B,H,Lq])`` pair — exactly
+    the state :func:`combine_partials` folds across chunks: because the
+    output is normalized by its own softmax sum and the sum's log rides
+    in the lse, partials over disjoint key sets merge into the full
+    softmax without ever materializing the concatenated key axis. This
+    is :func:`flash_attention` restricted to the non-causal self-shape
+    case (a ring step has no global causal structure — callers mask
+    before/at the chunk level via ``kv_valid_len``); it exists as a
+    named entry so ring-step call sites read as partial-softmax by
+    contract, not by accident of the default path.
+    """
+    return flash_attention(
+        q, k, v, is_causal=False, kv_valid_len=kv_valid_len,
+        use_pallas=use_pallas,
+    )
+
+
+def combine_partials(
+    out_a: jnp.ndarray,
+    lse_a: jnp.ndarray,
+    out_b: jnp.ndarray,
+    lse_b: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Merge two partial-softmax results by their stored log-sum-exps.
+
+    ``out_*`` are ``[B, L, H, D]`` attention outputs each normalized
+    over its OWN key set, ``lse_*`` the matching ``[B, H, L]``
+    log-sum-exps; returns the pair normalized over the UNION of the key
+    sets — the same online-softmax identity flash attention applies
+    across key blocks inside one kernel and the stream-fusion epilogue
+    applies across branches (pallas_dilated.py), here applied across
+    ring steps. Fully-masked partials carry ``lse ~ NEG_INF`` and fold
+    in with weight ``exp(NEG_INF - lse) == 0``, so no special-casing.
+
+    Accumulates in fp32 and returns ``out`` in ``out_a``'s dtype — ring
+    loops keep the accumulator fp32 end to end by seeding with an fp32
+    first partial.
+    """
+    lse = jnp.logaddexp(lse_a, lse_b)  # [B, H, L]
+
+    def w4(w):  # [B, H, L] -> broadcastable [B, L, H, 1]
+        return w.transpose(0, 2, 1)[..., None]
+
+    out = (
+        out_a.astype(jnp.float32) * w4(jnp.exp(lse_a - lse))
+        + out_b.astype(jnp.float32) * w4(jnp.exp(lse_b - lse))
+    )
+    return out.astype(out_a.dtype), lse

@@ -840,12 +840,16 @@ class PipelineFlags(NamedTuple):
     pipe_bwd_block_k: Optional[int] = None
     pack_direct: bool = False
     stream_fusion: bool = False
+    # ring-scheduled K/V exchange for gathered sequence-parallel branches
+    # (ops/dilated_attention.py): per-shard memory O(local chunk) instead
+    # of O(full segment), ppermute overlapped with partial attention
+    ring_attn: bool = False
 
 
 def snapshot_flags() -> PipelineFlags:
     """Read GIGAPATH_PIPELINED_ATTN/_BWD, GIGAPATH_PIPE(_BWD)_BLOCK_K,
-    GIGAPATH_PACK_DIRECT and GIGAPATH_STREAM_FUSION from the environment,
-    once."""
+    GIGAPATH_PACK_DIRECT, GIGAPATH_STREAM_FUSION and GIGAPATH_RING_ATTN
+    from the environment, once."""
     import os
 
     from gigapath_tpu.ops.common import env_flag
@@ -861,6 +865,7 @@ def snapshot_flags() -> PipelineFlags:
         pipe_bwd_block_k=_int("GIGAPATH_PIPE_BWD_BLOCK_K"),
         pack_direct=env_flag("GIGAPATH_PACK_DIRECT"),
         stream_fusion=env_flag("GIGAPATH_STREAM_FUSION"),
+        ring_attn=env_flag("GIGAPATH_RING_ATTN"),
     )
 
 

@@ -38,6 +38,52 @@ _ROW_PARALLEL = (
     "proj",
 )
 
+# Sequence-parallel collective registry: the explicit communication the
+# library is ALLOWED to perform over the ``seq`` mesh axis, by module.
+# Unlike the GSPMD parameter rules above (layout only, XLA inserts the
+# collectives), the seq-parallel attention paths issue collectives BY
+# HAND inside shard_map — each one is a deliberate sharding decision
+# (what crosses the axis, and in which schedule) and must be recorded
+# here so the layout story stays auditable in one file. Coverage is
+# enforced mechanically by gigalint GL009
+# (tools/gigalint/sharding_coverage.py): a ``ppermute``/``all_gather``
+# call in library code whose module has no matching entry flags.
+#
+# Keys are module-path suffixes; values the sanctioned collective names.
+_SEQ_COLLECTIVES: Dict[str, tuple] = {
+    # gathered dilated branches: the hoisted per-call all_gather of
+    # rank-local valid counts ([W, B] ints, shared by every gathered
+    # branch), the legacy full-segment K/V all_gather (fallback + parity
+    # oracle), and the ring schedule's sub-ring ppermute rotation of
+    # local sparse K/V chunks (GIGAPATH_RING_ATTN, fwd + reverse ring in
+    # the custom VJP)
+    "gigapath_tpu/ops/dilated_attention.py": ("all_gather", "ppermute"),
+}
+
+
+def shard_map_compat():
+    """(shard_map, check_kwargs) across jax spellings: jax >= 0.9 exposes
+    ``jax.shard_map`` and checks vma (``check_vma`` — pallas-opaque, so
+    the kwarg disables it); 0.4.x has the experimental spelling and
+    ``check_rep``. The ONE compat shim — scripts and tests building
+    seq-parallel regions by hand unpack it instead of re-deriving the
+    signature dance per call site::
+
+        shard_map, check_kw = shard_map_compat()
+        fn = shard_map(body, mesh=mesh, in_specs=..., out_specs=..., **check_kw)
+    """
+    import inspect
+
+    try:  # jax >= 0.9 spells it jax.shard_map
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    sig = inspect.signature(shard_map).parameters
+    check_kw = (
+        {"check_vma": False} if "check_vma" in sig else {"check_rep": False}
+    )
+    return shard_map, check_kw
+
 
 def param_spec(
     path_names,

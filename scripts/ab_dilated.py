@@ -17,6 +17,15 @@ epilogue adoption decision is one command the moment a chip answers::
 
     python scripts/ab_dilated.py --variants fused,stream --json AB_EPILOGUE.json
     python scripts/ab_dilated.py --variants fused,stream --grad --json AB_EPILOGUE_GRAD.json
+
+``gather``/``ring`` A/B the sequence-parallel K/V exchange for oversized
+branches on a multi-device slice (a ``seq`` mesh over every visible
+device): ``gather`` is the all-gather path, ``ring`` the
+GIGAPATH_RING_ATTN ppermute schedule. With both present the JSON gains
+the ``adopt_ring_attn`` decision row (same shape as
+``adopt_stream_fusion``)::
+
+    python scripts/ab_dilated.py --variants gather,ring --n 16384 --json AB_RING.json
 """
 
 import argparse
@@ -106,8 +115,74 @@ def main():
 
         return wrapped
 
+    seq_requested = [n for n in ("gather", "ring") if n in args.variants]
+    if seq_requested:
+        # seq-parallel A/B: shard the token axis over EVERY visible
+        # device. L trims to a shard multiple; gathered branches must
+        # divide into whole shards (the shard_map path's contract), so
+        # incompatible segments are dropped with a note.
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from gigapath_tpu.parallel.sharding import shard_map_compat
+
+        shard_map, check_kw = shard_map_compat()
+        ndev = len(jax.devices())
+        if ndev < 2:
+            sys.exit("--variants gather/ring need >= 2 devices")
+        Lp = L - (L % ndev)
+        lloc = Lp // ndev
+        kept = [
+            (sl, r) for sl, r in zip(SEGS, RATIOS)
+            if sl <= Lp and (sl <= lloc or sl % lloc == 0)
+        ]
+        dropped = [b for b in zip(SEGS, RATIOS) if b not in kept]
+        if dropped:
+            print(f"seq A/B: dropping branches {dropped} "
+                  f"(segment not local and not a multiple of the "
+                  f"{lloc}-token shard)")
+        if L != Lp:
+            print(f"seq A/B: trimming L {L} -> {Lp} ({ndev} shards)")
+            q, k, v = (x[:, :Lp] for x in (q, k, v))
+            L = Lp
+        SEGS = [sl for sl, _ in kept]
+        RATIOS = [r for _, r in kept]
+        if not SEGS:
+            sys.exit(
+                "seq A/B: NO branch survives the shard filter at this "
+                f"geometry (Lp={Lp}, {ndev} shards) — raise --n (e.g. "
+                "--n 1048576, the 1M operating point) or pick compatible "
+                "--branches"
+            )
+        if not any(sl > lloc for sl in SEGS):
+            print(
+                "seq A/B: WARNING — no branch exceeds the shard length, so "
+                "ring and gather are byte-identical here; pass a "
+                "power-of-two --n (e.g. --n 1048576, the 1M operating "
+                "point) so an oversized branch survives the filter"
+            )
+        flops = sum(
+            4 * E * L * (-(-min(sl, L) // r)) / r for sl, r in kept
+        ) * (4.5 if args.grad else 1.0)
+        mesh = Mesh(np.array(jax.devices()), ("seq",))
+
+        def seq_fn(q, k, v):
+            return shard_map(
+                lambda q, k, v: da.dilated_attention(
+                    q, k, v, SEGS, RATIOS,
+                    seq_axis_name="seq", seq_axis_size=ndev,
+                ),
+                mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+                out_specs=P(None, "seq"), **check_kw,
+            )(q, k, v)
+
     fused = lambda q, k, v: da.dilated_attention_fused(q, k, v, SEGS, RATIOS)
     variants = {}
+    if "gather" in args.variants:
+        variants["gather"] = with_env(seq_fn, GIGAPATH_RING_ATTN=0)
+    if "ring" in args.variants:
+        # ring-scheduled K/V exchange: ppermute rotation + stored-LSE
+        # combine, per-shard memory O(local chunk)
+        variants["ring"] = with_env(seq_fn, GIGAPATH_RING_ATTN=1)
     if "bhld" in args.variants:
         variants["bhld"] = lambda q, k, v: da.dilated_attention_bhld(
             q, k, v, SEGS, RATIOS
@@ -187,9 +262,8 @@ def main():
             "branches": [[int(s), int(r)] for s, r in zip(SEGS, RATIOS)],
             "variants": table,
         }
-        # the decision row the epilogue A/B exists for: adopt the
-        # streaming epilogue when it beats the dense-scatter fused path
-        # by more than measurement noise (>= 3%)
+        # the decision rows the A/Bs exist for: adopt a variant when it
+        # beats its baseline by more than measurement noise (>= 3%)
         if "fused" in table and "stream" in table:
             f_ms = table["fused"]["ms_per_op"]
             s_ms = table["stream"]["ms_per_op"]
@@ -199,6 +273,15 @@ def main():
                 "stream_over_fused": round(s_ms / f_ms, 4),
                 "adopt_stream_fusion": bool(s_ms <= f_ms * 0.97),
             }
+        if "gather" in table and "ring" in table:
+            g_ms = table["gather"]["ms_per_op"]
+            r_ms = table["ring"]["ms_per_op"]
+            payload.setdefault("decision", {}).update({
+                "gather_ms": g_ms,
+                "ring_ms": r_ms,
+                "ring_over_gather": round(r_ms / g_ms, 4),
+                "adopt_ring_attn": bool(r_ms <= g_ms * 0.97),
+            })
         with open(args.json, "w") as f:
             json.dump(payload, f, indent=1)
             f.write("\n")

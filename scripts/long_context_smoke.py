@@ -83,8 +83,11 @@ def run_sharded(n: int, n_devices: int = 8) -> dict:
     """
     jax.config.update("jax_platforms", "cpu")
     assert jax.device_count() >= n_devices, (jax.device_count(), n_devices)
-    from jax import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
+
+    from gigapath_tpu.parallel.sharding import shard_map_compat
+
+    shard_map, check_kw = shard_map_compat()
 
     from gigapath_tpu.ops.dilated_attention import dilated_attention
 
@@ -106,8 +109,9 @@ def run_sharded(n: int, n_devices: int = 8) -> dict:
         in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
         out_specs=P(None, "seq"),
         # required whenever the Pallas tier runs inside this region (TPU):
-        # jax 0.9 vma checking cannot see through pallas_call
-        check_vma=False,
+        # jax 0.9's vma checking (0.4's check_rep) cannot see through
+        # pallas_call
+        **check_kw,
     )
     t0 = time.perf_counter()
     out = jax.block_until_ready(jax.jit(fn)(q, k, v))

@@ -57,6 +57,12 @@ FLAGSHIP_SEGMENTS = [1024, 5792, 32768, 185363, 1048576]
 FLAGSHIP_RATIOS = [1, 2, 4, 8, 16]
 DILATED_SHAPE = dict(B=1, L=512, H=16, Dh=4)
 SLIDE_N, SLIDE_IN_CHANS = 256, 16
+# ring-vs-gather seq-parallel fingerprint geometry: a 4-rank seq mesh
+# (of the 8 virtual CPU devices), one fused-local branch and one
+# gathered branch spanning the whole sub-ring
+RING_SHAPE = dict(B=1, L=32, H=4, Dh=8, ndev=4)
+RING_SEGMENTS = [8, 32]
+RING_RATIOS = [1, 2]
 
 
 def build_golden_ledger():
@@ -100,6 +106,48 @@ def build_golden_ledger():
                 q, q, q,
             )
 
+    # -- ring vs gather seq parallelism (fingerprint-only): the ring
+    # path's jaxpr must carry ZERO full-segment all_gather of K/V — only
+    # ppermute (and, when ragged, the one hoisted counts gather) — while
+    # the gather path still materializes the K/V all_gathers. Pinned by
+    # tests/test_ledger.py::test_golden_covers_the_ring_signal. ----------
+    import numpy as onp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from gigapath_tpu.ops.dilated_attention import dilated_attention
+    from gigapath_tpu.ops.pallas_dilated import PipelineFlags as PF
+    from gigapath_tpu.parallel.sharding import shard_map_compat
+
+    shard_map, check_kw = shard_map_compat()
+    rB, rL, rH, rDh, ndev = (
+        RING_SHAPE[k] for k in ("B", "L", "H", "Dh", "ndev")
+    )
+    rq = jnp.ones((rB, rL, rH, rDh), jnp.float32)
+    mesh = Mesh(onp.array(jax.devices()[:ndev]), ("seq",))
+
+    def ring_fn(ring: bool, grad: bool):
+        flags = PF(ring_attn=ring)
+        sp = shard_map(
+            lambda q, k, v: dilated_attention(
+                q, k, v, RING_SEGMENTS, RING_RATIOS,
+                seq_axis_name="seq", seq_axis_size=ndev, flags=flags,
+            ),
+            mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+            out_specs=P(None, "seq"), **check_kw,
+        )
+
+        def f(q, k, v):
+            return (sp(q, k, v).astype(jnp.float32) ** 2).sum()
+
+        return jax.grad(f, argnums=(0, 1, 2)) if grad else f
+
+    for variant, ring in (("ring", True), ("ring_gather", False)):
+        for pass_name, grad in (("fwd", False), ("grad", True)):
+            ledger.capture_fingerprint(
+                f"dilated_{variant}_{pass_name}", ring_fn(ring, grad),
+                rq, rq, rq,
+            )
+
     # -- slide encoder (flagship topology at smoke scale): full profile
     # with XLA cost/memory analysis --------------------------------------
     model, params = slide_encoder.create_model(
@@ -124,6 +172,8 @@ def build_golden_ledger():
         "segments": FLAGSHIP_SEGMENTS,
         "ratios": FLAGSHIP_RATIOS,
         "dilated_shape": DILATED_SHAPE,
+        "ring": {**RING_SHAPE, "segments": RING_SEGMENTS,
+                 "ratios": RING_RATIOS},
         "slide": {"n_tokens": SLIDE_N, "in_chans": SLIDE_IN_CHANS,
                   "arch": "gigapath_slide_enc_tiny"},
         "jax_version": jax.__version__,

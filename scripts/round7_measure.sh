@@ -1,0 +1,46 @@
+#!/bin/bash
+# Round-7 on-chip measurement checklist, in priority order — round 6's
+# successor, folding in the ring-vs-gather sequence-parallel A/B
+# (GIGAPATH_RING_ATTN). Each step is timeout-bounded and logs to
+# /tmp/r7_*.log; artifacts land in the repo.
+# Run when a MULTI-CHIP slice is up:  bash scripts/round7_measure.sh
+set -x
+cd "$(dirname "$0")/.."
+
+# 1. headline bench -> BENCH_LOCAL.json (the round's survivable record)
+timeout 1800 python bench.py 2>/tmp/r7_bench.err | tee /tmp/r7_bench.log
+
+# 2. gate the kernels at the bench geometry (incl. flagged combos)
+timeout 2400 python scripts/tpu_selfcheck.py > /tmp/r7_selfcheck.log 2>&1
+tail -5 /tmp/r7_selfcheck.log
+
+# 3. THE round-7 decision: all-gather vs ring K/V exchange for the
+#    oversized branches at the 1M operating point (power-of-two L so the
+#    2^20 segment divides into whole shards). Decision-table JSON
+#    (adopt_ring_attn verdict) + obs run_end -> AB_DILATED_OBS.jsonl.
+#    NEEDS >= 2 devices; on one chip it exits with a message.
+timeout 2400 python scripts/ab_dilated.py --variants gather,ring \
+  --n 1048576 --iters 8 --json AB_RING.json > /tmp/r7_ab_ring.log 2>&1
+tail -12 /tmp/r7_ab_ring.log
+
+# 4. same decision for the grad step (the reverse ring vs the implicit
+#    backward reduce-scatter of the differentiable all-gather)
+timeout 2400 python scripts/ab_dilated.py --variants gather,ring \
+  --n 1048576 --iters 8 --grad --json AB_RING_GRAD.json \
+  > /tmp/r7_ab_ring_grad.log 2>&1
+tail -12 /tmp/r7_ab_ring_grad.log
+
+# 5. per-shard slice of the 1M recipe with the ring memory/comm fields:
+#    branch_*_{gather,ring}_{arg,temp,peak}_mb + *_comm_mb in
+#    SEQ_SHARD.json, full profiles in SEQ_SHARD.json.ledger.json ->
+#    diff per-shard bytes with scripts/ledger_diff.py
+timeout 2400 python scripts/seq_shard_slice.py --out SEQ_SHARD.json \
+  > /tmp/r7_slice.log 2>&1
+tail -4 /tmp/r7_slice.log
+
+# 6. the memory half of the claim, past the 393k wall: long-context
+#    envelope with the ring flag on (streaming fusion composed in, per
+#    the round-3 playbook)
+GIGAPATH_RING_ATTN=1 GIGAPATH_STREAMING_FUSION=1 GIGAPATH_STREAM_FUSION=1 \
+  timeout 2400 python scripts/long_context_smoke.py > /tmp/r7_envelope.log 2>&1
+tail -8 /tmp/r7_envelope.log

@@ -34,6 +34,16 @@ subtraction negative, and the 2x backward correction is an assumption).
 The collective itself cannot be timed on one chip; it is reported as an
 analytic byte count / 100 GB/s ICI bound, clearly labeled as such. Output:
 one JSON line (tee'd to SEQ_SHARD.json by --out).
+
+Ring-vs-gather (round 7): every gathered branch is additionally emulated
+under the RING schedule — ``ceil(segment / L_local)`` chunk-sized partial
+attentions folded through the stored-LSE combine, the identical per-shard
+compute of ``GIGAPATH_RING_ATTN`` with the ppermutes elided (one chip) —
+and BOTH variants' per-shard compiled memory (argument/temp/peak bytes,
+via the perf ledger's XLA memory analysis) and comm bytes land in the
+JSON: ``branch_*_{gather,ring}_{arg,temp,peak}_mb`` + ``_comm_mb``. The
+full profiles ride a canonical ledger next to ``--out``
+(``SEQ_SHARD.ledger.json`` by default) for ``scripts/ledger_diff.py``.
 """
 
 import argparse
@@ -57,12 +67,22 @@ def main():
         "for smoke-testing the script itself)",
     )
     parser.add_argument("--ndev", type=int, default=8)
+    parser.add_argument(
+        "--ledger", default=None,
+        help="ledger JSON for the per-variant compiled profiles "
+        "(default: <out>.ledger.json, or SEQ_SHARD.ledger.json)",
+    )
     args = parser.parse_args()
 
     from gigapath_tpu.models.longnet_config import flagship_geometry
+    from gigapath_tpu.obs.ledger import PerfLedger
     from gigapath_tpu.ops.dilated_attention import (
         dense_to_sparse,
         dilated_attention,
+    )
+    from gigapath_tpu.ops.flash_attention import (
+        combine_partials,
+        partial_attention,
     )
     from gigapath_tpu.utils.timing import chained_seconds_per_iter
 
@@ -86,11 +106,29 @@ def main():
     }
     fwd_total = 0.0
     train_total = 0.0
+    ledger_path = args.ledger or (
+        (args.out + ".ledger.json") if args.out else "SEQ_SHARD.ledger.json"
+    )
+    ledger = PerfLedger(path=ledger_path)
 
     def mk(shape):
         return jnp.asarray(rng.normal(size=shape), jnp.bfloat16)
 
-    def time_fwd_and_grad(call, q, k, v, tag):
+    def shard_memory_fields(tag, variant, call, *tensors):
+        """Per-shard compiled argument/temp/peak bytes for one variant of
+        one branch, via the perf ledger (XLA memory analysis of the
+        emulated per-shard forward — deterministic, needs no mesh)."""
+        entry = ledger.capture_full(f"seq_shard_{variant}_{tag}", call,
+                                    *tensors)
+        mem = (entry or {}).get("memory") or {}
+        for field, key in (("arg", "argument_bytes"), ("temp", "temp_bytes"),
+                           ("peak", "peak_bytes")):
+            val = mem.get(key)
+            result[f"{tag}_{variant}_{field}_mb"] = (
+                None if val is None else round(val / 2**20, 1)
+            )
+
+    def time_fwd_and_grad(call, q, k, v, tag, accumulate=True):
         """Forward sec + (fwd+bwd) sec for out = call(q, k, v)."""
         nonlocal fwd_total, train_total
 
@@ -118,8 +156,9 @@ def main():
         )
         result[f"{tag}_fwd_sec"] = round(sec_f, 4)
         result[f"{tag}_train_sec"] = round(sec_g, 4)
-        fwd_total += sec_f
-        train_total += sec_g
+        if accumulate:  # the headline totals model the GATHER recipe
+            fwd_total += sec_f
+            train_total += sec_g
         return sec_f, sec_g
 
     # ---- local branches: one public-dispatch call at the shard length ----
@@ -139,11 +178,45 @@ def main():
         g = min(sl, L_TOTAL)
         kg = mk((1, g, H, Dh))
         vg = mk((1, g, H, Dh))
-        time_fwd_and_grad(
-            lambda q_, k_, v_, sl=sl, r=r: dilated_attention(
-                q_, k_, v_, [sl], [r]
-            ),
-            q, kg, vg, f"branch_sl{sl}_r{r}",
+        tag = f"branch_sl{sl}_r{r}"
+
+        def gather_call(q_, k_, v_, sl=sl, r=r):
+            return dilated_attention(q_, k_, v_, [sl], [r])
+
+        time_fwd_and_grad(gather_call, q, kg, vg, tag)
+        shard_memory_fields(tag, "gather", gather_call, q, kg, vg)
+
+        # ---- the same branch under the RING schedule, per-shard slice:
+        # ceil(g / L_LOCAL) chunk-sized partial attentions + stored-LSE
+        # combine — the per-shard compute of GIGAPATH_RING_ATTN with each
+        # ppermute replaced by a chunk-sized LOCAL copy (a roll: same
+        # bytes moved into a fresh buffer, and it keeps every step's
+        # inputs distinct so XLA cannot CSE the steps into one; the real
+        # mesh overlaps the true collective with these steps) ----
+        rps_em = -(-g // L_LOCAL)
+
+        def ring_call(q_, k_, v_, r=r, rps_em=rps_em):
+            qs = dense_to_sparse(q_.reshape(1, -1, H, Dh), r)
+            ks = dense_to_sparse(k_.reshape(1, -1, H, Dh), r)
+            vs = dense_to_sparse(v_.reshape(1, -1, H, Dh), r)
+            out = lse = None
+            for s in range(rps_em):
+                k_s = jnp.roll(ks, s, axis=1) if s else ks
+                v_s = jnp.roll(vs, s, axis=1) if s else vs
+                o_s, l_s = partial_attention(qs, k_s, v_s)
+                if out is None:
+                    out, lse = o_s.astype(jnp.float32), l_s
+                else:
+                    out, lse = combine_partials(out, lse, o_s, l_s)
+            return out.astype(q_.dtype)
+
+        time_fwd_and_grad(ring_call, q, k, v, f"{tag}_ring",
+                          accumulate=False)
+        shard_memory_fields(tag, "ring", ring_call, q, k, v)
+        m_loc = L_LOCAL // r
+        # ring comm per shard: (steps-1) chunk-sized K+V receives (bf16)
+        result[f"{tag}_ring_comm_mb"] = round(
+            2 * (rps_em - 1) * m_loc * H * Dh * 2 / 2**20, 1
         )
 
         # emulation packs g K/V rows where a real shard packs L_LOCAL
@@ -169,6 +242,10 @@ def main():
         result[f"branch_sl{sl}_r{r}_gather_mb"] = round(
             2 * (m_total - m_local) * H * Dh * 2 / 2**20, 1
         )
+        # symmetric alias next to the ring field: same receive volume,
+        # but the gather's lands in ONE unoverlapped collective while the
+        # ring's spreads over rps-1 overlapped steps
+        result[f"{tag}_gather_comm_mb"] = result[f"{tag}_gather_mb"]
 
     gather_bytes = sum(
         result[f"branch_sl{sl}_r{r}_gather_mb"] * 2**20

@@ -778,11 +778,6 @@ def test_seq_parallel_fused_routing_fast(rng, monkeypatch):
 
     from jax.sharding import Mesh, PartitionSpec as P
 
-    try:  # jax >= 0.9 spells it jax.shard_map (same idiom as
-        from jax import shard_map  # ops/moe/expert_parallel.py)
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
-
     import gigapath_tpu.ops.flash_attention as fa
     import gigapath_tpu.ops.pallas_dilated as pdm
     from gigapath_tpu.ops import dilated_attention as da
@@ -811,12 +806,7 @@ def test_seq_parallel_fused_routing_fast(rng, monkeypatch):
     mesh = Mesh(np.array(jax.devices()[:n_dev]), ("seq",))
     # rep/vma checking can't see through pallas_call on either jax line —
     # disabled exactly as in the slow seq-parallel tests
-    import inspect
-
-    sig = inspect.signature(shard_map).parameters
-    check_kw = (
-        {"check_vma": False} if "check_vma" in sig else {"check_rep": False}
-    )
+    shard_map, check_kw = _shard_map_compat()
     fn = shard_map(
         functools.partial(
             da.dilated_attention, segment_lengths=sls, dilated_ratios=drs,
@@ -1236,11 +1226,6 @@ def test_seq_parallel_ragged_mask_fused_routing(rng, monkeypatch):
 
     from jax.sharding import Mesh, PartitionSpec as P
 
-    try:  # jax >= 0.9 spells it jax.shard_map
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
-
     import gigapath_tpu.ops.flash_attention as fa
     import gigapath_tpu.ops.pallas_dilated as pdm
     from gigapath_tpu.ops import dilated_attention as da
@@ -1280,12 +1265,7 @@ def test_seq_parallel_ragged_mask_fused_routing(rng, monkeypatch):
     routed.clear()
 
     mesh = Mesh(np.array(jax.devices()[:n_dev]), ("seq",))
-    import inspect
-
-    sig = inspect.signature(shard_map).parameters
-    check_kw = (
-        {"check_vma": False} if "check_vma" in sig else {"check_rep": False}
-    )
+    shard_map, check_kw = _shard_map_compat()
 
     def local_fn(q, k, v, mask_local):
         # per-shard valid counts from the SHARDED mask — exactly what
@@ -1325,3 +1305,352 @@ def test_seq_parallel_ragged_mask_fused_routing(rng, monkeypatch):
         np.testing.assert_allclose(
             np.asarray(b), np.asarray(a), atol=2e-5, rtol=1e-4
         )
+
+
+# ---------------------------------------------------------------------------
+# ring-scheduled sequence parallelism (GIGAPATH_RING_ATTN)
+# ---------------------------------------------------------------------------
+
+
+def _shard_map_compat():
+    """(shard_map, check kwarg) across jax spellings/signatures."""
+    from gigapath_tpu.parallel.sharding import shard_map_compat
+
+    return shard_map_compat()
+
+
+def _seq_parallel_fn(mesh, ndev, sls, drs, flags, n_arrays=3):
+    """shard_map'd dilated_attention over a seq axis of ``ndev`` ranks."""
+    from jax.sharding import PartitionSpec as P
+
+    shard_map, check_kw = _shard_map_compat()
+    return shard_map(
+        lambda q, k, v: dilated_attention(
+            q, k, v, sls, drs, seq_axis_name="seq", seq_axis_size=ndev,
+            flags=flags,
+        ),
+        mesh=mesh,
+        in_specs=(P(None, "seq"),) * n_arrays,
+        out_specs=P(None, "seq"),
+        **check_kw,
+    )
+
+
+def _qkv3(rng, B, N, H, D):
+    return tuple(
+        jnp.asarray(rng.normal(size=(B, N, H, D)), jnp.float32)
+        for _ in range(3)
+    )
+
+
+def test_ring_matches_gather_seq_parallel(rng):
+    """Core ring acceptance, compact tier: on a 2-way seq mesh the
+    ring-scheduled gathered branch matches the all-gather path (the
+    parity oracle) AND the single-device op — forward 1e-5, grads 1e-4.
+    The 8-way mesh with a sub-mesh segment is the slow-tier sibling
+    (test_ring_matches_gather_8way_submesh)."""
+    from jax.sharding import Mesh
+
+    from gigapath_tpu.ops.pallas_dilated import PipelineFlags
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("seq",))
+    q, k, v = _qkv3(rng, 1, 16, 4, 8)
+    sls, drs = [4, 16], [1, 2]  # 16 > the 8-token shard: rps=2 ring
+
+    ref = dilated_attention(q, k, v, sls, drs)
+    gather_fn = _seq_parallel_fn(mesh, 2, sls, drs, PipelineFlags())
+    ring_fn = _seq_parallel_fn(
+        mesh, 2, sls, drs, PipelineFlags(ring_attn=True)
+    )
+    out_g = gather_fn(q, k, v)
+    out_r = ring_fn(q, k, v)
+    np.testing.assert_allclose(np.asarray(out_r), np.asarray(out_g), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out_r), np.asarray(ref), atol=1e-5)
+
+    def grads(fn):
+        def loss(q, k, v):
+            return (fn(q, k, v).astype(jnp.float32) ** 2).sum()
+
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    for a, b in zip(grads(gather_fn), grads(ring_fn)):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=1e-4, rtol=1e-4
+        )
+
+
+@pytest.mark.slow
+def test_ring_matches_gather_8way_submesh(rng):
+    """8-way mesh, segments spanning BOTH a strict subset of the mesh
+    (sl=16 over 4-token shards: rps=4 < world=8 — two independent
+    sub-rings) and the full mesh (sl=32: rps=8): ring output and grads
+    match the all-gather path and the single-device op."""
+    from jax.sharding import Mesh
+
+    from gigapath_tpu.ops.pallas_dilated import PipelineFlags
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("seq",))
+    q, k, v = _qkv3(rng, 1, 32, 4, 8)
+    sls, drs = [4, 16, 32], [1, 2, 4]
+
+    ref = dilated_attention(q, k, v, sls, drs)
+    gather_fn = _seq_parallel_fn(mesh, 8, sls, drs, PipelineFlags())
+    ring_fn = _seq_parallel_fn(
+        mesh, 8, sls, drs, PipelineFlags(ring_attn=True)
+    )
+    out_r = ring_fn(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out_r), np.asarray(gather_fn(q, k, v)), atol=1e-5
+    )
+    np.testing.assert_allclose(np.asarray(out_r), np.asarray(ref), atol=1e-5)
+
+    def grads(fn):
+        def loss(q, k, v):
+            return (fn(q, k, v).astype(jnp.float32) ** 2).sum()
+
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    for a, b in zip(grads(gather_fn), grads(ring_fn)):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=1e-4, rtol=1e-4
+        )
+
+
+def _ragged_seq_parallel_fn(mesh, ndev, sls, drs, flags):
+    """shard_map'd dilated_attention deriving per-shard valid counts from
+    the SHARDED pad mask — what DilatedAttention._attend does."""
+    from jax.sharding import PartitionSpec as P
+
+    shard_map, check_kw = _shard_map_compat()
+
+    def local(q, k, v, mask):
+        vls = (~mask).sum(axis=-1).astype(jnp.int32)
+        return dilated_attention(
+            q, k, v, sls, drs, seq_axis_name="seq", seq_axis_size=ndev,
+            valid_len=vls, flags=flags,
+        )
+
+    return shard_map(
+        local, mesh=mesh, in_specs=(P(None, "seq"),) * 4,
+        out_specs=P(None, "seq"), **check_kw,
+    )
+
+
+def test_ring_ragged_mask_matches_single_device(rng):
+    """Ragged key_padding_mask under the ring: per-ORIGIN-rank valid
+    counts (from the hoisted per-call counts gather) mask each resident
+    chunk, matching the single-device op at valid positions. Also pins
+    the hoist itself: the ragged ring trace carries exactly ONE
+    all_gather (the counts — shared by BOTH gathered branches) and the
+    gather path's K/V all_gathers are gone."""
+    from jax.sharding import Mesh
+
+    from gigapath_tpu.obs import jaxpr_fingerprint
+    from gigapath_tpu.ops.pallas_dilated import PipelineFlags
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("seq",))
+    B, N, valid = 1, 32, 25
+    q, k, v = _qkv3(rng, B, N, 4, 8)
+    sls, drs = [8, 32, 32], [1, 2, 4]  # TWO gathered branches share the hoist
+    pad = jnp.arange(N)[None, :] >= valid
+    vmask = (~pad).astype(np.float32)[:, :, None, None]
+
+    ref = dilated_attention(
+        q, k, v, sls, drs, valid_len=jnp.full((B,), valid, jnp.int32)
+    )
+    ring_fn = _ragged_seq_parallel_fn(
+        mesh, 2, sls, drs, PipelineFlags(ring_attn=True)
+    )
+    out_r = ring_fn(q, k, v, pad)
+    np.testing.assert_allclose(
+        np.asarray(out_r) * np.asarray(vmask),
+        np.asarray(ref) * np.asarray(vmask), atol=1e-5,
+    )
+
+    gather_fn = _ragged_seq_parallel_fn(mesh, 2, sls, drs, PipelineFlags())
+    fp_ring = jaxpr_fingerprint(
+        lambda q, k, v: ring_fn(q, k, v, pad), q, k, v
+    )["primitives"]
+    fp_gather = jaxpr_fingerprint(
+        lambda q, k, v: gather_fn(q, k, v, pad), q, k, v
+    )["primitives"]
+    assert fp_ring["all_gather"] == 1, fp_ring  # the hoisted counts only
+    assert fp_ring["ppermute"] == 4, fp_ring  # 2 branches x (k, v) x (rps-1)
+    assert fp_gather["all_gather"] == 5, fp_gather  # counts + 2 x (k, v)
+    assert fp_gather["ppermute"] == 0, fp_gather
+
+
+@pytest.mark.slow
+def test_ring_ragged_grads_match_single_device(rng):
+    """Slow sibling: gradients through the ragged ring (custom VJP with
+    per-origin-rank chunk masking) match the single-device op 1e-4."""
+    from jax.sharding import Mesh
+
+    from gigapath_tpu.ops.pallas_dilated import PipelineFlags
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("seq",))
+    B, N, valid = 1, 32, 25
+    q, k, v = _qkv3(rng, B, N, 4, 8)
+    sls, drs = [8, 32], [1, 2]
+    pad = jnp.arange(N)[None, :] >= valid
+    vmask = (~pad).astype(jnp.float32)[:, :, None, None]
+    vl_full = jnp.full((B,), valid, jnp.int32)
+    ring_fn = _ragged_seq_parallel_fn(
+        mesh, 2, sls, drs, PipelineFlags(ring_attn=True)
+    )
+
+    def single_loss(q, k, v):
+        o = dilated_attention(q, k, v, sls, drs, valid_len=vl_full)
+        return ((o.astype(jnp.float32) * vmask) ** 2).sum()
+
+    def ring_loss(q, k, v):
+        return ((ring_fn(q, k, v, pad).astype(jnp.float32) * vmask) ** 2).sum()
+
+    g_single = jax.grad(single_loss, argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_single, g_ring):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=1e-4, rtol=1e-4
+        )
+
+
+def test_ring_jaxpr_no_kv_all_gather(rng):
+    """Acceptance fingerprint (trace-only, 8-way): the non-ragged ring
+    program contains ZERO all_gather — K/V move exclusively by ppermute,
+    one rotation per non-resident chunk per array, sub-ring-sized for the
+    subset segment — while the gather path still all-gathers K and V per
+    gathered branch. Grad traces: the ring VJP adds the reverse ring's
+    permutes, still zero all_gather."""
+    from jax.sharding import Mesh
+
+    from gigapath_tpu.obs import jaxpr_fingerprint
+    from gigapath_tpu.ops.pallas_dilated import PipelineFlags
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("seq",))
+    q, k, v = _qkv3(rng, 1, 32, 4, 8)
+    sls, drs = [4, 16, 32], [1, 2, 4]  # rps 4 (sub-mesh) and 8 (full)
+
+    def fp(flags, grad=False):
+        fn = _seq_parallel_fn(mesh, 8, sls, drs, flags)
+
+        def loss(q, k, v):
+            return (fn(q, k, v).astype(jnp.float32) ** 2).sum()
+
+        return jaxpr_fingerprint(
+            jax.grad(loss, argnums=(0, 1, 2)) if grad else fn, q, k, v
+        )["primitives"]
+
+    ring = fp(PipelineFlags(ring_attn=True))
+    gather = fp(PipelineFlags())
+    assert ring["all_gather"] == 0, ring
+    # (rps-1) x (k, v) per gathered branch: (4-1)*2 + (8-1)*2
+    assert ring["ppermute"] == 20, ring
+    assert gather["all_gather"] == 4, gather  # 2 branches x (k, v)
+    assert gather["ppermute"] == 0, gather
+
+    ring_g = fp(PipelineFlags(ring_attn=True), grad=True)
+    assert ring_g["all_gather"] == 0, ring_g
+    assert ring_g["ppermute"] > ring["ppermute"], ring_g
+
+
+def test_ring_env_flag_snapshot_routes(rng, monkeypatch):
+    """GIGAPATH_RING_ATTN rides the PipelineFlags snapshot into the ring
+    dispatch (trace-only: the spy fires at trace time, no mesh compile)."""
+    from jax.sharding import Mesh
+
+    from gigapath_tpu.ops import dilated_attention as da
+    from gigapath_tpu.ops.pallas_dilated import PipelineFlags
+
+    calls = []
+    real = da._ring_attention
+
+    def spy(qs, ks, vs, counts, *static):
+        calls.append(static)
+        return real(qs, ks, vs, counts, *static)
+
+    monkeypatch.setattr(da, "_ring_attention", spy)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("seq",))
+    q, k, v = _qkv3(rng, 1, 16, 4, 8)
+    fn = _seq_parallel_fn(mesh, 2, [16], [2], None)  # env-snapshot path
+
+    monkeypatch.setenv("GIGAPATH_RING_ATTN", "1")
+    jax.make_jaxpr(fn)(q, k, v)
+    assert calls, "flagged trace must route through the ring op"
+
+    calls.clear()
+    monkeypatch.setenv("GIGAPATH_RING_ATTN", "0")
+    jax.make_jaxpr(fn)(q, k, v)
+    assert not calls, "unflagged trace must keep the all-gather path"
+
+
+def test_ring_flag_keys_do_not_alias(rng):
+    """Zero-retrace contract: ring on/off are DISTINCT PipelineFlags
+    static keys — two jit cache entries, no silent aliasing of a trace
+    made under the other flag value."""
+    import functools
+
+    from jax.sharding import Mesh
+
+    from gigapath_tpu.ops.pallas_dilated import PipelineFlags
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("seq",))
+    q, k, v = _qkv3(rng, 1, 8, 2, 4)
+    sls, drs = [8], [1]  # one gathered branch, the tiniest ring
+
+    @functools.partial(jax.jit, static_argnums=(3,))
+    def f(q, k, v, flags):
+        return _seq_parallel_fn(mesh, 2, sls, drs, flags)(q, k, v)
+
+    a = f(q, k, v, PipelineFlags(ring_attn=True))
+    b = f(q, k, v, PipelineFlags())
+    assert f._cache_size() == 2, (
+        "ring on/off must trace under distinct cache keys"
+    )
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_ring_causal_falls_back_to_gather(rng):
+    """A causal gathered branch under the ring flag silently (one
+    warning) keeps the all-gather path and stays correct vs the
+    single-device causal op."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from gigapath_tpu.ops.pallas_dilated import PipelineFlags
+
+    shard_map, check_kw = _shard_map_compat()
+    mesh = Mesh(np.array(jax.devices()[:2]), ("seq",))
+    q, k, v = _qkv3(rng, 1, 16, 4, 8)
+    sls, drs = [16], [2]
+
+    ref = dilated_attention(q, k, v, sls, drs, is_causal=True)
+    fn = shard_map(
+        lambda q, k, v: dilated_attention(
+            q, k, v, sls, drs, is_causal=True, seq_axis_name="seq",
+            seq_axis_size=2, flags=PipelineFlags(ring_attn=True),
+        ),
+        mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"), **check_kw,
+    )
+    np.testing.assert_allclose(
+        np.asarray(fn(q, k, v)), np.asarray(ref), atol=1e-5
+    )
+
+
+def test_combine_partials_matches_joint_softmax(rng):
+    """The stored-LSE merge primitive: attending two disjoint key sets
+    separately and combining == attending their concatenation."""
+    from gigapath_tpu.ops.flash_attention import (
+        combine_partials,
+        partial_attention,
+    )
+
+    B, Lq, Lk, H, D = 2, 8, 12, 3, 4
+    q = jnp.asarray(rng.normal(size=(B, Lq, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, 2 * Lk, H, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, 2 * Lk, H, D)), jnp.float32)
+    o_full, l_full = attention_with_lse(q, k, v)
+    o_a, l_a = partial_attention(q, k[:, :Lk], v[:, :Lk])
+    o_b, l_b = partial_attention(q, k[:, Lk:], v[:, Lk:])
+    o_c, l_c = combine_partials(o_a.astype(jnp.float32), l_a, o_b, l_b)
+    np.testing.assert_allclose(np.asarray(o_c), np.asarray(o_full), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(l_c), np.asarray(l_full), atol=1e-5)

@@ -50,7 +50,7 @@ def test_fixture_tree_fires_every_rule_class():
     assert result.exit_code != 0
     fired = {f.rule for f in result.findings}
     expected = {"GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-                "GL007", "GL008"}
+                "GL007", "GL008", "GL009"}
     assert fired >= expected, (
         f"missing rule classes: {sorted(expected - fired)}"
     )
@@ -89,6 +89,9 @@ def test_fixture_specific_findings():
         ("GL008", "timing.py", "timed_wrapped_no_fence"),
         # span(fence=None) is explicitly unfenced: no fence credit
         ("GL008", "timing.py", "timed_span_fence_none"),
+        # seq-parallel collective without a _SEQ_COLLECTIVES entry (the
+        # sanctioned twin in sanctioned_ring.py is the negative control)
+        ("GL009", "ring.py", "ring_exchange_unregistered"),
     }
     assert expected <= got, f"missing: {sorted(expected - got)}"
 

@@ -1,0 +1,15 @@
+"""Seeded GL009 violation (never imported — parsed only).
+
+This module issues a seq-axis collective by hand but has NO entry in the
+fixture sharding rules' ``_SEQ_COLLECTIVES`` registry
+(``../parallel/sharding.py``) — the exact unrecorded-layout-decision
+class GL009 exists to catch. The sanctioned twin lives in
+``sanctioned_ring.py``.
+"""
+
+import jax
+
+
+def ring_exchange_unregistered(x):
+    # GL009: ppermute in library code, module absent from _SEQ_COLLECTIVES
+    return jax.lax.ppermute(x, "seq", [(0, 1), (1, 0)])

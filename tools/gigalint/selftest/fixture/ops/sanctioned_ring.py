@@ -1,0 +1,13 @@
+"""GL009 negative control (never imported — parsed only).
+
+Same collectives as ``ring.py``, but this module IS registered in the
+fixture sharding rules' ``_SEQ_COLLECTIVES`` (suffix key
+``ops/sanctioned_ring.py``) — no finding may fire here.
+"""
+
+import jax
+
+
+def negative_control_sanctioned_ring(x):
+    y = jax.lax.all_gather(x, "seq", axis=0)
+    return jax.lax.ppermute(y, "seq", [(0, 1), (1, 0)])

@@ -1,4 +1,10 @@
-"""Fixture sharding rules: 'uncovered_proj' is deliberately absent."""
+"""Fixture sharding rules: 'uncovered_proj' is deliberately absent, and
+the ``_SEQ_COLLECTIVES`` registry covers only ``ops/sanctioned_ring.py``
+— ``ops/ring.py``'s ppermute is the seeded GL009 violation."""
 
 _COLUMN_PARALLEL = ("fc1",)
 _ROW_PARALLEL = ("fc2",)
+
+_SEQ_COLLECTIVES = {
+    "ops/sanctioned_ring.py": ("ppermute", "all_gather"),
+}

@@ -195,3 +195,117 @@ def check_sharding_coverage(project: Project) -> List[Finding]:
             "can never be matched by the name-based sharding rules",
         ))
     return findings
+
+
+# ---------------------------------------------------------------------------
+# GL009 — seq-parallel collective coverage
+# ---------------------------------------------------------------------------
+
+# Hand-issued collectives the registry must sanction. all_to_all (MoE
+# expert dispatch) and psum/pmean (loss/metric reductions) are out of
+# scope: the rule targets the SEQUENCE-axis data movement of the
+# gathered/ring attention paths, where an unregistered collective means
+# an undocumented sharding decision.
+_GL009_COLLECTIVES = frozenset({"ppermute", "all_gather"})
+_GL009_EXEMPT_SEGMENTS = frozenset({"scripts", "tests", "demo"})
+
+
+def _collective_registry(project: Project) -> Tuple[Optional[str], Dict[str, Set[str]]]:
+    """(registry file path, {module-path suffix: sanctioned names})
+    parsed from a ``_SEQ_COLLECTIVES`` dict literal in the sharding-rules
+    file (same discovery idiom as :func:`_sharding_lists`)."""
+    for mod in project.modules.values():
+        for node in ast.walk(mod.tree):
+            # plain assignment or the annotated form
+            # (``_SEQ_COLLECTIVES: Dict[str, tuple] = {...}``)
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+            elif isinstance(node, ast.AnnAssign):
+                tgt = node.target
+            else:
+                continue
+            if not (
+                isinstance(tgt, ast.Name)
+                and tgt.id in ("_SEQ_COLLECTIVES", "SEQ_COLLECTIVES")
+                and isinstance(node.value, ast.Dict)
+            ):
+                continue
+            registry: Dict[str, Set[str]] = {}
+            for key, val in zip(node.value.keys, node.value.values):
+                if not (
+                    isinstance(key, ast.Constant)
+                    and isinstance(key.value, str)
+                ):
+                    continue
+                names = str_tuple_literal(val)
+                if names is not None:
+                    registry[key.value] = set(names)
+            return mod.path, registry
+    return None, {}
+
+
+def _registry_names_for(registry: Dict[str, Set[str]], mod_path: str) -> Set[str]:
+    """Union of sanctioned collective names whose key matches the module
+    (exact path or '/'-boundary suffix, so fixture trees can register
+    their own files with tree-relative keys)."""
+    out: Set[str] = set()
+    for suffix, names in registry.items():
+        if mod_path == suffix or mod_path.endswith("/" + suffix):
+            out |= names
+    return out
+
+
+@register(
+    "GL009",
+    "hand-issued seq-parallel collective (ppermute/all_gather) in library "
+    "code without a matching entry in the sharding rules' _SEQ_COLLECTIVES "
+    "registry — axis communication must be a recorded layout decision",
+)
+def check_collective_coverage(project: Project) -> List[Finding]:
+    reg_path, registry = _collective_registry(project)
+    findings: List[Finding] = []
+    for mod in project.modules.values():
+        segments = mod.path.split("/")[:-1]
+        if mod.is_test_file or any(
+            s in _GL009_EXEMPT_SEGMENTS for s in segments
+        ):
+            continue
+        sanctioned = _registry_names_for(registry, mod.path)
+        # innermost enclosing function, for the finding symbol (same
+        # resolution GL007 uses)
+        spans = sorted(
+            (
+                (fn.lineno, getattr(fn.node, "end_lineno", fn.lineno), fn)
+                for fn in mod.functions.values()
+            ),
+            key=lambda t: t[1] - t[0],
+        )
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if not name:
+                continue
+            coll = last_segment(name)
+            if coll not in _GL009_COLLECTIVES:
+                continue
+            if coll in sanctioned:
+                continue
+            symbol = "<module>"
+            for lo, hi, fn in spans:
+                if lo <= node.lineno <= hi:
+                    symbol = fn.qualname
+                    break
+            where = (
+                f"the _SEQ_COLLECTIVES registry in {reg_path}"
+                if reg_path
+                else "any _SEQ_COLLECTIVES registry (none found in the "
+                "scanned sharding rules)"
+            )
+            findings.append(Finding(
+                "GL009", mod.path, node.lineno, symbol,
+                f"jax.lax.{coll} in library code without a matching entry "
+                f"in {where}: register the module and the collective (what "
+                "crosses the seq axis, and why) next to the sharding rules",
+            ))
+    return findings
