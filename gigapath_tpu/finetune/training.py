@@ -216,10 +216,26 @@ def train(dataloader, fold: int, args):
     ledger = get_ledger(runlog)
     compile_log = CompileWatchdog("train_step", runlog, fn=train_step,
                                   ledger=ledger)
+    # deadline precedence: an explicit args attribute (programmatic
+    # callers) wins; else the env knobs (GIGAPATH_OBS_HEARTBEAT_S /
+    # GIGAPATH_OBS_STALL_S); else finetune's historical 60/600 — a PANDA
+    # fold's biggest bucket legitimately takes minutes per step, so the
+    # generic 300 s deadline would call healthy steps stalls (and now:
+    # anomalies)
+    from gigapath_tpu.obs.heartbeat import env_seconds
+
+    hb_interval = getattr(args, "obs_heartbeat_s", None)
+    hb_stall = getattr(args, "obs_stall_s", None)
     heartbeat = Heartbeat(
         runlog,
-        interval_s=float(getattr(args, "obs_heartbeat_s", 60.0)),
-        stall_after_s=float(getattr(args, "obs_stall_s", 600.0)),
+        interval_s=(
+            float(hb_interval) if hb_interval is not None
+            else env_seconds("GIGAPATH_OBS_HEARTBEAT_S", 60.0)
+        ),
+        stall_after_s=(
+            float(hb_stall) if hb_stall is not None
+            else env_seconds("GIGAPATH_OBS_STALL_S", 600.0)
+        ),
         name="finetune",
     )
     try:

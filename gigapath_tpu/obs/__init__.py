@@ -13,12 +13,28 @@
   ``scripts/ledger_diff.py`` diffs across commits;
 - :mod:`gigapath_tpu.obs.spans` — nestable ``span`` context manager
   (monotonic wall time, optional device fence, per-host rank tag) plus
-  the ``jax.profiler`` trace/annotate passthroughs.
+  the ``jax.profiler`` trace/annotate passthroughs (the GL010-sanctioned
+  ``start_trace``/``stop_trace`` entry points live here);
+- :mod:`gigapath_tpu.obs.anomaly` — the closed loop: an ``AnomalyEngine``
+  taps the event stream, fires detectors (step-time spike, stall,
+  unexpected retrace, memory-watermark growth, throughput dip), and
+  reacts — ``anomaly`` events, flight-recorder dumps
+  (:mod:`gigapath_tpu.obs.flight`), budgeted profiler captures;
+- :mod:`gigapath_tpu.obs.history` — the cross-run perf-history surface:
+  fold BENCH/MULTICHIP snapshots and per-run ledgers into one
+  append-only trend file that ``scripts/perf_history.py`` gates on.
 
 Fold a run's JSONL into a human report with ``scripts/obs_report.py``.
 """
 
-from gigapath_tpu.obs.heartbeat import Heartbeat
+from gigapath_tpu.obs.anomaly import (
+    AnomalyConfig,
+    AnomalyEngine,
+    NullAnomalyEngine,
+    attach_anomaly_engine,
+)
+from gigapath_tpu.obs.flight import FlightRecorder
+from gigapath_tpu.obs.heartbeat import Heartbeat, memory_watermarks
 from gigapath_tpu.obs.ledger import (
     LEDGER_SCHEMA_VERSION,
     NullLedger,
@@ -35,26 +51,41 @@ from gigapath_tpu.obs.runlog import (
     console,
     get_run_log,
 )
-from gigapath_tpu.obs.spans import Span, annotate, span, trace
+from gigapath_tpu.obs.spans import (
+    Span,
+    annotate,
+    span,
+    start_trace,
+    stop_trace,
+    trace,
+)
 from gigapath_tpu.obs.watchdog import CompileWatchdog
 
 __all__ = [
     "EVENT_KINDS",
     "LEDGER_SCHEMA_VERSION",
     "SCHEMA_VERSION",
+    "AnomalyConfig",
+    "AnomalyEngine",
     "CompileWatchdog",
+    "FlightRecorder",
     "Heartbeat",
+    "NullAnomalyEngine",
     "NullLedger",
     "NullRunLog",
     "PerfLedger",
     "RunLog",
     "Span",
     "annotate",
+    "attach_anomaly_engine",
     "capture_profile",
     "console",
     "get_ledger",
     "get_run_log",
     "jaxpr_fingerprint",
+    "memory_watermarks",
     "span",
+    "start_trace",
+    "stop_trace",
     "trace",
 ]

@@ -1,0 +1,513 @@
+"""Anomaly engine: the closed loop on top of the obs bus.
+
+The bus (``runlog``/``watchdog``/``heartbeat``/``ledger``) *records*;
+nothing in the stack *reacts* — a stall, a retrace storm, a step-time
+spike or a creeping device-memory watermark is JSONL that a human finds
+later with ``scripts/obs_report.py``. This module closes the loop: an
+:class:`AnomalyEngine` taps the run's event stream (a ``RunLog``
+observer — the same hooks that feed the report), maintains rolling
+statistics, and when a detector fires it
+
+1. emits a schema'd ``anomaly`` event (detector, value, baseline,
+   threshold, step) into the run JSONL,
+2. dumps the flight recorder (:mod:`gigapath_tpu.obs.flight`) — the last
+   N events of context land in ``flight-<run-id>.jsonl`` even when the
+   main stream went to a tmpdir nobody kept, and
+3. arms a profiler capture: the next K ``step`` events run inside a
+   ``jax.profiler`` trace (via the sanctioned
+   :func:`gigapath_tpu.obs.spans.start_trace`/``stop_trace`` — gigalint
+   GL010) written under ``<obs dir>/traces/``, subject to a per-run
+   capture budget so a flapping detector cannot fill a disk.
+
+Detectors (all host-side, all fed by events the drivers already emit —
+the traced programs are untouched, so the engine can add no retraces):
+
+- ``step_time_spike`` — a synced step's ``wall_s`` exceeds
+  ``spike_factor ×`` the EWMA of synced step walls (and the rolling
+  p95), after warmup. Baselines are keyed per collate ``bucket`` where
+  the driver tags one (finetune's bucketed steps legitimately differ by
+  orders of magnitude across buckets), and a step that paid an observed
+  XLA ``compile`` event is exempt — and kept out of the baselines;
+- ``throughput_dip``  — two consecutive step-event arrival gaps exceed
+  ``dip_factor ×`` the run's baseline gap (median of the warmup window)
+  and the absolute ``dip_min_gap_s`` floor — the "everything is slower
+  now" signal a per-step spike threshold misses, with one legitimate
+  pause (an eval epoch) unable to fire it;
+- ``stall``           — the heartbeat monitor's ``stall`` event
+  (no re-detection: one deadline, one owner);
+- ``unexpected_retrace`` — a ``compile`` event flagged ``unexpected``
+  by the watchdog;
+- ``memory_watermark``   — ``mem_peak_bytes`` (carried by heartbeat
+  events when ``device.memory_stats()`` exists — absent on CPU) grows
+  past ``watermark_factor ×`` its first-seen baseline by at least
+  ``watermark_min_delta`` bytes; the baseline re-arms at the fired
+  level so sustained growth keeps firing, a plateau does not.
+
+``error`` events trigger a flight dump (context for the post-mortem)
+without counting as an anomaly. Per-detector cooldowns (in step events)
+keep a bad regime from emitting one anomaly per step.
+
+Construction: :func:`attach_anomaly_engine` is called by
+``get_run_log`` for every recording run — the env gates
+(``GIGAPATH_ANOMALY``, ``GIGAPATH_PROFILE``, ``GIGAPATH_PROFILE_BUDGET``)
+are read there once, host-side, at driver start (GL001-clean). Against
+a ``NullRunLog`` nothing is constructed: obs off means no engine, no
+flight file, no trace dirs — byte-for-byte the bare run.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import os
+import threading
+from typing import Deque, Dict, Optional
+
+from gigapath_tpu.obs.flight import FlightRecorder, register_signal_dump
+
+DETECTORS = (
+    "step_time_spike", "throughput_dip", "stall", "unexpected_retrace",
+    "memory_watermark",
+)
+
+
+@dataclasses.dataclass
+class AnomalyConfig:
+    """Detector thresholds + reaction budgets (one snapshot per run)."""
+
+    warmup_steps: int = 8          # step events before detectors arm
+    ewma_alpha: float = 0.2        # weight of the newest observation
+    window: int = 64               # rolling window for the p95
+    spike_factor: float = 3.0      # synced wall_s vs EWMA
+    dip_factor: float = 3.0        # step arrival gap vs baseline gap
+    dip_min_gap_s: float = 0.05    # gaps below this never count as a dip
+    #   (sub-ms event streams jitter past any ratio threshold; a real
+    #   training/serving step that matters is never that fast)
+    watermark_factor: float = 1.5  # mem_peak_bytes vs first-seen
+    watermark_min_delta: float = float(1 << 26)  # ... and ≥ 64 MiB absolute
+    cooldown_steps: int = 16       # step events between same-detector fires
+    capture_steps: int = 4         # K: steps per triggered profiler capture
+    capture_budget: int = 2        # captures per run (0 disables capture)
+    profile_first: int = 0         # GIGAPATH_PROFILE=N: capture steps 1..N
+    flight_capacity: int = 512
+    flight_max_dumps: int = 8
+
+
+class NullAnomalyEngine:
+    """Obs-off twin: absorbs every call, owns nothing."""
+
+    flight = None
+    anomalies: tuple = ()
+    trace_dirs: tuple = ()
+
+    def on_event(self, record: dict) -> None:
+        return None
+
+    def close(self) -> None:
+        return None
+
+
+class AnomalyEngine(NullAnomalyEngine):
+    def __init__(self, runlog, config: Optional[AnomalyConfig] = None,
+                 flight: Optional[FlightRecorder] = None):
+        self.runlog = runlog
+        self.cfg = config or AnomalyConfig()
+        self.flight = flight
+        self.anomalies: list = []      # emitted anomaly records
+        self.trace_dirs: list = []     # profiler capture directories
+        self._lock = threading.RLock()  # re-entrant: firing emits events
+        # rolling state
+        self._step_events = 0
+        self._last_step: Optional[int] = None
+        # synced-wall stats keyed by the step's collate bucket (finetune
+        # tags step events with one; "" = untagged/global): bucketed
+        # training legitimately runs order-of-magnitude different step
+        # walls per bucket, so one global EWMA would call every large
+        # bucket a spike
+        self._wall_stats: Dict[str, dict] = {}
+        self._compile_since_step = False
+        self._last_t: Optional[float] = None
+        self._gap_ewma: Optional[float] = None
+        self._baseline_gaps: list = []
+        self._baseline_gap: Optional[float] = None
+        self._dip_streak = 0
+        self._mem_baseline: Optional[float] = None
+        self._compile_seconds = 0.0
+        self._first_t: Optional[float] = None
+        self._last_event_t: Optional[float] = None
+        self._last_fired: Dict[str, int] = {}  # detector -> step-event count
+        # triggered profiler capture
+        self._capture_armed: Optional[str] = None  # reason
+        self._capture_dir: Optional[str] = None    # dir published at arm
+        self._capture_left = max(int(self.cfg.capture_budget), 0)
+        self._capture_seq = 0
+        self._trace_steps_left = 0
+        self._tracing = False
+        if self.cfg.profile_first > 0 and self._capture_left > 0:
+            self._capture_armed = "profile_flag"
+
+    # -- helpers ----------------------------------------------------------
+    def _obs_dir(self) -> str:
+        return os.path.dirname(os.path.abspath(self.runlog.path))
+
+    def _cooled(self, detector: str) -> bool:
+        last = self._last_fired.get(detector)
+        return last is None or (
+            self._step_events - last >= self.cfg.cooldown_steps
+        )
+
+    def _fire(self, detector: str, **info) -> bool:
+        """One detector verdict -> anomaly event + flight dump + armed
+        profiler capture. Caller holds the lock. Returns whether the
+        anomaly was actually emitted (False = cooldown suppressed it)."""
+        if not self._cooled(detector):
+            return False
+        self._last_fired[detector] = self._step_events
+        flight_path = None
+        if self.flight is not None:
+            flight_path = self.flight.dump(detector, step=self._last_step)
+        trace_dir = None
+        if self._capture_armed is None and self._capture_left > 0:
+            self._capture_armed = detector
+            trace_dir = self._capture_dir = self._next_trace_dir(detector)
+            # the advertised path must exist even if the run never lands
+            # another step (a hung run's stall capture never starts):
+            # an empty trace dir reads as "capture armed, no steps
+            # followed", a missing one as a report pointing into a void
+            try:
+                os.makedirs(trace_dir, exist_ok=True)
+            except OSError:
+                trace_dir = self._capture_dir = None
+                self._capture_armed = None
+        record = self.runlog.event(
+            "anomaly", detector=detector, step=self._last_step,
+            flight=flight_path, trace_dir=trace_dir,
+            compile_share=self.compile_share(), **info,
+        )
+        self.anomalies.append(record)
+        detail = ", ".join(
+            f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in sorted(info.items()) if v is not None
+        )
+        self.runlog.echo(
+            f"[anomaly] {detector} at step {self._last_step}: {detail}"
+            + (f"; flight -> {flight_path}" if flight_path else "")
+            + (f"; capturing next {self.cfg.capture_steps} steps -> "
+               f"{trace_dir}" if trace_dir else "")
+        )
+        return True
+
+    def compile_share(self) -> Optional[float]:
+        """Observed compile seconds over the run's event-time span so
+        far — the 'how much of this run went to XLA' context attached
+        to every anomaly event."""
+        if self._first_t is None or self._last_event_t is None:
+            return None
+        span = self._last_event_t - self._first_t
+        if span <= 0:
+            return None
+        return round(min(self._compile_seconds / span, 1.0), 4)
+
+    def _next_trace_dir(self, reason: str) -> str:
+        self._capture_seq += 1
+        # keyed by the run FILE's stem (carries the per-process suffix
+        # under a shared GIGAPATH_OBS_RUN_ID) so concurrent ranks never
+        # capture into one directory
+        stem = os.path.splitext(os.path.basename(self.runlog.path))[0]
+        return os.path.join(
+            self._obs_dir(), "traces",
+            f"{stem}-{reason}-{self._capture_seq}",
+        )
+
+    # -- profiler capture -------------------------------------------------
+    def begin_armed_capture(self) -> None:
+        """Start a capture armed before any step landed (the
+        ``GIGAPATH_PROFILE=N`` path). Called from ``attach`` on the
+        driver thread at driver start, so the trace covers steps 1..N —
+        including step 1's XLA compile, the most profile-worthy work of
+        the run — instead of starting one step late."""
+        with self._lock:
+            self._maybe_start_capture()
+
+    def _maybe_start_capture(self) -> None:
+        """Start/advance/stop the triggered capture. Runs on the thread
+        emitting ``step`` events (the driver loop), so start/stop always
+        happen on the thread that owns the device work."""
+        if self._tracing:
+            self._trace_steps_left -= 1
+            if self._trace_steps_left <= 0:
+                self._stop_capture()
+            return
+        if self._capture_armed is None or self._capture_left <= 0:
+            return
+        reason = self._capture_armed
+        if reason == "profile_flag":
+            steps = self.cfg.profile_first
+            trace_dir = self._next_trace_dir(reason)
+            self.runlog.echo(
+                f"[profile] GIGAPATH_PROFILE: capturing next {steps} "
+                f"step(s) -> {trace_dir}"
+            )
+        else:
+            steps = self.cfg.capture_steps
+            trace_dir = self._capture_dir  # published in the anomaly event
+            if trace_dir is None:
+                self._capture_armed = None
+                return
+        try:
+            from gigapath_tpu.obs.spans import start_trace
+
+            os.makedirs(trace_dir, exist_ok=True)
+            start_trace(trace_dir)
+        except Exception as e:  # capture must never take the run down
+            self.runlog.event("anomaly", detector="capture_error",
+                              error=f"{type(e).__name__}: {e}")
+            self._capture_armed = None
+            self._capture_dir = None
+            return
+        self._capture_left -= 1
+        self._capture_armed = None
+        self._capture_dir = None
+        self._tracing = True
+        self._trace_steps_left = max(int(steps), 1)
+        self.trace_dirs.append(trace_dir)
+
+    def _stop_capture(self) -> None:
+        if not self._tracing:
+            return
+        self._tracing = False
+        try:
+            from gigapath_tpu.obs.spans import stop_trace
+
+            stop_trace()
+        except Exception:
+            pass
+
+    # -- the observer -----------------------------------------------------
+    def on_event(self, record: dict) -> None:
+        kind = record.get("kind")
+        if kind == "anomaly":
+            return  # our own output: never detector input
+        with self._lock:
+            t = record.get("t")
+            if t is not None:
+                if self._first_t is None:
+                    self._first_t = float(t)
+                self._last_event_t = float(t)
+            if kind == "compile":
+                # the next step event's wall (and arrival gap) carries
+                # this compile — exempt it from spike/dip detection and
+                # keep it out of the baselines
+                self._compile_since_step = True
+                if record.get("seconds") is not None:
+                    self._compile_seconds += float(record["seconds"])
+            if kind == "stall":
+                self._fire(
+                    "stall",
+                    value=record.get("since_progress_s"),
+                    threshold=record.get("deadline_s"),
+                )
+            elif kind == "compile" and record.get("unexpected"):
+                self._fire(
+                    "unexpected_retrace",
+                    fn=record.get("fn"), key=record.get("key"),
+                    compile_count=record.get("count"),
+                )
+            elif kind == "error":
+                # context dump only — the error event is its own record
+                if self.flight is not None:
+                    self.flight.dump("error", where=record.get("where"))
+            elif kind == "run_end":
+                self._stop_capture()
+            elif kind == "step":
+                self._on_step(record)
+            if kind in ("step", "heartbeat"):
+                self._check_watermark(record)
+
+    def _on_step(self, record: dict) -> None:
+        cfg = self.cfg
+        self._step_events += 1
+        if record.get("step") is not None:
+            self._last_step = record["step"]
+        # a step that paid an observed XLA compile is not an anomaly and
+        # must not poison the baselines either (a new bucket's first
+        # synced step legitimately carries minutes of compile wall)
+        paid_compile = self._compile_since_step
+        self._compile_since_step = False
+
+        # throughput: arrival gaps between consecutive step events
+        t = record.get("t")
+        if t is not None:
+            if paid_compile:
+                self._last_t = t
+                self._dip_streak = 0
+            elif self._last_t is not None:
+                gap = max(float(t) - float(self._last_t), 1e-9)
+                if len(self._baseline_gaps) < cfg.warmup_steps:
+                    self._baseline_gaps.append(gap)
+                    if len(self._baseline_gaps) == cfg.warmup_steps:
+                        self._baseline_gap = sorted(self._baseline_gaps)[
+                            len(self._baseline_gaps) // 2
+                        ]
+                self._gap_ewma = (
+                    gap if self._gap_ewma is None
+                    else (1 - cfg.ewma_alpha) * self._gap_ewma
+                    + cfg.ewma_alpha * gap
+                )
+                if (
+                    self._baseline_gap is not None
+                    and gap > cfg.dip_factor * self._baseline_gap
+                    and gap >= cfg.dip_min_gap_s
+                ):
+                    # streak over RAW gaps, not the EWMA: one legitimate
+                    # pause (an eval epoch) inflates the EWMA for many
+                    # steps after, but only a genuinely slower regime
+                    # produces back-to-back slow gaps
+                    self._dip_streak += 1
+                    if self._dip_streak >= 2:
+                        self._fire(
+                            "throughput_dip",
+                            value=round(1.0 / self._gap_ewma, 6),
+                            baseline=round(1.0 / self._baseline_gap, 6),
+                            unit="steps/s",
+                            factor=round(
+                                self._gap_ewma / self._baseline_gap, 3
+                            ),
+                        )
+                else:
+                    self._dip_streak = 0
+            self._last_t = t
+
+        # step-time spike: synced walls only (unsynced walls are dispatch
+        # times under async dispatch — spiking on those would be noise),
+        # baselined per collate bucket where the driver tags one
+        wall = record.get("wall_s")
+        if record.get("synced") and wall is not None and not paid_compile:
+            wall = float(wall)
+            bucket = str(record.get("bucket", ""))
+            stats = self._wall_stats.setdefault(bucket, {
+                "walls": collections.deque(maxlen=cfg.window),
+                "ewma": None,
+            })
+            walls_seen: Deque[float] = stats["walls"]
+            ewma = stats["ewma"]
+            if (
+                ewma is not None
+                and len(walls_seen) >= min(
+                    cfg.warmup_steps, walls_seen.maxlen
+                )
+                and wall > cfg.spike_factor * max(ewma, 1e-9)
+            ):
+                walls = sorted(walls_seen)
+                p95 = walls[min(len(walls) - 1, int(0.95 * len(walls)))]
+                if wall > p95:
+                    info = dict(
+                        value=wall, baseline=round(ewma, 6),
+                        p95=round(p95, 6),
+                        factor=round(wall / max(ewma, 1e-9), 3),
+                    )
+                    if bucket:
+                        info["bucket"] = bucket
+                    self._fire("step_time_spike", **info)
+            walls_seen.append(wall)
+            stats["ewma"] = (
+                wall if ewma is None
+                else (1 - cfg.ewma_alpha) * ewma + cfg.ewma_alpha * wall
+            )
+
+        self._maybe_start_capture()
+
+    def _check_watermark(self, record: dict) -> None:
+        peak = record.get("mem_peak_bytes")
+        if peak is None:
+            return
+        peak = float(peak)
+        if self._mem_baseline is None:
+            self._mem_baseline = peak
+            return
+        grown = peak - self._mem_baseline
+        if (
+            peak > self.cfg.watermark_factor * self._mem_baseline
+            and grown >= self.cfg.watermark_min_delta
+        ):
+            fired = self._fire(
+                "memory_watermark",
+                value=peak, baseline=self._mem_baseline,
+                grown_bytes=grown,
+                factor=round(peak / max(self._mem_baseline, 1.0), 3),
+            )
+            # re-arm at the fired level: sustained growth keeps firing,
+            # a plateau does not. Only when the anomaly was actually
+            # emitted — a cooldown-suppressed fire must not silently
+            # swallow the growth forever
+            if fired:
+                self._mem_baseline = peak
+
+    def close(self) -> None:
+        with self._lock:
+            self._stop_capture()
+        if self.flight is not None:
+            from gigapath_tpu.obs.flight import unregister_signal_dump
+
+            unregister_signal_dump(self.flight)
+
+
+def _anomaly_enabled() -> bool:
+    """GIGAPATH_ANOMALY semantics (mirrors GIGAPATH_OBS): unset -> ON
+    when obs records; ''/'0'/'false'/'no' -> OFF."""
+    from gigapath_tpu.obs.runlog import env_on_by_default
+
+    return env_on_by_default("GIGAPATH_ANOMALY")
+
+
+def attach_anomaly_engine(runlog, config: Optional[AnomalyConfig] = None):
+    """Wire the closed loop onto a recording runlog: flight recorder +
+    engine subscribe to the event stream, the engine's close rides the
+    runlog's. With ``config=None`` (the ``get_run_log`` path) the env
+    gates — ``GIGAPATH_ANOMALY`` / ``GIGAPATH_PROFILE`` /
+    ``GIGAPATH_PROFILE_BUDGET`` — are read once, here (host-side,
+    driver start); an EXPLICIT config is an explicit opt-in and skips
+    the env gate (selftests and tests must work under
+    ``GIGAPATH_ANOMALY=0`` in the caller's environment).
+    Returns the engine (also reachable as ``runlog.anomaly``); a
+    :class:`NullAnomalyEngine` when obs (or, for the env-gated path,
+    the anomaly layer) is off."""
+    if getattr(runlog, "path", None) is None:
+        return NullAnomalyEngine()
+    if config is None and not _anomaly_enabled():
+        return NullAnomalyEngine()
+    existing = getattr(runlog, "anomaly", None)
+    if isinstance(existing, AnomalyEngine):
+        # one engine per run, however often attach runs — but silently
+        # discarding an EXPLICIT config would leave the caller running
+        # under thresholds/budgets they believe they replaced
+        if config is not None:
+            raise ValueError(
+                "runlog already has an anomaly engine attached; an "
+                "explicit config cannot replace it (construct the runlog "
+                "with GIGAPATH_ANOMALY=0 and attach manually instead)"
+            )
+        return existing
+    if config is None:
+        from gigapath_tpu.obs.runlog import env_number
+
+        config = AnomalyConfig()
+        config.profile_first = max(int(env_number("GIGAPATH_PROFILE", 0)), 0)
+        config.capture_budget = max(
+            int(env_number("GIGAPATH_PROFILE_BUDGET", config.capture_budget)),
+            0,
+        )
+    flight = FlightRecorder(
+        runlog, capacity=config.flight_capacity,
+        max_dumps=config.flight_max_dumps,
+    )
+    engine = AnomalyEngine(runlog, config=config, flight=flight)
+    runlog.add_observer(flight.on_event)
+    runlog.add_observer(engine.on_event)
+    runlog.add_closer(engine.close)
+    register_signal_dump(flight)
+    runlog.anomaly = engine
+    runlog.flight = flight
+    if config.profile_first > 0:
+        engine.begin_armed_capture()  # trace covers step 1's compile too
+    return engine

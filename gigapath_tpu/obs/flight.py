@@ -1,0 +1,182 @@
+"""Flight recorder: a bounded in-memory ring of recent obs events that
+dumps full context to disk the moment something goes wrong.
+
+The run JSONL (``runlog.py``) already streams every event — but only
+when ``GIGAPATH_OBS`` points somewhere durable and only what the driver
+chose to emit at full rate. The flight recorder is the post-mortem
+companion: it taps the same event stream into a ``deque`` of the last N
+records (steps, spans, compiles, heartbeats — the context *around* a
+failure) and, when triggered, appends a dump to
+``flight-<run-id>.jsonl`` next to the run file:
+
+- one ``flight_meta`` record per dump (reason, dump ordinal, buffered
+  event count), then
+- every buffered record not already covered by a previous dump (a
+  monotonic sequence number dedups consecutive dumps).
+
+Triggers (wired by :mod:`gigapath_tpu.obs.anomaly`): a firing anomaly
+detector, an ``error`` event, or a fatal signal (SIGTERM — the
+preempted-worker case; the handler chains to whatever was installed
+before). Dumps are budgeted (``max_dumps``) so a flapping trigger cannot
+fill a disk.
+
+``GIGAPATH_OBS=0`` / ``GIGAPATH_ANOMALY=0``: never constructed — no
+ring, no file, no signal handler.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import signal
+import threading
+import time
+from typing import Deque, Optional, Tuple
+
+
+class FlightRecorder:
+    """Ring buffer of obs records with budgeted append-only dumps."""
+
+    def __init__(self, runlog, *, capacity: int = 512, max_dumps: int = 8):
+        self.runlog = runlog
+        base = os.path.dirname(os.path.abspath(runlog.path))
+        # named after the run FILE, not the run id: under a shared
+        # GIGAPATH_OBS_RUN_ID every rank's run file carries a
+        # -<host>-p<pid> suffix precisely so per-process artifacts never
+        # collide — the flight file must inherit that, or two ranks
+        # interleave dumps into one corrupted post-mortem
+        stem = os.path.splitext(os.path.basename(runlog.path))[0]
+        self.path = os.path.join(base, f"flight-{stem}.jsonl")
+        self.capacity = int(capacity)
+        self.max_dumps = int(max_dumps)
+        self.dump_count = 0
+        self._buf: Deque[Tuple[int, dict]] = collections.deque(
+            maxlen=self.capacity
+        )
+        self._seq = 0
+        self._last_dumped_seq = 0
+        self._lock = threading.Lock()
+
+    # -- tap (registered as a RunLog observer) ----------------------------
+    def on_event(self, record: dict) -> None:
+        with self._lock:
+            self._seq += 1
+            self._buf.append((self._seq, record))
+
+    # -- dump -------------------------------------------------------------
+    def dump(self, reason: str, **meta) -> Optional[str]:
+        """Append the un-dumped tail of the ring (+ a ``flight_meta``
+        header) to the flight file. Returns the path, or None when the
+        dump budget is exhausted or there is nothing new to say."""
+        self._lock.acquire()
+        try:
+            return self._dump_locked(reason, meta)
+        finally:
+            self._lock.release()
+
+    def dump_from_signal(self, reason: str) -> Optional[str]:
+        """Signal-handler-safe dump: the handler runs ON the main thread,
+        which may be suspended INSIDE ``on_event`` holding the lock — a
+        blocking acquire would deadlock and make the process unkillable
+        by the very SIGTERM it is handling. Try briefly; losing the dump
+        beats hanging the shutdown."""
+        if not self._lock.acquire(timeout=1.0):
+            return None
+        try:
+            return self._dump_locked(reason, {})
+        finally:
+            self._lock.release()
+
+    def _dump_locked(self, reason: str, meta: dict) -> Optional[str]:
+        if self.dump_count >= self.max_dumps:
+            return None
+        pending = [
+            rec for seq, rec in self._buf if seq > self._last_dumped_seq
+        ]
+        if not pending and self.dump_count > 0:
+            return None  # a repeat trigger with zero new context
+        header = {
+            "kind": "flight_meta",
+            "run": self.runlog.run_id,
+            "t": round(time.time(), 6),
+            "reason": reason,
+            "dump": self.dump_count + 1,
+            "events": len(pending),
+            "ring_capacity": self.capacity,
+        }
+        header.update(meta)
+        # the write happens under the lock, and the budget/sequence
+        # bookkeeping commits only AFTER it succeeds: a transient
+        # write failure (full disk — exactly the degraded state
+        # post-mortems happen in) must not mark the context dumped
+        # or burn a budget slot
+        try:
+            with open(self.path, "a", encoding="utf-8") as fh:
+                fh.write(json.dumps(header) + "\n")
+                for rec in pending:
+                    fh.write(json.dumps(rec) + "\n")
+        except Exception:  # the dump must never take the run down
+            return None
+        self.dump_count += 1
+        self._last_dumped_seq = self._seq
+        return self.path
+
+
+# ---------------------------------------------------------------------------
+# fatal-signal dumps
+# ---------------------------------------------------------------------------
+
+# every live recorder gets a final dump on SIGTERM; the module-level set
+# (not a handler per recorder) keeps the process at ONE chained handler
+# no matter how many runs (finetune folds) a process opens
+_SIGNAL_FLIGHTS: list = []
+_PREV_SIGTERM = None
+_SIGNAL_INSTALLED = False
+_SIGNAL_LOCK = threading.Lock()
+
+
+def _on_sigterm(signum, frame):
+    for flight in list(_SIGNAL_FLIGHTS):
+        try:
+            flight.dump_from_signal(f"signal-{signum}")
+        except Exception:
+            pass
+    prev = _PREV_SIGTERM
+    if callable(prev):
+        prev(signum, frame)
+    elif prev == signal.SIG_IGN:
+        return  # the process had explicitly ignored SIGTERM: keep that
+    else:
+        # SIG_DFL — or None, which signal.signal() returns when the
+        # prior disposition was installed outside Python (embedding
+        # host, C launcher): in both cases the default action must
+        # still happen, or this handler turns SIGTERM into a no-op and
+        # the supervisor escalates to SIGKILL (skipping every cleanup
+        # path this layer exists to protect)
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+
+def register_signal_dump(flight: FlightRecorder) -> bool:
+    """Arm a final flight dump on SIGTERM for ``flight``. Installs the
+    (single, chaining) handler on first use; only possible from the main
+    thread — elsewhere the registration is skipped, never fatal."""
+    global _PREV_SIGTERM, _SIGNAL_INSTALLED
+    with _SIGNAL_LOCK:
+        if not _SIGNAL_INSTALLED:
+            if threading.current_thread() is not threading.main_thread():
+                return False
+            try:
+                _PREV_SIGTERM = signal.signal(signal.SIGTERM, _on_sigterm)
+            except (ValueError, OSError):  # non-main interpreter contexts
+                return False
+            _SIGNAL_INSTALLED = True
+        _SIGNAL_FLIGHTS.append(flight)
+    return True
+
+
+def unregister_signal_dump(flight: FlightRecorder) -> None:
+    with _SIGNAL_LOCK:
+        if flight in _SIGNAL_FLIGHTS:
+            _SIGNAL_FLIGHTS.remove(flight)

@@ -24,15 +24,66 @@ recovered run can flag a second stall.
 
 from __future__ import annotations
 
+import os
+import sys
 import threading
 import time
-from typing import Optional
+from typing import Dict, Optional
+
+
+def env_seconds(name: str, default: float) -> float:
+    """Host-side env override for the heartbeat deadlines (read once, at
+    Heartbeat construction = driver start — never at trace time).
+    Public: drivers with their own historical defaults (finetune's
+    60/600) call this with those defaults instead of Heartbeat's."""
+    from gigapath_tpu.obs.runlog import env_number
+
+    return env_number(name, default)
+
+
+def memory_watermarks() -> Dict[str, float]:
+    """Device-memory watermarks via ``device.memory_stats()``, for the
+    heartbeat events the anomaly engine's watermark detector reads.
+
+    Guarded three ways (this runs on the heartbeat daemon thread):
+    jax must already be imported, ``memory_stats()`` may be None
+    (CPU backend reports none), and any backend error returns ``{}`` —
+    probing memory must never be the call that hangs a run (the
+    backend-init RPC this obs layer exists to survive is triggered by
+    the first ``jax.devices()``; by the time heartbeats carry a step,
+    the driver already initialized it).
+    """
+    if "jax" not in sys.modules:
+        return {}
+    try:
+        import jax
+
+        stats = [d.memory_stats() for d in jax.devices()]
+    except Exception:
+        return {}
+    peaks = [s.get("peak_bytes_in_use") for s in stats if s]
+    in_use = [s.get("bytes_in_use") for s in stats if s]
+    out: Dict[str, float] = {}
+    peaks = [p for p in peaks if p is not None]
+    in_use = [b for b in in_use if b is not None]
+    if peaks:
+        out["mem_peak_bytes"] = float(max(peaks))
+    if in_use:
+        out["mem_bytes_in_use"] = float(sum(in_use))
+    return out
 
 
 class Heartbeat:
-    def __init__(self, runlog, *, interval_s: float = 30.0,
-                 stall_after_s: float = 300.0, name: str = "train"):
+    def __init__(self, runlog, *, interval_s: Optional[float] = None,
+                 stall_after_s: Optional[float] = None, name: str = "train"):
         self.runlog = runlog
+        # env-tunable defaults so EVERY driver's deadlines can be bent
+        # without a CLI surface (a forced-stall repro, a tight CI run);
+        # explicit arguments win
+        if interval_s is None:
+            interval_s = env_seconds("GIGAPATH_OBS_HEARTBEAT_S", 30.0)
+        if stall_after_s is None:
+            stall_after_s = env_seconds("GIGAPATH_OBS_STALL_S", 300.0)
         self.interval_s = float(interval_s)
         self.stall_after_s = float(stall_after_s)
         self.name = name
@@ -105,7 +156,12 @@ class Heartbeat:
                     f"last step {step}"
                 )
             if now >= next_hb:
+                # watermarks only once the run has made step progress:
+                # before the first beat the backend may not be up, and
+                # jax.devices() from this daemon thread must never be
+                # the call that initializes (or hangs on) it
+                mem = memory_watermarks() if step is not None else {}
                 self.runlog.heartbeat(
-                    last_step=step, since_progress_s=round(since, 3)
+                    last_step=step, since_progress_s=round(since, 3), **mem
                 )
                 next_hb = now + self.interval_s

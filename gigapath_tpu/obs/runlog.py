@@ -28,6 +28,10 @@ Event kinds (schema v1, one JSON object per line, every record carries
 - ``heartbeat``  — periodic liveness from the background monitor;
 - ``stall``      — no progress within the deadline (the axon-tunnel-hang
   failure mode made visible);
+- ``anomaly``    — a detector of the anomaly engine fired
+  (:mod:`gigapath_tpu.obs.anomaly`): step-time spike, stall, unexpected
+  retrace, memory-watermark growth, throughput dip — with the reaction
+  taken (flight-dump path, scheduled profiler capture);
 - ``error``      — exception surfaced by a driver;
 - ``run_end``    — terminal status + summary payload.
 
@@ -51,7 +55,7 @@ SCHEMA_VERSION = 1
 
 EVENT_KINDS = (
     "run_start", "step", "compile", "compile_profile", "span", "eval",
-    "heartbeat", "stall", "error", "run_end",
+    "heartbeat", "stall", "anomaly", "error", "run_end",
 )
 
 
@@ -108,6 +112,13 @@ class NullRunLog:
     run_start = step = compile_event = eval_event = heartbeat = stall = \
         error = run_end = event
 
+    def add_observer(self, fn) -> None:
+        """No-op: the opt-out stream has no events to observe."""
+        return None
+
+    def add_closer(self, fn) -> None:
+        return None
+
     def close(self) -> None:
         return None
 
@@ -146,6 +157,23 @@ class RunLog(NullRunLog):
         self._fh = open(path, "a", encoding="utf-8")
         self._lock = threading.Lock()
         self._closed = False
+        self._observers: list = []
+        self._closers: list = []
+
+    # -- observers (the anomaly engine / flight recorder tap) ------------
+    def add_observer(self, fn) -> None:
+        """Subscribe ``fn(record)`` to every event written to this log.
+        Observers run on the EMITTING thread, outside the write lock (so
+        an observer may itself emit events — the anomaly engine does),
+        and must never raise into the driver: exceptions are contained.
+        """
+        self._observers.append(fn)
+
+    def add_closer(self, fn) -> None:
+        """Register a callback run once when the log closes (run_end or
+        explicit close) — the hook the anomaly engine uses to stop an
+        open profiler capture and detach cleanly."""
+        self._closers.append(fn)
 
     # -- core ------------------------------------------------------------
     def event(self, kind: str, **fields) -> Optional[Dict[str, Any]]:
@@ -162,9 +190,20 @@ class RunLog(NullRunLog):
                 return record
             self._fh.write(line + "\n")
             self._fh.flush()
+        for observer in list(self._observers):
+            try:
+                observer(record)
+            except Exception:  # observers must never take a run down
+                pass
         return record
 
     def close(self) -> None:
+        closers, self._closers = self._closers, []
+        for closer in closers:
+            try:
+                closer()
+            except Exception:  # closing obs must never take a run down
+                pass
         with self._lock:
             if not self._closed:
                 self._closed = True
@@ -246,15 +285,33 @@ def _default_run_id(driver: str) -> str:
     )
 
 
-def _obs_enabled() -> bool:
-    """GIGAPATH_OBS semantics: unset -> ON (telemetry is cheap); set to
+def env_number(name: str, default: float) -> float:
+    """The obs layer's one numeric-env parser (heartbeat deadlines,
+    profiler capture knobs): unset/blank/unparseable -> ``default``.
+    Host-side, read at driver start — never at trace time."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return float(default)
+    try:
+        return float(raw)
+    except ValueError:
+        return float(default)
+
+
+def env_on_by_default(name: str) -> bool:
+    """Shared truthiness for the obs layer's opt-OUT flags
+    (``GIGAPATH_OBS``, ``GIGAPATH_ANOMALY``): unset -> ON; set to
     ''/'0'/'false'/'no' -> OFF; anything else -> ON. Matches the repo's
     env_flag truthiness (ops/common.py) for set values, but defaults on
     because the artifact is the point of the subsystem."""
-    raw = os.environ.get("GIGAPATH_OBS")
+    raw = os.environ.get(name)
     if raw is None:
         return True
     return raw.strip().lower() not in ("", "0", "false", "no")
+
+
+def _obs_enabled() -> bool:
+    return env_on_by_default("GIGAPATH_OBS")
 
 
 def get_run_log(driver: str, out_dir: Optional[str] = None, *,
@@ -307,6 +364,20 @@ def get_run_log(driver: str, out_dir: Optional[str] = None, *,
     else:
         log = RunLog(path, driver=driver, run_id=shared_id, echo=echo,
                      echo_stream=echo_stream)
+    # the closed loop (anomaly engine + flight recorder + triggered
+    # profiler capture) rides the event stream of every recording run;
+    # its own env gates (GIGAPATH_ANOMALY / GIGAPATH_PROFILE) are read
+    # inside attach, here, once, at driver start — and the layer must
+    # never be the thing that takes a run down. Attached BEFORE the
+    # run_start below so the manifest (config, backend, device count)
+    # lands in the flight recorder's ring: a post-mortem dump without
+    # provenance is half a post-mortem
+    try:
+        from gigapath_tpu.obs.anomaly import attach_anomaly_engine
+
+        attach_anomaly_engine(log)
+    except Exception:
+        pass
     if run_start:
         log.run_start(config=config, probe_devices=probe_devices)
     return log

@@ -49,13 +49,30 @@ def trace(log_dir: str, *, create_perfetto_link: bool = False):
     >>> with trace("/tmp/profile"):
     ...     step(params, batch)  # compiled work is recorded
     """
-    import jax
-
-    jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
+    start_trace(log_dir, create_perfetto_link=create_perfetto_link)
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        stop_trace()
+
+
+def start_trace(log_dir: str, *, create_perfetto_link: bool = False) -> None:
+    """The sanctioned open-ended trace start (gigalint GL010: library
+    code reaches ``jax.profiler.start_trace``/``stop_trace`` only
+    through here). Prefer :func:`trace` when the region is a lexical
+    block; the anomaly engine's triggered capture is the open-ended
+    case — it starts on a firing detector and stops K step events later,
+    two different call sites."""
+    import jax
+
+    jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)  # gigalint: waive GL010 -- the one sanctioned passthrough
+
+
+def stop_trace() -> None:
+    """Close the trace opened by :func:`start_trace` (see GL010 note)."""
+    import jax
+
+    jax.profiler.stop_trace()  # gigalint: waive GL010 -- the one sanctioned passthrough
 
 
 def annotate(name: str):

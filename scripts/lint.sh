@@ -8,10 +8,14 @@
 # tests/test_gigalint.py enforces on every tier-1 run — honoring the
 # GIGALINT_WAIVERS file at the repo root. Also runs:
 #   - the obs selftest (scripts/obs_report.py --selftest): RunLog ->
-#     watchdog -> spans -> forced stall -> rendered report (incl. the
+#     watchdog -> spans -> forced stall -> anomaly engine (spike ->
+#     anomaly event + flight dump) -> rendered report (incl. the
 #     per-rank merge path), so a broken telemetry pipeline fails lint;
 #   - the ledger-diff selftest (scripts/ledger_diff.py --selftest): the
 #     perf regression verdict must flip on injected regressions;
+#   - the perf-history selftest (scripts/perf_history.py --selftest):
+#     the cross-round trend gate must flip on throughput dips, memory
+#     growth and lost donations, and stay blind to stale rounds;
 #   - the gigalint GL008 selftest: the seeded timing-hygiene fixture
 #     must fire (and only on the seeded violations — the negative
 #     controls are covered by tests/test_gigalint.py).
@@ -19,6 +23,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 python scripts/obs_report.py --selftest 1>&2
 python scripts/ledger_diff.py --selftest 1>&2
+python scripts/perf_history.py --selftest 1>&2
 
 # GL008 selftest: the seeded fixture violations MUST be found (exit 1 =
 # findings; 0 or 2 mean the rule went blind or crashed)

@@ -11,8 +11,13 @@ Sections: run manifest, throughput (steps/s + step-wall percentiles,
 synced vs unsynced), compile (total seconds, share of wall, per-key
 retrace table with unexpected retraces flagged), spans (per-name
 durations; with multi-host input a per-rank skew/straggler table —
-max/median step span per rank, worst rank called out), eval history,
-timeline (heartbeats, stalls, silent gaps between consecutive events).
+max/median step span per rank, worst rank called out), anomalies (per
+detector, with the reactions taken — flight-dump path, profiler trace
+dir), eval history, timeline (heartbeats, stalls, silent gaps between
+consecutive events). Passing a flight recorder dump
+(``flight-<run-id>.jsonl``) renders a flight-dumps summary (reason,
+dump ordinal, buffered-context size) above the usual sections folded
+from the dumped context events.
 
 Multi-host runs: launch with ``GIGAPATH_OBS_RUN_ID`` pinned so every
 rank logs under ONE run id, hand all per-rank files to this script, and
@@ -214,6 +219,52 @@ def render(events: List[dict], out=None) -> int:
         _rank_table(by_name, w)
         w("\n")
 
+    # -- anomalies (the closed loop: gigapath_tpu.obs.anomaly) ------------
+    anomalies = by_kind.get("anomaly", [])
+    if anomalies:
+        w("== anomalies ==\n")
+        by_det: Dict[str, int] = {}
+        for ev in anomalies:
+            det = str(ev.get("detector", "?"))
+            by_det[det] = by_det.get(det, 0) + 1
+        w("anomalies: {} ({})\n".format(
+            len(anomalies),
+            ", ".join(f"{d} x{n}" for d, n in sorted(by_det.items())),
+        ))
+        for ev in anomalies:
+            bits = []
+            if ev.get("value") is not None:
+                bits.append(f"value {ev['value']}")
+            if ev.get("baseline") is not None:
+                bits.append(f"baseline {ev['baseline']}")
+            if ev.get("factor") is not None:
+                bits.append(f"x{ev['factor']}")
+            reactions = []
+            if ev.get("flight"):
+                reactions.append(f"flight -> {ev['flight']}")
+            if ev.get("trace_dir"):
+                reactions.append(f"trace -> {ev['trace_dir']}")
+            w(
+                f"  {str(ev.get('detector', '?')).upper()} at "
+                f"+{ev.get('t', 0.0) - t0:.1f}s step {ev.get('step')}: "
+                + (", ".join(bits) if bits else "(no measure)")
+                + (("; " + "; ".join(reactions)) if reactions else "")
+                + "\n"
+            )
+        w("\n")
+
+    # -- flight dumps (records only present in flight-*.jsonl files) ------
+    metas = by_kind.get("flight_meta", [])
+    if metas:
+        w("== flight dumps ==\n")
+        for ev in metas:
+            w(
+                f"  dump #{ev.get('dump')} reason={ev.get('reason')}: "
+                f"{ev.get('events')} buffered event(s) "
+                f"(ring capacity {ev.get('ring_capacity')})\n"
+            )
+        w("\n")
+
     # -- eval -------------------------------------------------------------
     evals = by_kind.get("eval", [])
     if evals:
@@ -256,8 +307,10 @@ def render(events: List[dict], out=None) -> int:
 
 
 def selftest() -> int:
-    """Synthesize a run (RunLog + watchdog + spans + a forced stall) in a
-    temp dir, render it, and assert every section materializes; then a
+    """Synthesize a run (RunLog + watchdog + spans + a forced stall +
+    the anomaly engine's closed loop) in a temp dir, render it, and
+    assert every section materializes — including ``== anomalies ==``
+    and the flight-dump summary rendered from the flight file; then a
     two-rank merge of one run id must render the per-rank skew table —
     the obs half of scripts/lint.sh."""
     import io
@@ -266,28 +319,45 @@ def selftest() -> int:
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from gigapath_tpu.obs import Heartbeat, RunLog, span
+    from gigapath_tpu.obs.anomaly import AnomalyConfig, attach_anomaly_engine
     from gigapath_tpu.obs.watchdog import CompileWatchdog
 
     with tempfile.TemporaryDirectory() as tmp:
         path = os.path.join(tmp, "run.jsonl")
         log = RunLog(path, driver="selftest", echo=False)
+        # closed loop armed, profiler capture disabled (a jax trace in a
+        # lint selftest would be weight, not signal)
+        engine = attach_anomaly_engine(
+            log, config=AnomalyConfig(capture_budget=0)
+        )
         log.run_start(config={"purpose": "obs selftest"}, probe_devices=False)
         wd = CompileWatchdog("selftest.step", log)
         for i in range(25):
             key = (1, 128 if i < 20 else 256)
             with span("step", log, bucket=str(key)):
                 wd.record(key, 0.5 if wd.is_new(key) else None)
-            log.step(i, wall_s=0.01 * (i + 1), synced=i % 5 == 0, loss=1.0 / (i + 1))
+            log.step(i, wall_s=0.01, synced=True, loss=1.0 / (i + 1))
+        log.step(25, wall_s=0.9, synced=True)  # spike vs the 0.01 EWMA
         log.eval_event(24, auroc=0.99)
         with Heartbeat(log, interval_s=0.05, stall_after_s=0.15,
                        name="selftest") as hb:
             hb.beat(24)
             _time.sleep(0.4)  # exceed the stall deadline -> stall event
         log.run_end(status="ok")
+        flight_path = engine.flight.path
 
         buf = io.StringIO()
         rc = render(load_events(path), out=buf)
         text = buf.getvalue()
+
+        # the flight file must exist (the spike dumped it) and render a
+        # flight-dumps summary on top of the dumped context
+        buf_fl = io.StringIO()
+        rc_fl = (
+            render(load_events(flight_path), out=buf_fl)
+            if os.path.exists(flight_path) else 2
+        )
+        text_fl = buf_fl.getvalue()
 
         # -- per-rank merge path: two files, ONE run id, rank 1 straggles
         paths = [os.path.join(tmp, f"mh-r{r}.jsonl") for r in (0, 1)]
@@ -306,16 +376,21 @@ def selftest() -> int:
         text2 = buf2.getvalue()
 
     required = ("== throughput ==", "== compile ==", "== timeline ==",
-                "retrace table", "STALL", "p50", "== spans ==")
+                "retrace table", "STALL", "p50", "== spans ==",
+                "== anomalies ==", "STEP_TIME_SPIKE", "flight ->")
     missing = [s for s in required if s not in text]
+    required_fl = ("== flight dumps ==", "reason=step_time_spike")
+    missing_fl = [s for s in required_fl if s not in text_fl]
     required_mh = ("per-rank skew (span 'step')", "rank 1:",
                    "straggler: rank 1")
     missing_mh = [s for s in required_mh if s not in text2]
-    if rc != 0 or missing or rc2 != 0 or missing_mh:
+    if rc != 0 or missing or rc_fl != 0 or missing_fl or rc2 != 0 or missing_mh:
         print(text)
+        print(text_fl)
         print(text2)
-        print(f"obs selftest FAILED: rc={rc}/{rc2}, missing sections: "
-              f"{missing}, missing rank sections: {missing_mh}",
+        print(f"obs selftest FAILED: rc={rc}/{rc_fl}/{rc2}, missing "
+              f"sections: {missing}, missing flight sections: {missing_fl}, "
+              f"missing rank sections: {missing_mh}",
               file=sys.stderr)
         return 1
     print("obs selftest OK")

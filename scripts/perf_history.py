@@ -1,0 +1,321 @@
+#!/usr/bin/env python
+"""Fold perf snapshots into PERF_HISTORY.json and gate on the trend.
+
+    python scripts/perf_history.py seed                       # r01..r05 + golden ledger -> PERF_HISTORY.json
+    python scripts/perf_history.py ingest --label r06 \
+        --bench BENCH_r06.json --multichip MULTICHIP_r06.json \
+        --ledger out/obs/run.ledger.json
+    python scripts/perf_history.py check [--json verdict.json] [--baseline prev]
+    python scripts/perf_history.py --selftest                 # run by scripts/lint.sh
+
+The history file (``PERF_HISTORY.json``, repo root, tracked) is
+append-only: each round's BENCH/MULTICHIP snapshots and any per-run
+ledgers land as labeled points keyed ``name|qualifier`` — the same key
+shape as the perf ledger. ``check`` renders a ``ledger_diff``-shaped
+decision table: the latest measured point per entry is judged against
+the best (default) or previous measured point per metric, with
+regression directions per metric class (throughput/MFU up-is-good,
+bytes/FLOPs/eqns down-is-good, a lost donation is a regression). Stale
+points (failed rounds, unmeasured values) keep their provenance but
+never move the trend. Improvements never fail.
+
+Pure stdlib (the folding logic lives in ``gigapath_tpu.obs.history``,
+itself jax-free). Exit 0 on ok, 1 on trend regressions, 2 on unreadable
+input / usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from gigapath_tpu.obs import history  # noqa: E402
+
+DEFAULT_HISTORY = os.path.join(REPO_ROOT, "PERF_HISTORY.json")
+GOLDEN_LEDGER = os.path.join(REPO_ROOT, "tests", "goldens",
+                             "LEDGER_flagship.json")
+
+
+def _load_json(path: str) -> dict:
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def _load_or_new(path: str) -> dict:
+    if os.path.exists(path):
+        return history.load_history(path)
+    return history.new_history()
+
+
+# ---------------------------------------------------------------------------
+# commands
+# ---------------------------------------------------------------------------
+
+def cmd_seed(args) -> int:
+    """Build the day-one history from every BENCH_r*/MULTICHIP_r*
+    snapshot in the repo root (plus the golden flagship ledger under the
+    newest round's label), so the trend gate never starts blind."""
+    doc = history.new_history() if args.force else _load_or_new(args.history)
+    rounds: List[str] = []
+    try:
+        for path in sorted(glob.glob(os.path.join(args.root, "BENCH_r*.json"))):
+            label = os.path.basename(path).replace("BENCH_", "").replace(".json", "")
+            rounds.append(label)
+            history.fold_bench(doc, _load_json(path), label,
+                               source=os.path.basename(path), force=args.force)
+        for path in sorted(glob.glob(os.path.join(args.root, "MULTICHIP_r*.json"))):
+            label = os.path.basename(path).replace("MULTICHIP_", "").replace(".json", "")
+            history.fold_multichip(doc, _load_json(path), label,
+                                   source=os.path.basename(path), force=args.force)
+        if rounds and os.path.exists(GOLDEN_LEDGER):
+            history.fold_ledger(
+                doc, _load_json(GOLDEN_LEDGER), max(rounds),
+                source=os.path.relpath(GOLDEN_LEDGER, REPO_ROOT),
+                force=args.force,
+            )
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: {e} (already seeded? --force rebuilds)",
+              file=sys.stderr)
+        return 2
+    history.write_history(doc, args.history)
+    n_points = sum(len(e["points"]) for e in doc["entries"].values())
+    print(f"perf_history: seeded {len(doc['entries'])} entries "
+          f"({n_points} points) -> {args.history}")
+    return 0
+
+
+def cmd_ingest(args) -> int:
+    try:
+        doc = _load_or_new(args.history)
+        if args.bench:
+            history.fold_bench(doc, _load_json(args.bench), args.label,
+                               source=os.path.basename(args.bench),
+                               force=args.force)
+        if args.multichip:
+            history.fold_multichip(doc, _load_json(args.multichip),
+                                   args.label,
+                                   source=os.path.basename(args.multichip),
+                                   force=args.force)
+        for path in args.ledger or []:
+            history.fold_ledger(doc, _load_json(path), args.label,
+                                source=os.path.basename(path),
+                                force=args.force)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    history.write_history(doc, args.history)
+    print(f"perf_history: ingested label '{args.label}' -> {args.history}")
+    return 0
+
+
+def render(verdict: dict, out=None) -> None:
+    out = out or sys.stdout
+    w = out.write
+    dec = verdict["decision"]
+    w(f"perf_history: {verdict['history_entries']} entries, "
+      f"baseline={verdict['thresholds']['baseline']} "
+      f"rel_tol={verdict['thresholds']['rel_tol']}, "
+      f"{dec['regressions']} regression(s), "
+      f"{dec['improvements']} improvement(s)\n")
+    for line in dec["regressed"]:
+        w(f"  REGRESSION {line}\n")
+    for line in dec["improved"]:
+        w(f"  improvement {line}\n")
+    for note in verdict.get("notes", []):
+        w(f"  note {note}\n")
+    w("verdict: " + ("OK\n" if dec["ok"] else "REGRESSED\n"))
+
+
+def cmd_check(args) -> int:
+    try:
+        doc = history.load_history(args.history)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    verdict = history.trend_verdict(doc, rel_tol=args.rel_tol,
+                                    baseline=args.baseline)
+    verdict["history"] = os.path.abspath(args.history)
+    render(verdict)
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(verdict, f, indent=1)
+            f.write("\n")
+    return 0 if verdict["decision"]["ok"] else 1
+
+
+# ---------------------------------------------------------------------------
+# selftest
+# ---------------------------------------------------------------------------
+
+def selftest() -> int:
+    """Synthesize a history, assert the trend gate flips both ways
+    (throughput dip = regression, memory growth = regression, stale
+    points invisible, improvements never fail) and that append-only
+    refuses label reuse — the history half of scripts/lint.sh."""
+    doc = history.new_history()
+    history.fold_bench(
+        doc, {"rc": 0, "parsed": {"metric": "m", "value": 100.0,
+                                  "mfu": 0.2, "peak_hbm_gb": 1.0}}, "r01")
+    history.fold_bench(
+        doc, {"rc": 0, "parsed": {"metric": "m", "value": 120.0,
+                                  "mfu": 0.25, "peak_hbm_gb": 1.0}}, "r02")
+    # a failed round must land stale and stay invisible to the gate
+    history.fold_bench(doc, {"rc": 1, "parsed": None}, "r03")
+    clean = history.trend_verdict(doc)
+    if not clean["decision"]["ok"] or clean["decision"]["regressions"]:
+        print("perf_history selftest FAILED: improving history not clean",
+              file=sys.stderr)
+        render(clean, out=sys.stderr)
+        return 1
+    lines = clean["decision"]["improved"] + clean["decision"]["regressed"]
+    if any("r03" in line for line in lines):
+        print("perf_history selftest FAILED: stale point moved the trend",
+              file=sys.stderr)
+        return 1
+
+    # a throughput dip + memory growth in a NEW measured round must flip
+    history.fold_bench(
+        doc, {"rc": 0, "parsed": {"metric": "m", "value": 80.0,
+                                  "mfu": 0.25, "peak_hbm_gb": 1.4}}, "r04")
+    bad = history.trend_verdict(doc)
+    dec = bad["decision"]
+    want = ["value 120.0", "peak_hbm_gb 1.0"]
+    missing = [w for w in want
+               if not any(w in line for line in dec["regressed"])]
+    if dec["ok"] or missing:
+        print(f"perf_history selftest FAILED: ok={dec['ok']}, "
+              f"undetected: {missing}", file=sys.stderr)
+        render(bad, out=sys.stderr)
+        return 1
+
+    # baseline=prev view: r04 vs r02 (r03 is stale) — same regressions
+    prev = history.trend_verdict(doc, baseline="prev")
+    if prev["decision"]["ok"]:
+        print("perf_history selftest FAILED: prev-baseline blind",
+              file=sys.stderr)
+        return 1
+
+    # ledger folding + eqn-count trend direction
+    ldoc = {"entries": {"step|f32[1,8]": {
+        "jaxpr": {"eqns_total": 100},
+        "cost": {"flops": 1e6, "bytes_accessed": 2e6},
+        "memory": {"peak_bytes": 3e6, "donated_bytes": 4096.0},
+    }}}
+    history.fold_ledger(doc, ldoc, "r05")
+    worse = {"entries": {"step|f32[1,8]": {
+        "jaxpr": {"eqns_total": 130},
+        "cost": {"flops": 1e6, "bytes_accessed": 2e6},
+        "memory": {"peak_bytes": 3e6, "donated_bytes": 0.0},
+    }}}
+    history.fold_ledger(doc, worse, "r06")
+    v = history.trend_verdict(doc)
+    for needle in ("jaxpr.eqns_total", "memory.donated_bytes"):
+        if not any(needle in line for line in v["decision"]["regressed"]):
+            print(f"perf_history selftest FAILED: {needle} regression "
+                  "undetected", file=sys.stderr)
+            return 1
+
+    # append-only: reusing a label without force must refuse
+    try:
+        history.fold_bench(
+            doc, {"rc": 0, "parsed": {"metric": "m", "value": 1.0}}, "r02")
+    except ValueError:
+        pass
+    else:
+        print("perf_history selftest FAILED: label reuse not refused",
+              file=sys.stderr)
+        return 1
+    # ... and force replaces IN PLACE: a re-measured OLD round must not
+    # become the trend gate's "latest" candidate
+    history.fold_bench(
+        doc, {"rc": 0, "parsed": {"metric": "m", "value": 119.0}}, "r02",
+        force=True)
+    labels = [p["label"] for p in doc["entries"]["bench|slide_embed"]["points"]]
+    if labels != ["r01", "r02", "r03", "r04"]:
+        print(f"perf_history selftest FAILED: force reordered points "
+              f"({labels})", file=sys.stderr)
+        return 1
+    v2 = history.trend_verdict(doc)
+    if v2["decision"]["ok"] or not any(
+        "(r04)" in line for line in v2["decision"]["regressed"]
+    ):
+        print("perf_history selftest FAILED: force-replacing an old round "
+              "masked the latest round's regression", file=sys.stderr)
+        return 1
+    # ... and round-trips through the canonical writer
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "PERF_HISTORY.json")
+        history.write_history(doc, path)
+        again = history.load_history(path)
+        if again["entries"].keys() != doc["entries"].keys():
+            print("perf_history selftest FAILED: write/load round-trip",
+                  file=sys.stderr)
+            return 1
+    print("perf_history selftest OK")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python scripts/perf_history.py",
+        description="Append-only perf history + trend regression gate",
+    )
+    ap.add_argument("--selftest", action="store_true",
+                    help="verify the trend gate on a synthetic history")
+    sub = ap.add_subparsers(dest="command")
+
+    p_seed = sub.add_parser("seed", help="build from BENCH_r*/MULTICHIP_r* "
+                            "snapshots (+ the golden ledger)")
+    p_seed.add_argument("--history", default=DEFAULT_HISTORY)
+    p_seed.add_argument("--root", default=REPO_ROOT,
+                        help="directory holding the round snapshots")
+    p_seed.add_argument("--force", action="store_true",
+                        help="rebuild from scratch, replacing the file")
+
+    p_ing = sub.add_parser("ingest", help="append one labeled round")
+    p_ing.add_argument("--history", default=DEFAULT_HISTORY)
+    p_ing.add_argument("--label", required=True,
+                       help="round label (e.g. r06) — append-only")
+    p_ing.add_argument("--bench", default=None, help="BENCH snapshot JSON")
+    p_ing.add_argument("--multichip", default=None,
+                       help="MULTICHIP snapshot JSON")
+    p_ing.add_argument("--ledger", action="append", default=None,
+                       help="per-run ledger JSON (repeatable)")
+    p_ing.add_argument("--force", action="store_true",
+                       help="replace an existing label (re-measured round)")
+
+    p_chk = sub.add_parser("check", help="trend regression gate")
+    p_chk.add_argument("--history", default=DEFAULT_HISTORY)
+    p_chk.add_argument("--rel-tol", type=float, default=0.05,
+                       help="relative tolerance per metric (default 0.05)")
+    p_chk.add_argument("--baseline", choices=("best", "prev"),
+                       default="best",
+                       help="judge the latest point against the best-ever "
+                       "(default) or the previous measured point")
+    p_chk.add_argument("--json", default="",
+                       help="also write the verdict JSON here")
+
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    if args.command == "seed":
+        return cmd_seed(args)
+    if args.command == "ingest":
+        return cmd_ingest(args)
+    if args.command == "check":
+        return cmd_check(args)
+    ap.error("provide a command (seed | ingest | check) or --selftest")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

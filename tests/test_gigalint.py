@@ -50,7 +50,7 @@ def test_fixture_tree_fires_every_rule_class():
     assert result.exit_code != 0
     fired = {f.rule for f in result.findings}
     expected = {"GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-                "GL007", "GL008", "GL009"}
+                "GL007", "GL008", "GL009", "GL010"}
     assert fired >= expected, (
         f"missing rule classes: {sorted(expected - fired)}"
     )
@@ -92,6 +92,9 @@ def test_fixture_specific_findings():
         # seq-parallel collective without a _SEQ_COLLECTIVES entry (the
         # sanctioned twin in sanctioned_ring.py is the negative control)
         ("GL009", "ring.py", "ring_exchange_unregistered"),
+        # open-ended jax.profiler pair outside obs/spans.py (the
+        # fixture's own obs/spans.py twin is the negative control)
+        ("GL010", "profiler.py", "trace_by_hand"),
     }
     assert expected <= got, f"missing: {sorted(expected - got)}"
 

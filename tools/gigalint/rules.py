@@ -488,6 +488,90 @@ def check_timing_hygiene(project: Project) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# GL010 — profiler trace hygiene
+# ---------------------------------------------------------------------------
+
+# jax.profiler's open-ended trace pair. The contextmanager form
+# (jax.profiler.trace) is lexically scoped and self-closing; the
+# start/stop pair is the dangerous one: a start without a guaranteed
+# stop leaks an open trace across the rest of the run (every later op
+# recorded, trace files growing unbounded), and scattered call sites
+# defeat the anomaly engine's per-run capture budget. Library code must
+# go through gigapath_tpu/obs/spans.py (trace()/start_trace()/
+# stop_trace()), the one place with the stop-on-close and budget
+# bookkeeping.
+_GL010_TRACE_SUFFIXES = ("profiler.start_trace", "profiler.stop_trace")
+_GL010_FULL_NAMES = frozenset({
+    "jax.profiler.start_trace", "jax.profiler.stop_trace",
+})
+# the sanctioned passthrough module, matched by path suffix so fixture
+# trees can carry their own obs/spans.py twin as a negative control
+_GL010_SANCTIONED_SUFFIX = "obs/spans.py"
+_GL010_EXEMPT_SEGMENTS = frozenset({"scripts", "tests", "demo"})
+
+
+@register(
+    "GL010",
+    "jax.profiler.start_trace/stop_trace called directly in library code — "
+    "open-ended trace capture must go through the sanctioned "
+    "gigapath_tpu/obs/spans.py entry points (trace/start_trace/stop_trace), "
+    "which own the stop-on-close and capture-budget bookkeeping",
+)
+def check_profiler_hygiene(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.modules.values():
+        segments = mod.path.split("/")[:-1]
+        if mod.is_test_file or any(
+            s in _GL010_EXEMPT_SEGMENTS for s in segments
+        ):
+            continue
+        if (
+            mod.path == _GL010_SANCTIONED_SUFFIX.split("/")[-1]
+            or mod.path.endswith("/" + _GL010_SANCTIONED_SUFFIX)
+            or mod.path == _GL010_SANCTIONED_SUFFIX
+        ):
+            continue
+        # innermost enclosing function for the finding symbol (the same
+        # resolution GL007/GL009 use)
+        spans = sorted(
+            (
+                (fn.lineno, getattr(fn.node, "end_lineno", fn.lineno), fn)
+                for fn in mod.functions.values()
+            ),
+            key=lambda t: t[1] - t[0],
+        )
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if not name:
+                continue
+            # expand a leading import alias (``from jax.profiler import
+            # start_trace``; ``import jax.profiler as prof``)
+            head, sep, rest = name.partition(".")
+            target = mod.imports.get(head)
+            resolved = (f"{target}.{rest}" if sep else target) if target else name
+            if not (
+                resolved in _GL010_FULL_NAMES
+                or resolved.endswith(_GL010_TRACE_SUFFIXES)
+            ):
+                continue
+            symbol = "<module>"
+            for lo, hi, fn in spans:
+                if lo <= node.lineno <= hi:
+                    symbol = fn.qualname
+                    break
+            findings.append(Finding(
+                "GL010", mod.path, node.lineno, symbol,
+                f"direct {resolved}() in library code: route profiler "
+                "capture through gigapath_tpu.obs.spans "
+                "(trace()/start_trace()/stop_trace()) so every open trace "
+                "has an owner that stops it and a capture budget",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # GL004 — forbidden APIs
 # ---------------------------------------------------------------------------
 

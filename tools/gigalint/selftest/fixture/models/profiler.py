@@ -1,0 +1,19 @@
+"""Seeded GL010 violation (never imported — parsed only).
+
+This module drives ``jax.profiler``'s open-ended trace pair by hand in
+library code — the exact leaked-open-trace / unbudgeted-capture class
+GL010 exists to catch. The sanctioned twin lives in the fixture's
+``obs/spans.py`` (path-suffix sanctioned, like the real
+``gigapath_tpu/obs/spans.py``).
+"""
+
+import jax
+
+
+def trace_by_hand(step_fn, x):
+    # GL010: start_trace outside the sanctioned spans module — if
+    # step_fn raises, the trace stays open for the rest of the run
+    jax.profiler.start_trace("/tmp/fixture-trace")
+    out = step_fn(x)
+    jax.profiler.stop_trace()  # GL010 (the stop half, same class)
+    return out

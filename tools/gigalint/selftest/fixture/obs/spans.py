@@ -1,0 +1,16 @@
+"""GL010 negative control (never imported — parsed only).
+
+Same ``jax.profiler`` calls as ``../models/profiler.py``, but this
+module's path ends in ``obs/spans.py`` — the sanctioned passthrough
+location — so no finding may fire here.
+"""
+
+import jax
+
+
+def negative_control_sanctioned_start_trace(log_dir):
+    jax.profiler.start_trace(log_dir)
+
+
+def negative_control_sanctioned_stop_trace():
+    jax.profiler.stop_trace()
