@@ -5,6 +5,19 @@ trained classification checkpoint, iterate ``*_features.pt`` files (or orbax
 feature dirs), softmax-classify, write a csv of ``slide_id`` /
 ``predicted_label`` / ``confidence`` and print the label distribution +
 mean-confidence stats (``run_inference:37-79``).
+
+Two execution paths:
+
+- **bucketed (default)**: slides route through the serving stack's
+  shape-bucket ladder and request coalescer (:mod:`gigapath_tpu.serve`)
+  — padded ``[batch_size, N_bucket, D]`` batches with key-padding
+  masks, one AOT executable per bucket instead of one jit retrace per
+  distinct tile count, and ``--batch_size`` actually batches (the
+  reference accepted the flag and ignored it). Repeated slides are
+  served from the content-hash embedding cache without a forward pass.
+- **exact-shape** (``--no-buckets``): the original slide-at-a-time
+  jit path — one compile per distinct N — kept as the fallback and the
+  parity oracle the bucketed path is tested against.
 """
 
 from __future__ import annotations
@@ -79,16 +92,173 @@ def _load_features(path: str):
     return np.asarray(state), None
 
 
+def _results_df(results, output_file, runlog, **run_end_fields):
+    """Shared CSV + summary tail of both inference paths. A write
+    failure (disk full, permissions) is contained like any other run
+    failure: ``error`` event + terminal ``run_end(status='error')``, so
+    the anomaly engine's error-triggered flight dump and obs_report's
+    terminal-status accounting see it."""
+    import pandas as pd
+
+    results_df = pd.DataFrame(results)
+    try:
+        results_df.to_csv(output_file, index=False)
+    except Exception as e:
+        runlog.error("inference.results", e)
+        runlog.run_end(status="error")
+        raise
+    label_counts = {
+        str(k): int(v)
+        for k, v in results_df["predicted_label"].value_counts().items()
+    }
+    runlog.echo(f"Inference results saved to {output_file}")
+    runlog.echo(f"Label distribution: {label_counts}")
+    runlog.echo(f"Mean confidence: {results_df['confidence'].mean():.4f}")
+    runlog.run_end(
+        status="ok", n_slides=len(results),
+        label_distribution=str(label_counts),
+        mean_confidence=float(results_df["confidence"].mean()),
+        **run_end_fields,
+    )
+    return results_df
+
+
+def _run_inference_bucketed(model, params, feature_files, output_file,
+                            runlog, batch_size: int):
+    """Bucketed path: the serving stack's ladder + coalescer + AOT
+    executables + content-hash cache, driven synchronously.
+
+    Submits stream one file at a time and full buckets dispatch
+    immediately (``step()`` after every submit), so at most
+    ``batch_size`` slides per bucket are resident at once — the memory
+    shape of the old slide-at-a-time loop, times the batch the
+    ``--batch_size`` flag always promised.
+    """
+    from gigapath_tpu.serve import ServeConfig, SlideService
+
+    def forward(p, embeds, coords, pad_mask):
+        return model.apply({"params": p}, embeds, coords,
+                           pad_mask=pad_mask, deterministic=True)
+
+    config = ServeConfig.from_env(
+        max_batch=int(batch_size),
+        # an offline batch driver has no latency bound: the serving
+        # default (50 ms) would deadline-dispatch batch-of-1 whenever a
+        # feature file takes longer than that to load. Full buckets
+        # still dispatch eagerly; partials flush in the final drain().
+        max_wait_s=float("inf"),
+        feature_dim=int(getattr(model, "input_dim", 1536)),
+    )
+    identity = (
+        f"{getattr(model, 'model_arch', type(model).__name__)}"
+        f"|feat{getattr(model, 'feat_layer', '?')}"
+        f"|cls{getattr(model, 'n_classes', '?')}"
+    )
+    service = SlideService(forward, params, config=config, runlog=runlog,
+                           identity=identity, name="serve")
+    results = []
+    warned = False
+    exact_forward = None  # lazily jitted; only oversized slides pay it
+    try:
+        with Heartbeat(runlog, name="inference") as heartbeat:
+            futures = []
+            for idx, path in enumerate(feature_files):
+                feats, coords = _load_features(path)
+                if coords is None and not warned:
+                    runlog.echo(
+                        "Warning: feature files carry no coords; using zeros "
+                        "(positional signal collapses to one grid cell)"
+                    )
+                    warned = True
+                slide_id = os.path.basename(path).replace("_features.pt", "")
+                feats = np.asarray(feats, np.float32)
+                if feats.shape[0] > service.ladder.rungs[-1]:
+                    # larger than the ladder's top rung: submit() would
+                    # refuse it and abort the run — serve THIS slide on
+                    # the exact-shape fallback (one extra compile, like
+                    # the old driver) and keep the batch going
+                    runlog.echo(
+                        f"Warning: {slide_id} has {feats.shape[0]} tiles, "
+                        f"above the ladder's top rung "
+                        f"{service.ladder.rungs[-1]}; serving it on the "
+                        "exact-shape fallback (raise "
+                        "GIGAPATH_SERVE_BUCKET_MAX to bucket it)"
+                    )
+                    from concurrent.futures import Future
+
+                    if exact_forward is None:
+                        exact_forward = jax.jit(
+                            lambda p, e, c: model.apply(
+                                {"params": p}, e, c, deterministic=True
+                            )
+                        )
+                    c = (np.zeros((feats.shape[0], 2), np.float32)
+                         if coords is None
+                         else np.asarray(coords, np.float32))
+                    logits = np.asarray(exact_forward(
+                        params, jnp.asarray(feats[None]), jnp.asarray(c[None])
+                    ), np.float32)[0]
+                    fut: Future = Future()
+                    fut.set_result(logits)
+                    futures.append((slide_id, fut))
+                else:
+                    futures.append((slide_id, service.submit(
+                        slide_id, feats, coords
+                    )))
+                while service.step():  # dispatch any filled buckets now
+                    pass
+                heartbeat.beat(idx)
+            # flush the partial batches — one step() per beat, not one
+            # opaque drain(): each flush can pay a fresh AOT compile
+            # plus a full padded forward, and a beat-less multi-minute
+            # drain would trip the stall detector on a healthy run
+            drained = len(feature_files)
+            while True:
+                n = service.step(drain=True)
+                if n == 0 and service.queue.pending() == 0:
+                    break
+                drained += 1
+                heartbeat.beat(drained)
+            for slide_id, fut in futures:
+                logits = np.asarray(fut.result(), np.float32)
+                probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+                pred = int(probs.argmax())
+                results.append({
+                    "slide_id": slide_id,
+                    "predicted_label": pred,
+                    "confidence": float(probs[pred]),
+                })
+    except Exception as e:
+        runlog.error("inference.run_inference", e)
+        runlog.run_end(status="error")
+        raise
+    finally:
+        service.close()
+    stats = service.stats()
+    return _results_df(
+        results, output_file, runlog,
+        compile_seconds_total=stats["compile_seconds_total"],
+        dispatches=stats["dispatches"],
+        buckets_used=stats["buckets_used"],
+        cache_hits=stats["cache"]["hits"],
+        unexpected_retraces=stats["unexpected_retraces"],
+        ledger_path=service.ledger.path,
+    )
+
+
 def run_inference(
     model,
     params,
     feature_dir: str,
     output_file: str,
+    *,
+    use_buckets: bool = True,
+    batch_size: int = 16,
 ):
     """Classify every ``*_features.pt`` in ``feature_dir``
-    (reference ``run_inference:37-79``)."""
-    import pandas as pd
-
+    (reference ``run_inference:37-79``). ``use_buckets`` routes through
+    the serving stack (module docstring); False is the exact-shape
+    oracle path."""
     feature_files = sorted(glob.glob(os.path.join(feature_dir, "*_features.pt")))
     if not feature_files:
         console(f"No feature files found in {feature_dir}")
@@ -97,8 +267,13 @@ def run_inference(
     runlog = get_run_log(
         "inference", out_dir=os.path.dirname(os.path.abspath(output_file)),
         config={"feature_dir": feature_dir, "output_file": output_file,
-                "n_slides": len(feature_files)},
+                "n_slides": len(feature_files), "buckets": bool(use_buckets),
+                "batch_size": int(batch_size)},
     )
+    if use_buckets:
+        return _run_inference_bucketed(
+            model, params, feature_files, output_file, runlog, batch_size
+        )
 
     @jax.jit
     def forward(params, embeds, coords):
@@ -151,27 +326,16 @@ def run_inference(
                     confidence=float(probs[pred]),
                 )
                 heartbeat.beat(idx)
-        results_df = pd.DataFrame(results)
-        results_df.to_csv(output_file, index=False)
     except Exception as e:
         runlog.error("inference.run_inference", e)
         runlog.run_end(status="error")
         raise
 
-    label_counts = {
-        str(k): int(v)
-        for k, v in results_df["predicted_label"].value_counts().items()
-    }
-    runlog.echo(f"Inference results saved to {output_file}")
-    runlog.echo(f"Label distribution: {label_counts}")
-    runlog.echo(f"Mean confidence: {results_df['confidence'].mean():.4f}")
-    runlog.run_end(
-        status="ok", n_slides=len(results), label_distribution=str(label_counts),
-        mean_confidence=float(results_df["confidence"].mean()),
+    return _results_df(
+        results, output_file, runlog,
         compile_seconds_total=watchdog.compile_seconds_total(),
         ledger_path=ledger.path,
     )
-    return results_df
 
 
 def main(argv=None):
@@ -181,8 +345,14 @@ def main(argv=None):
     parser.add_argument("--output_file", type=str, default="predictions.csv")
     parser.add_argument(
         "--batch_size", type=int, default=16,
-        help="Accepted for reference-CLI compatibility (slides are "
-        "variable-length; processed one at a time)",
+        help="Slides coalesced per padded bucket batch (the serving "
+        "stack's max_batch; ignored under --no-buckets, where slides "
+        "are processed one at a time)",
+    )
+    parser.add_argument(
+        "--no-buckets", dest="no_buckets", action="store_true",
+        help="Exact-shape fallback/oracle path: one jit compile per "
+        "distinct tile count, no batching, no padding",
     )
     parser.add_argument("--num_classes", type=int, default=2)
     parser.add_argument("--model_arch", type=str, default="gigapath_slide_enc12l768d")
@@ -190,7 +360,10 @@ def main(argv=None):
     model, params = load_model(
         args.model_path, n_classes=args.num_classes, model_arch=args.model_arch
     )
-    return run_inference(model, params, args.feature_dir, args.output_file)
+    return run_inference(
+        model, params, args.feature_dir, args.output_file,
+        use_buckets=not args.no_buckets, batch_size=args.batch_size,
+    )
 
 
 if __name__ == "__main__":

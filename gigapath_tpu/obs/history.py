@@ -43,6 +43,12 @@ _DIRECTION_RULES: Tuple[Tuple[str, str], ...] = (
     ("tokens_per_sec", "up"),
     ("tiles_per_sec", "up"),
     ("steps_per_sec", "up"),
+    ("slides_per_sec", "up"),
+    ("occupancy_mean", "up"),
+    ("cache_hit_rate", "up"),
+    ("queue_wait_p50_s", "down"),
+    ("queue_wait_p90_s", "down"),
+    ("compile_seconds_total", "down"),
     ("vs_baseline", "up"),
     ("mfu", "up"),
     ("value", "up"),          # bench payload primary metric
@@ -176,6 +182,49 @@ def fold_bench(doc: dict, snapshot: dict, label: str,
         metrics = {}
     return append_point(
         doc, "bench|slide_embed", label, metrics, source=source,
+        stale=stale, note=note, force=force,
+    )
+
+
+# serve_smoke payload fields worth trending (scripts/serve_smoke.py's
+# JSON line; everything else is provenance)
+_SERVE_METRICS = (
+    "slides_per_sec", "occupancy_mean", "cache_hit_rate",
+    "queue_wait_p50_s", "queue_wait_p90_s", "compile_seconds_total",
+    "buckets_used", "dispatches",
+)
+
+
+def fold_serve(doc: dict, snapshot: dict, label: str,
+               source: Optional[str] = None, force: bool = False) -> dict:
+    """One serve_smoke JSON -> one point under ``serve|smoke``.
+
+    A failed run (rc != 0 / error) or a NON-CHIP backend lands STALE:
+    CPU smoke numbers carry the metric KEYS for future on-chip rounds
+    (the acceptance surface of ROADMAP item 1) without ever moving the
+    trend — a laptop's queue-wait percentiles are not a perf baseline.
+    """
+    parsed = snapshot.get("parsed", snapshot)
+    if not isinstance(parsed, dict):
+        parsed = {}
+    backend = str(parsed.get("backend", "")).lower()
+    stale = bool(
+        snapshot.get("rc", 0) != 0
+        or parsed.get("error")
+        or backend not in ("tpu", "gpu")
+    )
+    metrics = {
+        k: parsed[k] for k in _SERVE_METRICS
+        if _finite_number(parsed.get(k)) is not None
+    }
+    note = None
+    if stale:
+        note = str(
+            parsed.get("error")
+            or f"backend={backend or '?'}: not an on-chip measurement"
+        )[:200]
+    return append_point(
+        doc, "serve|smoke", label, metrics, source=source,
         stale=stale, note=note, force=force,
     )
 

@@ -264,6 +264,9 @@ class NullLedger:
 
     capture_full = capture_fingerprint = capture
 
+    def adopt_compiled(self, name: str, key, compiled, fn, *args, **kwargs):
+        return None
+
     def write(self, path: Optional[str] = None):
         return None
 
@@ -326,6 +329,54 @@ class PerfLedger(NullLedger):
         return self._capture(name, fn, args, kwargs,
                              full=name not in self._full_named,
                              extra={"key": _key_str(key)})
+
+    def adopt_compiled(self, name: str, key, compiled, fn,
+                       *args, **kwargs) -> Optional[dict]:
+        """Ledger an ALREADY-compiled AOT executable.
+
+        The documented cost model of :meth:`capture` pays one extra AOT
+        compile per full profile; callers that hold the compiled object
+        already (the serving stack's per-bucket executables,
+        :mod:`gigapath_tpu.serve.aot`) get cost/memory analysis straight
+        off it for free — the only added work is the fingerprint's one
+        extra trace. ``args``/``kwargs`` may be ``jax.ShapeDtypeStruct``s
+        (they only feed the trace and the shape signature). Every
+        (name, signature) is a FULL profile here, since full costs
+        nothing. Failures are contained like every other capture."""
+        from gigapath_tpu.obs.runlog import _key_str
+
+        sig = shape_signature(args, kwargs)
+        entry_key = f"{name}|{sig}"
+        existing = self._entries.get(entry_key)
+        if existing is not None and "cost" in existing:
+            return existing
+        try:
+            profile: Dict[str, Any] = {
+                "sig": sig,
+                "jaxpr": jaxpr_fingerprint(fn, *args, **kwargs),
+                "cost": cost_analysis_of(compiled),
+                "memory": memory_analysis_of(compiled),
+            }
+        except Exception as e:
+            if self.runlog is not None:
+                self.runlog.event(
+                    "compile_profile", name=name, sig=sig,
+                    error=f"{type(e).__name__}: {e}",
+                )
+            return None
+        self._full_named.add(name)
+        extra = {"key": _key_str(key)}
+        entry = {"name": name, **extra, **profile}
+        self._entries[entry_key] = entry
+        if self.runlog is not None:
+            self.runlog.event("compile_profile", name=name, **extra, **profile)
+        if self.autowrite:
+            try:
+                self.write()
+            except Exception as e:  # the artifact must never take a run down
+                if self.runlog is not None:
+                    self.runlog.error("ledger.write", e)
+        return entry
 
     def _capture(self, name, fn, args, kwargs, *, full,
                  extra: Optional[dict] = None) -> Optional[dict]:

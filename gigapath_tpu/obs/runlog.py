@@ -32,6 +32,11 @@ Event kinds (schema v1, one JSON object per line, every record carries
   (:mod:`gigapath_tpu.obs.anomaly`): step-time spike, stall, unexpected
   retrace, memory-watermark growth, throughput dip — with the reaction
   taken (flight-dump path, scheduled profiler capture);
+- ``serve_dispatch`` — one coalesced batch through a serving executable
+  (:mod:`gigapath_tpu.serve`): bucket, slides/capacity (occupancy),
+  per-slide queue waits, wall seconds, executable provenance;
+- ``cache_hit``  — a serving request short-circuited by the
+  content-hash embedding cache (no forward pass);
 - ``error``      — exception surfaced by a driver;
 - ``run_end``    — terminal status + summary payload.
 
@@ -55,7 +60,8 @@ SCHEMA_VERSION = 1
 
 EVENT_KINDS = (
     "run_start", "step", "compile", "compile_profile", "span", "eval",
-    "heartbeat", "stall", "anomaly", "error", "run_end",
+    "heartbeat", "stall", "anomaly", "serve_dispatch", "cache_hit",
+    "error", "run_end",
 )
 
 

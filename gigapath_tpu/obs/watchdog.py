@@ -104,6 +104,19 @@ class CompileWatchdog:
     def is_new(self, key) -> bool:
         return key not in self.first_call_sec
 
+    def mark_preloaded(self, key) -> None:
+        """Register a key whose executable arrived WITHOUT a compile —
+        the serving stack's persisted-artifact loads (serve/aot.py).
+        Steady calls are counted under the key from here on, no
+        ``compile`` event is filed (a load is not a compile), and later
+        cache growth on the key is still flagged as an unexpected
+        retrace."""
+        if self.is_new(key):
+            self.first_call_sec[key] = 0.0
+            cur = self._cache_size()
+            if cur is not None:
+                self._last_cache_size = cur
+
     def record(self, key, seconds: Optional[float]) -> None:
         """File one completed call under ``key``.
 

@@ -1,0 +1,38 @@
+"""Slide-embedding serving stack (ROADMAP item 1).
+
+- :mod:`gigapath_tpu.serve.buckets` — geometric shape-bucket ladder +
+  padded-batch assembly with key-padding masks;
+- :mod:`gigapath_tpu.serve.aot` — per-bucket AOT executables (donated
+  request buffers, persisted compiled artifacts: warm cold-start loads
+  instead of retracing);
+- :mod:`gigapath_tpu.serve.queue` — same-bucket request coalescing under
+  a fill-or-deadline (continuous batching) policy;
+- :mod:`gigapath_tpu.serve.cache` — content-hash embedding LRU with a
+  byte budget (re-queried slides never recompute);
+- :mod:`gigapath_tpu.serve.service` — the orchestration loop, wired
+  through the obs bus (runlog, watchdog, heartbeat, ledger, anomaly
+  engine; ``serve_dispatch`` / ``cache_hit`` events).
+
+Smoke: ``python scripts/serve_smoke.py``; tier-1:
+``tests/test_serve.py``; knobs: the ``GIGAPATH_SERVE_*`` rows of the
+README flag table (all host-side, read once at service construction).
+"""
+
+from gigapath_tpu.serve.aot import AotExecutableCache
+from gigapath_tpu.serve.buckets import BucketLadder, assemble_batch, pad_slide
+from gigapath_tpu.serve.cache import EmbeddingCache, content_key
+from gigapath_tpu.serve.queue import RequestQueue, SlideRequest
+from gigapath_tpu.serve.service import ServeConfig, SlideService
+
+__all__ = [
+    "AotExecutableCache",
+    "BucketLadder",
+    "EmbeddingCache",
+    "RequestQueue",
+    "ServeConfig",
+    "SlideRequest",
+    "SlideService",
+    "assemble_batch",
+    "content_key",
+    "pad_slide",
+]

@@ -1,0 +1,139 @@
+"""Geometric shape-bucket ladder for slide serving.
+
+Slides are ragged — 10^5..10^6 tiles at production scale (PAPER.md §0),
+anything from a biopsy fragment to a full resection in practice — and a
+jitted forward compiles once per distinct shape. Serving therefore maps
+every tile count onto a SMALL FIXED SET of padded ``[B, N_bucket, D]``
+shapes: a geometric ladder (each rung ``growth``× the previous, aligned
+to the TPU-friendly 128 multiple the slide encoder already pads to
+internally) bounds the executable count at O(log N_max) while capping
+padding waste at ``growth``× worst case. The key-padding mask rides next
+to the padded arrays, and the slide encoder's exact suffix-pad masking
+(tests/test_pad_masking.py) makes the padded forward bit-for-bit
+trustworthy: bucketed logits match exact-shape logits at 1e-5
+(tests/test_serve.py's parity suite).
+
+The batch dimension is bucketed too: :func:`assemble_batch` always pads
+a coalesced batch to the queue's fixed capacity with fully-masked dummy
+rows, so a partially-filled dispatch reuses the full-batch executable
+instead of compiling a second one per occupancy level. Rows are
+independent in the slide encoder (attention never crosses the batch
+axis), so dummy rows cannot perturb real rows; their outputs are
+discarded at scatter time.
+
+Host-side numpy only — nothing here is jit-reachable.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class BucketLadder:
+    """Geometric ladder of padded tile counts.
+
+    ``rungs[0] = align_up(n_min)``; each later rung is the previous rung
+    times ``growth``, aligned up to ``align``, strictly increasing, until
+    ``n_max`` is covered. ``bucket_for(n)`` returns the smallest rung
+    >= n (so a slide whose tile count lands exactly ON a rung pays zero
+    padding).
+    """
+
+    def __init__(self, n_min: int = 1024, growth: float = 2.0,
+                 n_max: int = 1 << 20, align: int = 128):
+        if n_min < 1:
+            raise ValueError(f"n_min must be >= 1, got {n_min}")
+        if growth <= 1.0:
+            raise ValueError(f"growth must be > 1, got {growth}")
+        if n_max < n_min:
+            raise ValueError(f"n_max {n_max} < n_min {n_min}")
+        self.align = int(align)
+        rungs: List[int] = []
+        rung = self._align_up(n_min)
+        while True:
+            rungs.append(rung)
+            if rung >= n_max:
+                break
+            nxt = self._align_up(int(np.ceil(rung * growth)))
+            rung = max(nxt, rung + self.align)  # strictly increasing
+        self._rungs: Tuple[int, ...] = tuple(rungs)
+
+    def _align_up(self, n: int) -> int:
+        return -(-int(n) // self.align) * self.align
+
+    @property
+    def rungs(self) -> Tuple[int, ...]:
+        return self._rungs
+
+    def __len__(self) -> int:
+        return len(self._rungs)
+
+    def bucket_for(self, n_tiles: int) -> int:
+        """Smallest rung >= ``n_tiles``."""
+        if n_tiles < 1:
+            raise ValueError(f"n_tiles must be >= 1, got {n_tiles}")
+        for rung in self._rungs:
+            if rung >= n_tiles:
+                return rung
+        raise ValueError(
+            f"slide of {n_tiles} tiles exceeds the ladder's top rung "
+            f"{self._rungs[-1]} (raise n_max, or serve it on the "
+            "exact-shape fallback path)"
+        )
+
+
+def pad_slide(feats: np.ndarray, coords: Optional[np.ndarray],
+              bucket_n: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pad one slide ``([N, D], [N, 2] or None)`` to
+    ``([bucket_n, D], [bucket_n, 2], mask [bucket_n])``.
+
+    Mask convention: True = VALID tile (the collate convention,
+    data/collate.py — the slide encoder inverts it internally). Pad rows
+    are zeros; coords default to zeros when the feature file carries
+    none (positional signal collapses to one grid cell — the caller
+    warns, as inference.py always has).
+    """
+    feats = np.asarray(feats)
+    if feats.ndim != 2:
+        raise ValueError(f"feats must be [N, D], got shape {feats.shape}")
+    n, d = feats.shape
+    if n > bucket_n:
+        raise ValueError(f"slide of {n} tiles does not fit bucket {bucket_n}")
+    out = np.zeros((bucket_n, d), feats.dtype)
+    out[:n] = feats
+    c = np.zeros((bucket_n, 2), np.float32)
+    if coords is not None:
+        c[:n] = np.asarray(coords, np.float32)
+    mask = np.zeros((bucket_n,), bool)
+    mask[:n] = True
+    return out, c, mask
+
+
+def assemble_batch(
+    slides: Sequence[Tuple[np.ndarray, Optional[np.ndarray]]],
+    bucket_n: int,
+    capacity: int,
+    feature_dim: Optional[int] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Stack ``slides`` (each ``(feats [N_i, D], coords or None)``) into
+    one fixed-shape batch ``(embeds [capacity, bucket_n, D],
+    coords [capacity, bucket_n, 2], mask [capacity, bucket_n])``.
+
+    Rows beyond ``len(slides)`` are dummy rows: all-zero, all-masked
+    (only their always-valid cls token attends, to itself) — present so
+    every dispatch of this bucket shares ONE executable shape regardless
+    of how full the batch is.
+    """
+    if not slides and feature_dim is None:
+        raise ValueError("empty batch needs an explicit feature_dim")
+    if len(slides) > capacity:
+        raise ValueError(f"{len(slides)} slides exceed capacity {capacity}")
+    d = feature_dim if feature_dim is not None else np.asarray(slides[0][0]).shape[1]
+    embeds = np.zeros((capacity, bucket_n, d), np.float32)
+    coords = np.zeros((capacity, bucket_n, 2), np.float32)
+    mask = np.zeros((capacity, bucket_n), bool)
+    for i, (f, c) in enumerate(slides):
+        embeds[i], coords[i], mask[i] = pad_slide(f, c, bucket_n)
+    return embeds, coords, mask

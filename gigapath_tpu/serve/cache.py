@@ -1,0 +1,113 @@
+"""Content-hash embedding cache: re-queried slides never recompute.
+
+Downstream tasks re-query the same slides constantly (every probe,
+finetune eval, report regeneration hits the same cohort), and a slide's
+embedding is a pure function of its tile features + coords + model
+identity. The cache key is therefore a sha256 over the exact feature
+bytes — not the slide id, which is a filename convention two pipelines
+can disagree on; renaming a file must not fake a miss, and two different
+slides sharing an id must not collide.
+
+Byte-budgeted LRU: entries are numpy pytrees (logits, embeddings);
+eviction is size-aware (a 1M-tile slide's layer stack and a biopsy's
+logits are not the same weight). Thread-safe — submitters probe it
+concurrently from request threads while the dispatch worker fills it.
+Host memory only; no jax anywhere.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def content_key(feats: np.ndarray, coords: Optional[np.ndarray] = None,
+                extra: str = "") -> str:
+    """sha256 over the slide's exact content: feature bytes, coord
+    bytes, shapes/dtypes, plus ``extra`` (the model identity — same
+    features through two checkpoints are two cache lines)."""
+    h = hashlib.sha256()
+    for arr in (feats, coords):
+        if arr is None:
+            h.update(b"none|")
+            continue
+        arr = np.ascontiguousarray(arr)
+        h.update(str(arr.shape).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(arr.tobytes())
+        h.update(b"|")
+    h.update(extra.encode())
+    return h.hexdigest()
+
+
+def _nbytes(value: Any) -> int:
+    if isinstance(value, dict):
+        return sum(_nbytes(v) for v in value.values())
+    if isinstance(value, (list, tuple)):
+        return sum(_nbytes(v) for v in value)
+    return int(getattr(value, "nbytes", 64))  # scalars: bookkeeping floor
+
+
+class EmbeddingCache:
+    """Byte-budgeted, thread-safe LRU over content keys."""
+
+    def __init__(self, budget_bytes: int = 256 << 20):
+        self.budget_bytes = int(budget_bytes)
+        self._entries: "OrderedDict[str, Any]" = OrderedDict()
+        self._sizes: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def get(self, key: str) -> Optional[Any]:
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return self._entries[key]
+            self.misses += 1
+            return None
+
+    def put(self, key: str, value: Any) -> bool:
+        """Insert (refreshing recency on re-insert). Returns False when
+        the value alone exceeds the whole budget — such a value is
+        served but never cached (caching it would evict everything for
+        one line that LRU would drop first anyway)."""
+        size = _nbytes(value)
+        if size > self.budget_bytes:
+            return False
+        with self._lock:
+            if key in self._entries:
+                self.bytes -= self._sizes[key]
+                del self._entries[key]
+            while self._entries and self.bytes + size > self.budget_bytes:
+                old_key, _ = self._entries.popitem(last=False)
+                self.bytes -= self._sizes.pop(old_key)
+                self.evictions += 1
+            self._entries[key] = value
+            self._sizes[key] = size
+            self.bytes += size
+        return True
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            requests = self.hits + self.misses
+            return {
+                "entries": len(self._entries),
+                "bytes": self.bytes,
+                "budget_bytes": self.budget_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "hit_rate": (self.hits / requests) if requests else 0.0,
+            }

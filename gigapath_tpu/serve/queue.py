@@ -1,0 +1,162 @@
+"""Request queue with same-bucket coalescing (continuous batching).
+
+The serving loop's scheduling policy lives here, decoupled from both the
+transport (threads submit, one worker drains) and the executor (the AOT
+cache). Requests land in per-bucket FIFO lanes; a batch dispatches as
+soon as either
+
+- its bucket has ``max_batch`` pending slides (a FULL batch — the
+  throughput case), or
+- the bucket's OLDEST request has waited ``max_wait_s`` (the latency
+  case: a lone odd-sized slide must not wait for company that never
+  comes).
+
+``pop_ready`` is pull-based and takes an explicit ``now`` so the policy
+is deterministic under test (no hidden clock reads in assertions);
+callers in production pass nothing and get the monotonic clock. The
+queue never touches jax — it moves numpy references and futures.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class SlideRequest:
+    """One slide awaiting a forward pass."""
+
+    __slots__ = ("slide_id", "feats", "coords", "n_tiles", "bucket_n",
+                 "cache_key", "future", "t_submit", "t_dispatch")
+
+    def __init__(self, slide_id: str, feats: np.ndarray,
+                 coords: Optional[np.ndarray], bucket_n: int,
+                 cache_key: Optional[str] = None,
+                 t_submit: Optional[float] = None):
+        self.slide_id = slide_id
+        self.feats = feats
+        self.coords = coords
+        self.n_tiles = int(np.asarray(feats).shape[0])
+        self.bucket_n = int(bucket_n)
+        self.cache_key = cache_key
+        self.future: Future = Future()
+        self.t_submit = time.monotonic() if t_submit is None else t_submit
+        self.t_dispatch: Optional[float] = None
+
+    def wait_s(self, now: Optional[float] = None) -> float:
+        end = self.t_dispatch if self.t_dispatch is not None else (
+            time.monotonic() if now is None else now
+        )
+        return max(end - self.t_submit, 0.0)
+
+
+class RequestQueue:
+    """Per-bucket FIFO lanes + the fill-or-deadline dispatch policy."""
+
+    def __init__(self, max_batch: int = 8, max_wait_s: float = 0.05,
+                 capacity_for: Optional[Callable[[int], int]] = None):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_s)
+        # per-bucket batch capacity (<= max_batch); the service passes
+        # its token-budget clamp so big buckets fill (and dispatch) at
+        # smaller batch sizes than small ones
+        self._capacity_for = capacity_for
+        self._lanes: Dict[int, List[SlideRequest]] = {}
+        self._cond = threading.Condition()
+
+    def capacity(self, bucket_n: int) -> int:
+        if self._capacity_for is None:
+            return self.max_batch
+        return max(1, min(self.max_batch, int(self._capacity_for(bucket_n))))
+
+    # -- producer side ----------------------------------------------------
+    def submit(self, req: SlideRequest) -> None:
+        with self._cond:
+            self._lanes.setdefault(req.bucket_n, []).append(req)
+            self._cond.notify_all()
+
+    # -- consumer side ----------------------------------------------------
+    def pending(self) -> int:
+        with self._cond:
+            return sum(len(lane) for lane in self._lanes.values())
+
+    def _oldest_head(self) -> Optional[SlideRequest]:
+        heads = [lane[0] for lane in self._lanes.values() if lane]
+        return min(heads, key=lambda r: r.t_submit) if heads else None
+
+    def next_deadline_s(self, now: Optional[float] = None) -> Optional[float]:
+        """Seconds until the oldest pending request's deadline expires
+        (<= 0 means a batch is already dispatchable on the deadline
+        rule); None when the queue is idle."""
+        now = time.monotonic() if now is None else now
+        with self._cond:
+            head = self._oldest_head()
+        if head is None:
+            return None
+        return (head.t_submit + self.max_wait_s) - now
+
+    def pop_ready(self, now: Optional[float] = None,
+                  drain: bool = False) -> List[SlideRequest]:
+        """One dispatchable same-bucket batch (possibly empty).
+
+        Priority: the bucket holding the overall-oldest request once its
+        deadline has PASSED (an expired head must never be starved by
+        full lanes elsewhere — sustained hot-bucket traffic would defer
+        it forever, and the displaced full lane dispatches on the very
+        next poll), else a FULL bucket (the one whose head has waited
+        longest among the full ones), else — only under ``drain`` —
+        whatever bucket holds the oldest head.
+        """
+        now = time.monotonic() if now is None else now
+        with self._cond:
+            pick: Optional[SlideRequest] = None
+            head = self._oldest_head()
+            if head is not None and (
+                drain or now - head.t_submit >= self.max_wait_s
+            ):
+                pick = head
+            else:
+                full = [
+                    lane[0] for lane in self._lanes.values()
+                    if len(lane) >= self.capacity(lane[0].bucket_n)
+                ]
+                if full:
+                    pick = min(full, key=lambda r: r.t_submit)
+            if pick is None:
+                return []
+            cap = self.capacity(pick.bucket_n)
+            lane = self._lanes[pick.bucket_n]
+            batch, rest = lane[:cap], lane[cap:]
+            if rest:
+                self._lanes[pick.bucket_n] = rest
+            else:
+                del self._lanes[pick.bucket_n]
+        for req in batch:
+            req.t_dispatch = now
+        return batch
+
+    def wait_for_work(self, timeout: Optional[float] = None,
+                      now: Optional[float] = None) -> None:
+        """Block until work might be dispatchable — the worker's parking
+        spot between polls. Returns immediately only when a batch is
+        ready NOW (a full lane, or an expired deadline); a pending but
+        not-yet-dispatchable request parks like an empty queue, waiting
+        for a new submit or the caller's deadline-bounded timeout
+        (returning early on it would busy-spin the worker for the whole
+        ``max_wait_s`` window). Spurious wakeups are fine, the worker
+        re-polls ``pop_ready``."""
+        now = time.monotonic() if now is None else now
+        with self._cond:
+            for lane in self._lanes.values():
+                if lane and len(lane) >= self.capacity(lane[0].bucket_n):
+                    return
+            head = self._oldest_head()
+            if head is not None and now - head.t_submit >= self.max_wait_s:
+                return
+            self._cond.wait(timeout=timeout)

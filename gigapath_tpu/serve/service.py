@@ -1,0 +1,447 @@
+"""The slide-embedding service: queue -> bucket -> AOT executable ->
+content-hash cache, wired through the obs bus.
+
+``SlideService`` is the orchestration layer ROADMAP item 1 asked for:
+requests (slide feature arrays) arrive from any thread via
+:meth:`submit` and resolve as futures; a single dispatch worker
+coalesces them into same-bucket batches (:mod:`gigapath_tpu.serve.queue`),
+pads them onto the bucket ladder (:mod:`gigapath_tpu.serve.buckets`),
+runs the per-bucket AOT executable (:mod:`gigapath_tpu.serve.aot` —
+compiled once per bucket, loaded from a persisted artifact on warm
+restarts), and banks every result in the content-hash cache
+(:mod:`gigapath_tpu.serve.cache`) so re-queried slides short-circuit the
+encoder entirely. Identical slides in flight coalesce onto ONE pending
+forward (the second submitter gets the same future), so a thundering
+herd on a hot slide costs one dispatch.
+
+Observability rides the existing bus for free: a ``RunLog`` (the
+driver's, or the service's own), a ``CompileWatchdog`` whose cache-size
+probe points at the AOT cache (zero-mid-serve-retrace is a pinned
+invariant, not a hope), the perf ledger adopting each compiled
+executable at zero extra compiles, a ``Heartbeat`` thread making a hung
+dispatch visible, and the anomaly engine's detectors (dispatch walls
+ride ``step`` events keyed by bucket, so its spike baselines are
+per-bucket). Serving-specific telemetry lands as schema'd
+``serve_dispatch`` / ``cache_hit`` events that
+``scripts/obs_report.py``'s ``== serving ==`` section folds into batch
+occupancy, queue-wait and hit-rate tables.
+
+All ``GIGAPATH_SERVE_*`` flags are host-side, read ONCE at
+:meth:`ServeConfig.from_env` (service construction) — never at trace
+time (GL001-clean by construction; README flag table).
+
+Sync usage (drivers, tests)::
+
+    svc = SlideService(forward, params, config=ServeConfig(max_batch=4))
+    futs = [svc.submit(sid, feats, coords) for ...]
+    svc.drain()                   # dispatch everything on THIS thread
+    results = [f.result() for f in futs]
+    svc.close()
+
+Async usage (the smoke's concurrent submitters)::
+
+    with SlideService(...) as svc:        # starts the worker thread
+        fut = svc.submit(sid, feats, coords)
+        logits = fut.result(timeout=60)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, replace
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from gigapath_tpu.obs import (
+    CompileWatchdog,
+    Heartbeat,
+    get_ledger,
+    get_run_log,
+    span,
+)
+from gigapath_tpu.serve.aot import AotExecutableCache
+from gigapath_tpu.serve.buckets import BucketLadder, assemble_batch
+from gigapath_tpu.serve.cache import EmbeddingCache, content_key
+from gigapath_tpu.serve.queue import RequestQueue, SlideRequest
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    """Serving policy knobs (all host-side; env via :meth:`from_env`)."""
+
+    max_batch: int = 8          # batch capacity per dispatch
+    max_wait_s: float = 0.05    # latency bound: oldest request's deadline
+    # memory bound: capacity x bucket_n never exceeds this many padded
+    # tiles, so a big-bucket dispatch is capped below max_batch (the
+    # default equals the exact path's worst single slide, 2^20 tiles —
+    # padding the batch axis must not multiply peak memory past what
+    # the old slide-at-a-time driver already materialized)
+    batch_tokens: int = 1 << 20
+    cache_budget_mb: float = 256.0
+    artifact_dir: Optional[str] = None  # persisted executables; None = off
+    bucket_min: int = 1024
+    bucket_growth: float = 2.0
+    bucket_max: int = 1 << 20
+    bucket_align: int = 128     # rung alignment (the encoder's internal pad)
+    feature_dim: int = 1536
+
+    @classmethod
+    def from_env(cls, **overrides) -> "ServeConfig":
+        """Read the ``GIGAPATH_SERVE_*`` env surface ONCE (host-side, at
+        service construction — the obs layer's flag discipline).
+        Explicit keyword overrides win over env over defaults."""
+        from gigapath_tpu.obs.runlog import env_number
+
+        base = cls(
+            max_batch=int(env_number("GIGAPATH_SERVE_MAX_BATCH",
+                                     cls.max_batch)),
+            max_wait_s=env_number("GIGAPATH_SERVE_MAX_WAIT_S",
+                                  cls.max_wait_s),
+            batch_tokens=int(env_number("GIGAPATH_SERVE_BATCH_TOKENS",
+                                        cls.batch_tokens)),
+            cache_budget_mb=env_number("GIGAPATH_SERVE_CACHE_MB",
+                                       cls.cache_budget_mb),
+            artifact_dir=os.environ.get("GIGAPATH_SERVE_ARTIFACT_DIR")
+            or None,
+            bucket_min=int(env_number("GIGAPATH_SERVE_BUCKET_MIN",
+                                      cls.bucket_min)),
+            bucket_growth=env_number("GIGAPATH_SERVE_BUCKET_GROWTH",
+                                     cls.bucket_growth),
+            bucket_max=int(env_number("GIGAPATH_SERVE_BUCKET_MAX",
+                                      cls.bucket_max)),
+            bucket_align=int(env_number("GIGAPATH_SERVE_BUCKET_ALIGN",
+                                        cls.bucket_align)),
+        )
+        return replace(base, **overrides) if overrides else base
+
+
+def _tree_np(value: Any) -> Any:
+    """Whole output pytree onto the host, one transfer per leaf (slicing
+    device arrays per row would dispatch an eager XLA op per slide —
+    the zero-extra-compile pin in tests/test_serve.py would catch it)."""
+    if isinstance(value, dict):
+        return {k: _tree_np(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return type(value)(_tree_np(v) for v in value)
+    return np.asarray(value)
+
+
+def _to_host(value: Any, row: int) -> Any:
+    """Row ``row`` of a HOST (numpy) batched output pytree — COPIED out
+    of the batch buffer. ``value[row]`` alone is a view whose ``.base``
+    is the whole ``[capacity, bucket_n, ...]`` batch (dummy rows
+    included), so caching it would pin up to capacity× the bytes the
+    cache accounts for. The copy is read-only: the same array backs the
+    requester's future AND the cache line, so a consumer mutating its
+    result would silently corrupt every later cache hit — mutation
+    fails loudly instead (``.copy()`` it on the consumer side)."""
+    if isinstance(value, dict):
+        return {k: _to_host(v, row) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return type(value)(_to_host(v, row) for v in value)
+    out = np.array(value[row])
+    out.setflags(write=False)
+    return out
+
+
+class SlideService:
+    """See module docstring. ``forward(params, embeds, coords,
+    pad_mask) -> pytree`` must be jit-compatible with a leading batch
+    axis on every output leaf (rows independent across the batch)."""
+
+    def __init__(self, forward: Callable, params: Any, *,
+                 config: Optional[ServeConfig] = None,
+                 out_dir: Optional[str] = None, runlog=None,
+                 identity: str = "", name: str = "serve"):
+        self.config = config or ServeConfig.from_env()
+        self.identity = identity
+        self._owns_runlog = runlog is None
+        if runlog is None:
+            runlog = get_run_log(
+                name, out_dir=out_dir,
+                config={
+                    "max_batch": self.config.max_batch,
+                    "max_wait_s": self.config.max_wait_s,
+                    "cache_budget_mb": self.config.cache_budget_mb,
+                    "artifact_dir": self.config.artifact_dir,
+                    "buckets": f"{self.config.bucket_min}..x"
+                               f"{self.config.bucket_growth:g}..",
+                    "identity": identity,
+                },
+            )
+        self.runlog = runlog
+        self.ladder = BucketLadder(
+            n_min=self.config.bucket_min, growth=self.config.bucket_growth,
+            n_max=self.config.bucket_max, align=self.config.bucket_align,
+        )
+        self.queue = RequestQueue(
+            max_batch=self.config.max_batch,
+            max_wait_s=self.config.max_wait_s,
+            capacity_for=self.capacity_for,
+        )
+        self.cache = EmbeddingCache(
+            budget_bytes=int(self.config.cache_budget_mb * (1 << 20))
+        )
+        self.ledger = get_ledger(runlog)
+        self.watchdog = CompileWatchdog(f"{name}.forward", runlog,
+                                        ledger=self.ledger)
+        self.aot = AotExecutableCache(
+            forward, params, feature_dim=self.config.feature_dim,
+            artifact_dir=self.config.artifact_dir, identity=identity,
+            name=f"{name}.forward", runlog=runlog,
+            watchdog=self.watchdog, ledger=self.ledger,
+        )
+        self.heartbeat = Heartbeat(runlog, name=name)
+        self._pending: Dict[str, SlideRequest] = {}  # in-flight by content
+        self._lock = threading.Lock()
+        self._worker: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._closed = False
+        self.dispatch_count = 0
+        self.slides_served = 0
+        self.inflight_joins = 0
+        self.per_bucket_dispatches: Dict[int, int] = {}
+
+    def capacity_for(self, bucket_n: int) -> int:
+        """Per-bucket batch capacity: ``max_batch`` clamped so one
+        dispatch never pads more than ``batch_tokens`` tiles — a
+        131k-tile bucket batches fewer slides than a 1k one instead of
+        multiplying peak memory by the full batch axis."""
+        return max(1, min(self.config.max_batch,
+                          self.config.batch_tokens // max(1, int(bucket_n))))
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "SlideService":
+        if self._worker is None:
+            self._stop.clear()
+            self.heartbeat.start()
+            self._worker = threading.Thread(
+                target=self._run, daemon=True, name="serve-dispatch"
+            )
+            self._worker.start()
+        return self
+
+    def __enter__(self) -> "SlideService":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close(status="error" if exc_type else "ok")
+
+    # -- request side -----------------------------------------------------
+    def submit(self, slide_id: str, feats: np.ndarray,
+               coords: Optional[np.ndarray] = None):
+        """Enqueue one slide; returns a ``Future`` resolving to the
+        forward output's row for this slide (host numpy pytree).
+        Cache hits and in-flight duplicates resolve without a forward
+        pass (``cache_hit`` event either way)."""
+        if self._closed:
+            raise RuntimeError("SlideService is closed")
+        feats = np.asarray(feats, np.float32)
+        if feats.ndim != 2:
+            raise ValueError(f"feats must be [N, D], got {feats.shape}")
+        if feats.shape[1] != self.config.feature_dim:
+            raise ValueError(
+                f"feature dim {feats.shape[1]} != configured "
+                f"{self.config.feature_dim}"
+            )
+        key = content_key(feats, coords, extra=self.identity)
+        # cache probe, pending probe and enqueue are ONE atomic section:
+        # probing the cache outside the lock would let a dispatch finish
+        # in the gap (cache.put + _pending.pop) and this request re-run
+        # a full forward for bytes already sitting in the cache
+        with self._lock:
+            if self._closed:
+                # re-checked under the lock: a submit racing close()
+                # past the unlocked check above must not enqueue onto a
+                # service whose orphan sweep already ran (its future
+                # would never resolve)
+                raise RuntimeError("SlideService is closed")
+            pending = self._pending.get(key)
+            if pending is not None:
+                # identical content already awaiting dispatch: join it
+                # (probed BEFORE the cache so a join never counts as a
+                # cache miss in the stats the hit-rate trend is fed by)
+                self.inflight_joins += 1
+                self.runlog.event(
+                    "cache_hit", slide_id=slide_id, key=key[:16],
+                    n_tiles=int(feats.shape[0]), inflight=True,
+                )
+                return pending.future
+            cached = self.cache.get(key)
+            if cached is not None:
+                from concurrent.futures import Future
+
+                fut: Future = Future()
+                fut.set_result(cached)
+                self.runlog.event(
+                    "cache_hit", slide_id=slide_id, key=key[:16],
+                    n_tiles=int(feats.shape[0]), inflight=False,
+                )
+                return fut
+            req = SlideRequest(
+                slide_id, feats, coords,
+                bucket_n=self.ladder.bucket_for(feats.shape[0]),
+                cache_key=key,
+            )
+            self._pending[key] = req
+        self.queue.submit(req)
+        return req.future
+
+    # -- dispatch side ----------------------------------------------------
+    def step(self, *, drain: bool = False,
+             now: Optional[float] = None) -> int:
+        """Process at most ONE ready batch on the calling thread;
+        returns the number of slides served. Drivers in sync mode call
+        this in a loop; the worker thread calls it forever."""
+        batch = self.queue.pop_ready(now=now, drain=drain)
+        if not batch:
+            return 0
+        bucket_n = batch[0].bucket_n
+        capacity = self.capacity_for(bucket_n)
+        try:
+            with span("serve.dispatch", self.runlog, fence=True,
+                      bucket=bucket_n, slides=len(batch)) as sp:
+                embeds, coords, mask = assemble_batch(
+                    [(r.feats, r.coords) for r in batch], bucket_n, capacity,
+                    feature_dim=self.config.feature_dim,
+                )
+                out = self.aot(embeds, coords, mask)
+                sp.fence(out)
+            # host-side conversion and scatter stay INSIDE the poisoned-
+            # batch containment: a MemoryError copying rows out of a big
+            # batch must fail these futures too, not strand their waiters
+            out = _tree_np(out)
+            for i, req in enumerate(batch):
+                result = _to_host(out, i)
+                if req.cache_key is not None:
+                    self.cache.put(req.cache_key, result)
+                    with self._lock:
+                        self._pending.pop(req.cache_key, None)
+                if not req.future.done():
+                    req.future.set_result(result)
+        except Exception as e:
+            # a poisoned batch fails ITS futures, not the service: the
+            # batch was consumed from the queue, so waiters must hear
+            # about it here or hang forever
+            self.runlog.error("serve.dispatch", e)
+            with self._lock:
+                for req in batch:
+                    if req.cache_key is not None:
+                        self._pending.pop(req.cache_key, None)
+            for req in batch:
+                if not req.future.done():
+                    req.future.set_exception(e)
+            return 0
+        self.dispatch_count += 1
+        self.slides_served += len(batch)
+        self.per_bucket_dispatches[bucket_n] = (
+            self.per_bucket_dispatches.get(bucket_n, 0) + 1
+        )
+        waits = [round(r.wait_s(), 6) for r in batch]
+        self.runlog.event(
+            "serve_dispatch", bucket=bucket_n, slides=len(batch),
+            capacity=capacity, occupancy=round(len(batch) / capacity, 4),
+            queue_wait_s=waits, wall_s=sp.dur_s,
+            source=self.aot.sources.get((capacity, bucket_n), "?"),
+        )
+        # dispatch walls also ride step events so the anomaly engine's
+        # per-bucket spike/dip baselines cover serving for free
+        self.runlog.step(
+            self.dispatch_count, wall_s=sp.dur_s, synced=True,
+            bucket=str(bucket_n), slides=len(batch),
+        )
+        self.heartbeat.beat(self.dispatch_count)
+        return len(batch)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if self.step():
+                    continue
+                deadline = self.queue.next_deadline_s()
+                timeout = 0.05 if deadline is None else max(
+                    min(deadline, 0.05), 0.001
+                )
+                self.queue.wait_for_work(timeout=timeout)
+            except Exception as e:  # a poisoned batch must not kill serving
+                self.runlog.error("serve.dispatch", e)
+
+    def drain(self) -> int:
+        """Dispatch everything still queued on the CALLING thread (sync
+        mode / shutdown flush); returns slides served."""
+        served = 0
+        while True:
+            n = self.step(drain=True)
+            if n == 0 and self.queue.pending() == 0:
+                return served
+            served += n
+
+    # -- summaries --------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        cache = self.cache.stats()
+        return {
+            "dispatches": self.dispatch_count,
+            "slides_served": self.slides_served,
+            "inflight_joins": self.inflight_joins,
+            "buckets_used": len(self.per_bucket_dispatches),
+            "per_bucket_dispatches": {
+                str(k): v
+                for k, v in sorted(self.per_bucket_dispatches.items())
+            },
+            "compiled_executables": self.aot.compiled_count,
+            "loaded_executables": self.aot.loaded_count,
+            "unexpected_retraces": len(self.watchdog.unexpected_retraces),
+            "compile_seconds_total": self.watchdog.compile_seconds_total(),
+            "cache": cache,
+        }
+
+    def close(self, status: str = "ok") -> None:
+        if self._closed:
+            return
+        if self._worker is not None:
+            self._stop.set()
+            # join until the worker is DEAD, not a fixed grace:
+            # proceeding into drain() while the worker is mid-step()
+            # would put two threads inside the AOT cache / watchdog /
+            # dispatch counters, which are single-dispatch-thread by
+            # design. A flagship compile can exceed any fixed grace;
+            # the worker always exits after its current batch (_stop
+            # is set and queue waits are <= 50 ms), and a truly hung
+            # forward is the stall detector's job — echoed here so the
+            # wait is visible either way.
+            waited = 0.0
+            while True:
+                self._worker.join(timeout=10.0)
+                if not self._worker.is_alive():
+                    break
+                waited += 10.0
+                self.runlog.echo(
+                    "[serve] close(): dispatch worker still mid-batch "
+                    f"after {waited:.0f}s; waiting"
+                )
+            self._worker = None
+        try:
+            self.drain()
+        finally:
+            self.heartbeat.stop()
+            # _closed flips INSIDE the same locked section as the orphan
+            # sweep, so no submit can slip between the two; orphaned
+            # futures (submitters gone, service closing) fail loudly
+            # rather than hang their waiters forever
+            with self._lock:
+                self._closed = True
+                orphans = list(self._pending.values())
+                self._pending.clear()
+            for req in orphans:
+                if not req.future.done():
+                    req.future.set_exception(
+                        RuntimeError("SlideService closed before dispatch")
+                    )
+            if self._owns_runlog:
+                self.runlog.run_end(status=status, **{
+                    k: v for k, v in self.stats().items()
+                    if not isinstance(v, dict)
+                })

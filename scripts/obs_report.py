@@ -253,6 +253,68 @@ def render(events: List[dict], out=None) -> int:
             )
         w("\n")
 
+    # -- serving (gigapath_tpu.serve: dispatch/cache telemetry) -----------
+    serves = by_kind.get("serve_dispatch", [])
+    cache_hits = by_kind.get("cache_hit", [])
+    if serves or cache_hits:
+        w("== serving ==\n")
+        slides_total = sum(int(ev.get("slides", 0)) for ev in serves)
+        occ = sorted(
+            float(ev["occupancy"]) for ev in serves
+            if ev.get("occupancy") is not None
+        )
+        w(f"dispatches: {len(serves)}, {slides_total} slide(s) served")
+        if occ:
+            w(
+                "; batch occupancy p50 {:.2f} p90 {:.2f} min {:.2f}".format(
+                    percentile(occ, 0.50), percentile(occ, 0.90), occ[0]
+                )
+            )
+        w("\n")
+        waits = sorted(
+            float(wv)
+            for ev in serves
+            for wv in (ev.get("queue_wait_s") or [])
+        )
+        if waits:
+            w(
+                "queue wait: p50 {} p90 {} max {}\n".format(
+                    _fmt_s(percentile(waits, 0.50)),
+                    _fmt_s(percentile(waits, 0.90)),
+                    _fmt_s(waits[-1]),
+                )
+            )
+        requests = slides_total + len(cache_hits)
+        if requests:
+            inflight = sum(1 for ev in cache_hits if ev.get("inflight"))
+            w(
+                f"cache: {len(cache_hits)} hit(s) / {requests} request(s) "
+                f"({100.0 * len(cache_hits) / requests:.1f}% hit rate"
+                + (f"; {inflight} in-flight join(s)" if inflight else "")
+                + ")\n"
+            )
+        if serves:
+            w("per-bucket dispatch table (bucket / dispatches / slides / "
+              "mean occupancy / sources):\n")
+            by_bucket: Dict[int, List[dict]] = {}
+            for ev in serves:
+                by_bucket.setdefault(int(ev.get("bucket", 0)), []).append(ev)
+            for bucket in sorted(by_bucket):
+                evs = by_bucket[bucket]
+                n_slides = sum(int(ev.get("slides", 0)) for ev in evs)
+                occs = [
+                    float(ev["occupancy"]) for ev in evs
+                    if ev.get("occupancy") is not None
+                ]
+                sources = sorted({str(ev.get("source", "?")) for ev in evs})
+                mean_occ = sum(occs) / len(occs) if occs else float("nan")
+                w(
+                    f"  {bucket}: {len(evs)} dispatch(es), {n_slides} "
+                    f"slide(s), occupancy {mean_occ:.2f} "
+                    f"[{','.join(sources)}]\n"
+                )
+        w("\n")
+
     # -- flight dumps (records only present in flight-*.jsonl files) ------
     metas = by_kind.get("flight_meta", [])
     if metas:
@@ -339,6 +401,20 @@ def selftest() -> int:
             log.step(i, wall_s=0.01, synced=True, loss=1.0 / (i + 1))
         log.step(25, wall_s=0.9, synced=True)  # spike vs the 0.01 EWMA
         log.eval_event(24, auroc=0.99)
+        # serving telemetry (gigapath_tpu.serve): dispatches + cache hits
+        for i, (slides, source) in enumerate(
+            [(3, "compiled"), (4, "artifact"), (2, "artifact")]
+        ):
+            log.event(
+                "serve_dispatch", bucket=256 if i < 2 else 512,
+                slides=slides, capacity=4, occupancy=slides / 4.0,
+                queue_wait_s=[0.01 * (j + 1) for j in range(slides)],
+                wall_s=0.05, source=source,
+            )
+        log.event("cache_hit", slide_id="s0", key="abcd", n_tiles=100,
+                  inflight=False)
+        log.event("cache_hit", slide_id="s1", key="abcd", n_tiles=100,
+                  inflight=True)
         with Heartbeat(log, interval_s=0.05, stall_after_s=0.15,
                        name="selftest") as hb:
             hb.beat(24)
@@ -377,7 +453,11 @@ def selftest() -> int:
 
     required = ("== throughput ==", "== compile ==", "== timeline ==",
                 "retrace table", "STALL", "p50", "== spans ==",
-                "== anomalies ==", "STEP_TIME_SPIKE", "flight ->")
+                "== anomalies ==", "STEP_TIME_SPIKE", "flight ->",
+                "== serving ==", "batch occupancy", "queue wait",
+                "2 hit(s) / 11 request(s)", "1 in-flight join(s)",
+                "per-bucket dispatch table", "256: 2 dispatch(es)",
+                "512: 1 dispatch(es)")
     missing = [s for s in required if s not in text]
     required_fl = ("== flight dumps ==", "reason=step_time_spike")
     missing_fl = [s for s in required_fl if s not in text_fl]

@@ -103,6 +103,10 @@ def cmd_ingest(args) -> int:
                                    args.label,
                                    source=os.path.basename(args.multichip),
                                    force=args.force)
+        if args.serve:
+            history.fold_serve(doc, _load_json(args.serve), args.label,
+                               source=os.path.basename(args.serve),
+                               force=args.force)
         for path in args.ledger or []:
             history.fold_ledger(doc, _load_json(path), args.label,
                                 source=os.path.basename(path),
@@ -222,6 +226,39 @@ def selftest() -> int:
                   "undetected", file=sys.stderr)
             return 1
 
+    # serve_smoke folding: a CPU point is stale (keys present, trend
+    # blind to it); on-chip points trend, and a throughput dip flips
+    serve_doc = history.new_history()
+    history.fold_serve(
+        serve_doc,
+        {"rc": 0, "parsed": {"backend": "cpu", "slides_per_sec": 3.0,
+                             "cache_hit_rate": 1.0}}, "r01")
+    point = serve_doc["entries"]["serve|smoke"]["points"][0]
+    if not point.get("stale") or "slides_per_sec" not in point["metrics"]:
+        print("perf_history selftest FAILED: CPU serve point must be "
+              "stale WITH metric keys", file=sys.stderr)
+        return 1
+    history.fold_serve(
+        serve_doc,
+        {"rc": 0, "parsed": {"backend": "tpu", "slides_per_sec": 100.0,
+                             "occupancy_mean": 0.9}}, "r02")
+    history.fold_serve(
+        serve_doc,
+        {"rc": 0, "parsed": {"backend": "tpu", "slides_per_sec": 50.0,
+                             "occupancy_mean": 0.9}}, "r03")
+    sv = history.trend_verdict(serve_doc)
+    if sv["decision"]["ok"] or not any(
+        "slides_per_sec 100.0" in line for line in sv["decision"]["regressed"]
+    ):
+        print("perf_history selftest FAILED: serve throughput dip "
+              "undetected", file=sys.stderr)
+        render(sv, out=sys.stderr)
+        return 1
+    if any("r01" in line for line in sv["decision"]["regressed"]):
+        print("perf_history selftest FAILED: stale CPU serve point moved "
+              "the trend", file=sys.stderr)
+        return 1
+
     # append-only: reusing a label without force must refuse
     try:
         history.fold_bench(
@@ -288,6 +325,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_ing.add_argument("--bench", default=None, help="BENCH snapshot JSON")
     p_ing.add_argument("--multichip", default=None,
                        help="MULTICHIP snapshot JSON")
+    p_ing.add_argument("--serve", default=None,
+                       help="serve_smoke snapshot JSON "
+                       "(scripts/serve_smoke.py --json output)")
     p_ing.add_argument("--ledger", action="append", default=None,
                        help="per-run ledger JSON (repeatable)")
     p_ing.add_argument("--force", action="store_true",

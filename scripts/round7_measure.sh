@@ -44,3 +44,17 @@ tail -4 /tmp/r7_slice.log
 GIGAPATH_RING_ATTN=1 GIGAPATH_STREAMING_FUSION=1 GIGAPATH_STREAM_FUSION=1 \
   timeout 2400 python scripts/long_context_smoke.py > /tmp/r7_envelope.log 2>&1
 tail -8 /tmp/r7_envelope.log
+
+# 7. the serving stack at flagship shape (ROADMAP item 1): bucketed AOT
+#    executables + continuous batching + content-hash cache, hard
+#    assertions baked in (zero mid-serve retraces, warm restart loads
+#    artifacts, repeats cache-served). On-chip numbers move the
+#    serve|smoke trend; the committed CPU point (r06-cpu) is stale
+#    provenance only.
+timeout 2400 python scripts/serve_smoke.py \
+  --arch gigapath_slide_enc12l768d --input-dim 1536 --latent-dim 768 \
+  --bucket-min 1024 --bucket-align 128 --bucket-max 131072 \
+  --json SERVE_SMOKE.json > /tmp/r7_serve.log 2>&1
+tail -3 /tmp/r7_serve.log
+python scripts/perf_history.py ingest --label r07 --serve SERVE_SMOKE.json \
+  || true

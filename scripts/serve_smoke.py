@@ -1,0 +1,318 @@
+#!/usr/bin/env python
+"""Serving-stack smoke: N concurrent synthetic slides of mixed lengths
+through the full queue -> bucket -> AOT -> cache path (ROADMAP item 1's
+acceptance driver).
+
+    python scripts/serve_smoke.py                       # 32 slides, 8 lengths, tiny arch
+    python scripts/serve_smoke.py --json SERVE_SMOKE.json
+    python scripts/serve_smoke.py --arch gigapath_slide_enc12l768d \
+        --input-dim 1536 --latent-dim 768 --bucket-min 1024   # flagship (chip day)
+
+Three phases, each with hard assertions (exit 1 + structured JSON on
+violation, bench.py-style):
+
+1. **cold serve**: ``--slides`` synthetic slides of ``--distinct-lengths``
+   distinct tile counts submitted from ``--threads`` concurrent
+   threads; the service must compile exactly ONE executable per bucket
+   touched (watchdog-pinned: zero unexpected retraces, compile count ==
+   buckets used).
+2. **repeat serve**: every distinct slide re-submitted under a new
+   request id; the dispatch count must NOT move — repeats are served
+   from the content-hash cache without a forward pass.
+3. **warm restart** (skip with ``--no-warm-restart``): a fresh service
+   over the same artifact dir serves one slide per bucket with ZERO
+   compiles — every executable loads from its persisted artifact.
+
+Emits one JSON line (stdout; ``--json`` also writes a file) whose
+metric keys (`slides_per_sec`, `occupancy_mean`, `cache_hit_rate`,
+`queue_wait_p50_s`, ...) are what ``scripts/perf_history.py ingest
+--serve`` folds into PERF_HISTORY.json — CPU runs land as stale points
+(keys without trend weight) until a chip round measures them for real.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from obs_report import percentile  # noqa: E402  (scripts/ is on sys.path)
+
+
+def make_slides(n_slides: int, lengths: List[int], dim: int, seed: int):
+    """(slide_id, feats [N, D], coords [N, 2]) per slide, lengths cycled."""
+    rng = np.random.default_rng(seed)
+    slides = []
+    for i in range(n_slides):
+        n = lengths[i % len(lengths)]
+        slides.append((
+            f"slide_{i:04d}_n{n}",
+            rng.normal(size=(n, dim)).astype(np.float32),
+            rng.uniform(0, 25000, (n, 2)).astype(np.float32),
+        ))
+    return slides
+
+
+def pick_lengths(ladder, k: int) -> List[int]:
+    """k distinct tile counts spread over the ladder: rung boundaries
+    (exact fits), off-rung interiors, and the N=1 edge."""
+    rungs = list(ladder.rungs)
+    lengths = [1, rungs[0]]                      # the edge + an exact fit
+    for rung, prev in zip(rungs[1:], rungs[:-1]):
+        lengths.append(prev + max(1, (rung - prev) // 3))  # interior
+        lengths.append(rung)                                # boundary
+    # dedup, keep order, then cycle-extend if the ladder is too short
+    seen, out = set(), []
+    for n in lengths:
+        if n not in seen:
+            seen.add(n)
+            out.append(n)
+    i = 0
+    max_tries = 8 * (k + len(out))  # bounded: fall through when the
+    while len(out) < k and i < max_tries:   # neighborhood runs dry
+        cand = out[1 + (i % max(len(out) - 1, 1))] - 1 - i // len(out)
+        i += 1
+        if cand >= 1 and cand not in seen:
+            seen.add(cand)
+            out.append(cand)
+    if len(out) < k:
+        # exhaustive sweep of every representable length, then give a
+        # real error instead of looping forever on an impossible ask
+        for cand in range(1, rungs[-1] + 1):
+            if len(out) >= k:
+                break
+            if cand not in seen:
+                seen.add(cand)
+                out.append(cand)
+    if len(out) < k:
+        raise ValueError(
+            f"ladder {rungs} only admits {rungs[-1]} distinct tile "
+            f"counts; cannot pick {k} distinct lengths"
+        )
+    return out[:k]
+
+
+def run(args) -> dict:
+    import jax
+
+    from gigapath_tpu.inference import load_model
+    from gigapath_tpu.serve import ServeConfig, SlideService
+
+    out_dir = args.out_dir or tempfile.mkdtemp(prefix="serve_smoke_")
+    artifact_dir = args.artifact_dir or os.path.join(out_dir, "artifacts")
+    model, params = load_model(
+        "", input_dim=args.input_dim, latent_dim=args.latent_dim,
+        feat_layer=args.feat_layer, n_classes=args.n_classes,
+        model_arch=args.arch,
+    )
+
+    def forward(p, embeds, coords, pad_mask):
+        return model.apply({"params": p}, embeds, coords,
+                           pad_mask=pad_mask, deterministic=True)
+
+    config = ServeConfig.from_env(
+        max_batch=args.max_batch, max_wait_s=args.max_wait_s,
+        bucket_min=args.bucket_min, bucket_growth=args.bucket_growth,
+        bucket_max=args.bucket_max, bucket_align=args.bucket_align,
+        feature_dim=args.input_dim, artifact_dir=artifact_dir,
+    )
+    identity = f"{args.arch}|{args.feat_layer}|{args.n_classes}"
+    service = SlideService(forward, params, config=config,
+                           out_dir=out_dir, identity=identity)
+    lengths = pick_lengths(service.ladder, args.distinct_lengths)
+    slides = make_slides(args.slides, lengths, args.input_dim, args.seed)
+    expected_buckets = sorted({
+        service.ladder.bucket_for(f.shape[0]) for _, f, _ in slides
+    })
+
+    payload: dict = {
+        "metric": "serve_smoke",
+        "backend": jax.default_backend(),
+        "jax_version": jax.__version__,
+        "arch": args.arch,
+        "slides": len(slides),
+        "distinct_lengths": len(lengths),
+        "lengths": lengths,
+        "expected_buckets": expected_buckets,
+        "max_batch": args.max_batch,
+        "obs": getattr(service.runlog, "path", None),
+    }
+
+    # -- phase 1: cold serve, concurrent submitters -----------------------
+    with service:
+        t0 = time.monotonic()
+        with ThreadPoolExecutor(max_workers=args.threads) as pool:
+            futures = list(pool.map(
+                lambda s: service.submit(*s), slides
+            ))
+        results = [f.result(timeout=args.timeout_s) for f in futures]
+        jax.block_until_ready(results)  # host numpy already; explicit fence
+        cold_s = time.monotonic() - t0
+
+        stats = service.stats()
+        payload.update(
+            cold_wall_s=round(cold_s, 4),
+            slides_per_sec=round(len(slides) / cold_s, 4),
+            dispatches=stats["dispatches"],
+            buckets_used=stats["buckets_used"],
+            compiled_executables=stats["compiled_executables"],
+            unexpected_retraces=stats["unexpected_retraces"],
+            compile_seconds_total=round(stats["compile_seconds_total"], 4),
+        )
+        if stats["unexpected_retraces"]:
+            raise AssertionError(
+                f"mid-serve retrace: {service.watchdog.unexpected_retraces}"
+            )
+        if stats["compiled_executables"] != len(expected_buckets):
+            raise AssertionError(
+                f"compiled {stats['compiled_executables']} executables for "
+                f"{len(expected_buckets)} buckets ({expected_buckets})"
+            )
+
+        # -- phase 2: repeats must be cache hits, not dispatches ----------
+        dispatches_before = service.dispatch_count
+        repeats = [
+            (f"repeat_{sid}", feats, coords)
+            for sid, feats, coords in slides[: args.repeats]
+        ]
+        repeat_futs = [service.submit(*s) for s in repeats]
+        repeat_results = [f.result(timeout=args.timeout_s)
+                          for f in repeat_futs]
+        if service.dispatch_count != dispatches_before:
+            raise AssertionError(
+                f"repeated slides triggered "
+                f"{service.dispatch_count - dispatches_before} dispatch(es) "
+                "— the content-hash cache failed to short-circuit"
+            )
+        for i, r_new in enumerate(repeat_results):
+            if not np.allclose(
+                np.asarray(results[i]), np.asarray(r_new), atol=0.0
+            ):
+                raise AssertionError("cached result != computed result")
+        cache = service.cache.stats()
+        payload.update(
+            repeats=len(repeats),
+            cache_hits=cache["hits"],
+            cache_hit_rate=round(
+                cache["hits"] / (cache["hits"] + cache["misses"]), 4
+            ),
+        )
+
+        # queue-wait / occupancy distributions out of the run artifact
+        waits: List[float] = []
+        occs: List[float] = []
+        run_path = getattr(service.runlog, "path", None)
+        if run_path and os.path.exists(run_path):
+            with open(run_path, encoding="utf-8") as fh:
+                for line in fh:
+                    try:
+                        ev = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if ev.get("kind") == "serve_dispatch":
+                        waits.extend(ev.get("queue_wait_s") or [])
+                        if ev.get("occupancy") is not None:
+                            occs.append(float(ev["occupancy"]))
+        waits.sort()
+        payload.update(
+            occupancy_mean=round(sum(occs) / len(occs), 4) if occs else None,
+            queue_wait_p50_s=percentile(waits, 0.50) if waits else None,
+            queue_wait_p90_s=percentile(waits, 0.90) if waits else None,
+        )
+
+    # -- phase 3: warm restart loads artifacts, compiles nothing ----------
+    if not args.no_warm_restart:
+        warm = SlideService(forward, params, config=config,
+                            out_dir=out_dir, identity=identity)
+        try:
+            per_bucket = {}
+            for sid, feats, coords in slides:
+                per_bucket.setdefault(
+                    warm.ladder.bucket_for(feats.shape[0]),
+                    (sid, feats, coords),
+                )
+            futs = [warm.submit(f"warm_{sid}", feats, coords)
+                    for sid, feats, coords in per_bucket.values()]
+            warm.drain()
+            for f in futs:
+                f.result(timeout=args.timeout_s)
+            wstats = warm.stats()
+            payload.update(
+                warm_loaded_executables=wstats["loaded_executables"],
+                warm_compiled_executables=wstats["compiled_executables"],
+            )
+            if wstats["compiled_executables"] != 0:
+                raise AssertionError(
+                    f"warm restart compiled "
+                    f"{wstats['compiled_executables']} executable(s) — "
+                    "cold start must be an artifact load, not a retrace"
+                )
+            if wstats["loaded_executables"] != len(per_bucket):
+                raise AssertionError(
+                    f"warm restart loaded {wstats['loaded_executables']} of "
+                    f"{len(per_bucket)} persisted executables"
+                )
+        finally:
+            warm.close()
+    return payload
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python scripts/serve_smoke.py",
+        description="Concurrent synthetic slides through the serving stack",
+    )
+    ap.add_argument("--slides", type=int, default=32)
+    ap.add_argument("--distinct-lengths", type=int, default=8)
+    ap.add_argument("--repeats", type=int, default=8,
+                    help="re-submitted slides that must be cache hits")
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--max-wait-s", type=float, default=0.05)
+    ap.add_argument("--bucket-min", type=int, default=32)
+    ap.add_argument("--bucket-growth", type=float, default=2.0)
+    ap.add_argument("--bucket-max", type=int, default=512)
+    ap.add_argument("--bucket-align", type=int, default=32,
+                    help="tiny-arch default; use 128 for flagship shapes")
+    ap.add_argument("--arch", default="gigapath_slide_enc_tiny")
+    ap.add_argument("--input-dim", type=int, default=16)
+    ap.add_argument("--latent-dim", type=int, default=32)
+    ap.add_argument("--feat-layer", default="1")
+    ap.add_argument("--n-classes", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--timeout-s", type=float, default=300.0)
+    ap.add_argument("--out-dir", default=None,
+                    help="obs + artifact root (default: fresh temp dir)")
+    ap.add_argument("--artifact-dir", default=None,
+                    help="persisted-executable dir (default: <out>/artifacts)")
+    ap.add_argument("--no-warm-restart", action="store_true")
+    ap.add_argument("--json", default=None, help="also write the payload here")
+    args = ap.parse_args(argv)
+
+    try:
+        payload = run(args)
+        payload["rc"] = 0
+    except Exception as e:
+        payload = {
+            "metric": "serve_smoke", "rc": 1,
+            "error": f"{type(e).__name__}: {e}",
+        }
+    line = json.dumps(payload, sort_keys=True)
+    print(line)
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as fh:
+            fh.write(line + "\n")
+    return payload["rc"]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

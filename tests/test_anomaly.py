@@ -611,7 +611,11 @@ def _run_inference_driver(tmp_path, monkeypatch, stall_slide=2,
     try:
         out_csv = str(tmp_path / "out" / "predictions.csv")
         os.makedirs(os.path.dirname(out_csv), exist_ok=True)
-        df = inference.run_inference(model, params, feat_dir, out_csv)
+        # exact-shape path: this acceptance pair pins the slide-at-a-time
+        # driver's compile accounting (the bucketed serving path has its
+        # own compile-count pins in tests/test_serve.py)
+        df = inference.run_inference(model, params, feat_dir, out_csv,
+                                     use_buckets=False)
     finally:
         jax.config.update("jax_log_compiles", False)
         logger.setLevel(prev_level)

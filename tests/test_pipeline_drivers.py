@@ -217,11 +217,17 @@ class TestPretrain:
 
 
 class TestInferenceDriver:
-    def test_feature_file_inference(self, tmp_path, rng):
+    def test_feature_file_inference(self, tmp_path, rng, monkeypatch):
+        """Default (bucketed serving) path vs the --no-buckets exact
+        oracle: same CSV verdicts either way."""
         import torch
 
         from gigapath_tpu.inference import load_model, run_inference
 
+        # small serving buckets so the tier-1 compile stays tiny
+        monkeypatch.setenv("GIGAPATH_SERVE_BUCKET_MIN", "16")
+        monkeypatch.setenv("GIGAPATH_SERVE_BUCKET_ALIGN", "16")
+        torch.manual_seed(0)
         for i in range(3):
             torch.save(
                 torch.randn(10, 16), tmp_path / f"slide{i}_features.pt"
@@ -231,7 +237,53 @@ class TestInferenceDriver:
             model_arch="gigapath_slide_enc_tiny",
         )
         out_csv = tmp_path / "preds.csv"
-        df = run_inference(model, params, str(tmp_path), str(out_csv))
+        df = run_inference(model, params, str(tmp_path), str(out_csv),
+                           batch_size=4)
         assert len(df) == 3
         assert set(df.columns) == {"slide_id", "predicted_label", "confidence"}
         assert ((df["confidence"] >= 0.0) & (df["confidence"] <= 1.0)).all()
+
+        exact = run_inference(
+            model, params, str(tmp_path), str(tmp_path / "exact.csv"),
+            use_buckets=False,
+        )
+        assert list(exact["slide_id"]) == list(df["slide_id"])
+        assert list(exact["predicted_label"]) == list(df["predicted_label"])
+        # the model is bf16 (load_model's serving default): padded vs
+        # exact shapes round differently at bf16 resolution; f32 parity
+        # at 1e-5 is pinned in tests/test_serve.py
+        np.testing.assert_allclose(
+            exact["confidence"], df["confidence"], atol=5e-3
+        )
+
+    def test_oversized_slide_falls_back_to_exact_shape(self, tmp_path,
+                                                       monkeypatch):
+        """A slide above the ladder's top rung must NOT abort the run:
+        it routes through the exact-shape fallback while the rest of the
+        batch serves bucketed."""
+        import torch
+
+        from gigapath_tpu.inference import load_model, run_inference
+
+        monkeypatch.setenv("GIGAPATH_SERVE_BUCKET_MIN", "16")
+        monkeypatch.setenv("GIGAPATH_SERVE_BUCKET_ALIGN", "16")
+        monkeypatch.setenv("GIGAPATH_SERVE_BUCKET_MAX", "16")
+        torch.manual_seed(0)
+        torch.save(torch.randn(10, 16), tmp_path / "small_features.pt")
+        torch.save(torch.randn(40, 16), tmp_path / "toobig_features.pt")
+        model, params = load_model(
+            "", input_dim=16, latent_dim=32, feat_layer="1", n_classes=2,
+            model_arch="gigapath_slide_enc_tiny",
+        )
+        df = run_inference(model, params, str(tmp_path),
+                           str(tmp_path / "preds.csv"), batch_size=2)
+        assert sorted(df["slide_id"]) == ["small", "toobig"]
+
+        exact = run_inference(
+            model, params, str(tmp_path), str(tmp_path / "exact.csv"),
+            use_buckets=False,
+        )
+        assert list(exact["predicted_label"]) == list(df["predicted_label"])
+        np.testing.assert_allclose(
+            exact["confidence"], df["confidence"], atol=5e-3
+        )

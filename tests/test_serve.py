@@ -1,0 +1,713 @@
+"""Serving stack (gigapath_tpu/serve): bucket ladder, continuous-batch
+coalescer, content-hash cache, per-bucket AOT executables, and the full
+queue -> bucket -> AOT -> cache service end to end on CPU (ISSUE 7
+acceptance).
+
+The pinned invariants:
+
+- **padding parity**: a bucketed padded forward (key-padding mask) ==
+  the exact-shape forward at 1e-5, across ragged tile counts including
+  the bucket-boundary N and N=1;
+- **compile count**: serving M slides of K distinct lengths over J
+  buckets compiles exactly J executables — watchdog-counted AND
+  XLA-layer-counted — and a warm restart from persisted artifacts
+  compiles ZERO (the cold-start acceptance of ROADMAP item 1);
+- **cache short-circuit**: repeated slides resolve with no forward pass
+  (dispatch-count pinned).
+"""
+
+import glob
+import io
+import json
+import logging
+import os
+import sys
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from gigapath_tpu.serve import (
+    BucketLadder,
+    EmbeddingCache,
+    RequestQueue,
+    ServeConfig,
+    SlideRequest,
+    SlideService,
+    assemble_batch,
+    content_key,
+    pad_slide,
+)
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "scripts"),
+)
+
+
+# ---------------------------------------------------------------------------
+# bucket ladder
+# ---------------------------------------------------------------------------
+
+class TestBucketLadder:
+    def test_geometric_rungs_aligned_and_increasing(self):
+        ladder = BucketLadder(n_min=1024, growth=2.0, n_max=1 << 20)
+        rungs = ladder.rungs
+        assert rungs[0] == 1024 and rungs[-1] >= 1 << 20
+        assert all(r % 128 == 0 for r in rungs)
+        assert all(b > a for a, b in zip(rungs, rungs[1:]))
+        # geometric: a small fixed set, not one per length
+        assert len(rungs) <= 12
+
+    def test_bucket_for_boundaries(self):
+        ladder = BucketLadder(n_min=16, growth=2.0, n_max=64, align=16)
+        assert ladder.rungs == (16, 32, 64)
+        assert ladder.bucket_for(1) == 16
+        assert ladder.bucket_for(16) == 16      # exact fit pays no padding
+        assert ladder.bucket_for(17) == 32
+        assert ladder.bucket_for(64) == 64
+        with pytest.raises(ValueError):
+            ladder.bucket_for(65)
+        with pytest.raises(ValueError):
+            ladder.bucket_for(0)
+
+    def test_constructor_validation(self):
+        with pytest.raises(ValueError):
+            BucketLadder(n_min=0)
+        with pytest.raises(ValueError):
+            BucketLadder(growth=1.0)
+        with pytest.raises(ValueError):
+            BucketLadder(n_min=100, n_max=50)
+
+    def test_pad_slide_and_mask(self, rng):
+        feats = rng.normal(size=(5, 8)).astype(np.float32)
+        coords = rng.uniform(0, 100, (5, 2)).astype(np.float32)
+        f, c, m = pad_slide(feats, coords, 16)
+        assert f.shape == (16, 8) and c.shape == (16, 2) and m.shape == (16,)
+        np.testing.assert_array_equal(f[:5], feats)
+        assert not f[5:].any() and not c[5:].any()
+        assert m[:5].all() and not m[5:].any()
+        # no coords -> zeros, mask unchanged
+        f2, c2, m2 = pad_slide(feats, None, 16)
+        assert not c2.any() and m2.sum() == 5
+        with pytest.raises(ValueError):
+            pad_slide(feats, coords, 4)  # does not fit
+
+    def test_assemble_batch_pads_batch_dim_with_masked_rows(self, rng):
+        slides = [
+            (rng.normal(size=(n, 8)).astype(np.float32), None)
+            for n in (3, 7)
+        ]
+        embeds, coords, mask = assemble_batch(slides, 16, capacity=4)
+        assert embeds.shape == (4, 16, 8)
+        assert mask[0].sum() == 3 and mask[1].sum() == 7
+        assert not mask[2:].any() and not embeds[2:].any()
+        with pytest.raises(ValueError):
+            assemble_batch(slides, 16, capacity=1)
+        with pytest.raises(ValueError):
+            assemble_batch([], 16, capacity=2)  # needs feature_dim
+        e, c, m = assemble_batch([], 16, capacity=2, feature_dim=8)
+        assert e.shape == (2, 16, 8) and not m.any()
+
+
+# ---------------------------------------------------------------------------
+# request queue (continuous batching policy; deterministic clock)
+# ---------------------------------------------------------------------------
+
+def _req(n_tiles, bucket_n, t, sid="s"):
+    return SlideRequest(sid, np.zeros((n_tiles, 4), np.float32), None,
+                        bucket_n=bucket_n, t_submit=t)
+
+
+class TestRequestQueue:
+    def test_full_bucket_dispatches_immediately(self):
+        q = RequestQueue(max_batch=2, max_wait_s=10.0)
+        q.submit(_req(3, 16, t=0.0, sid="a"))
+        assert q.pop_ready(now=0.001) == []  # not full, deadline far
+        q.submit(_req(4, 16, t=0.002, sid="b"))
+        batch = q.pop_ready(now=0.003)
+        assert [r.slide_id for r in batch] == ["a", "b"]  # FIFO
+        assert q.pending() == 0
+
+    def test_deadline_dispatches_partial_batch(self):
+        q = RequestQueue(max_batch=4, max_wait_s=0.05)
+        q.submit(_req(3, 16, t=0.0))
+        assert q.pop_ready(now=0.02) == []          # young: keep waiting
+        assert q.next_deadline_s(now=0.02) == pytest.approx(0.03)
+        batch = q.pop_ready(now=0.06)               # deadline passed
+        assert len(batch) == 1
+
+    def test_batches_never_mix_buckets(self):
+        q = RequestQueue(max_batch=2, max_wait_s=0.0)
+        q.submit(_req(3, 16, t=0.0, sid="a16"))
+        q.submit(_req(20, 32, t=0.001, sid="a32"))
+        q.submit(_req(4, 16, t=0.002, sid="b16"))
+        first = q.pop_ready(now=0.01)
+        assert {r.bucket_n for r in first} == {16}
+        assert [r.slide_id for r in first] == ["a16", "b16"]
+        second = q.pop_ready(now=0.01)
+        assert [r.slide_id for r in second] == ["a32"]
+
+    def test_full_bucket_beats_deadline_and_caps_at_max_batch(self):
+        q = RequestQueue(max_batch=2, max_wait_s=0.01)
+        q.submit(_req(20, 32, t=0.0, sid="old32"))      # oldest, not full
+        q.submit(_req(3, 16, t=0.005, sid="a16"))
+        q.submit(_req(4, 16, t=0.006, sid="b16"))
+        q.submit(_req(5, 16, t=0.007, sid="c16"))
+        batch = q.pop_ready(now=0.006)  # 32-lane deadline NOT passed
+        assert [r.slide_id for r in batch] == ["a16", "b16"]  # full wins, capped
+        assert q.pending() == 2
+
+    def test_expired_deadline_beats_full_bucket(self):
+        # starvation guard: sustained hot-bucket traffic (the 16-lane
+        # refills to full between polls) must not defer an EXPIRED
+        # odd-sized head forever — max_wait_s is a bound, not a hint
+        q = RequestQueue(max_batch=2, max_wait_s=0.01)
+        q.submit(_req(20, 32, t=0.0, sid="old32"))
+        q.submit(_req(3, 16, t=0.005, sid="a16"))
+        q.submit(_req(4, 16, t=0.006, sid="b16"))
+        batch = q.pop_ready(now=0.02)  # 32-lane deadline passed
+        assert [r.slide_id for r in batch] == ["old32"]
+        # the displaced full lane dispatches on the very next poll
+        assert [r.slide_id for r in q.pop_ready(now=0.02)] == ["a16", "b16"]
+        assert q.pending() == 0
+
+    def test_drain_flushes_leftovers(self):
+        q = RequestQueue(max_batch=4, max_wait_s=100.0)
+        q.submit(_req(3, 16, t=0.0))
+        assert q.pop_ready(now=0.01) == []
+        assert len(q.pop_ready(now=0.01, drain=True)) == 1
+        assert q.pop_ready(now=0.01, drain=True) == []
+        assert q.next_deadline_s() is None
+
+    def test_wait_for_work_wakes_on_submit(self):
+        q = RequestQueue(max_batch=2, max_wait_s=1.0)
+        woke = threading.Event()
+
+        def waiter():
+            q.wait_for_work(timeout=5.0)
+            woke.set()
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        q.submit(_req(3, 16, t=0.0))
+        t.join(timeout=5.0)
+        assert woke.is_set()
+
+    def test_per_bucket_capacity_caps_big_buckets(self):
+        # token-budget clamp: a big bucket fills (and dispatches) at a
+        # smaller batch than max_batch so one dispatch never pads more
+        # tiles than the budget
+        q = RequestQueue(max_batch=4, max_wait_s=100.0,
+                         capacity_for=lambda n: 64 // n)
+        assert q.capacity(16) == 4   # min(4, 64//16=4)
+        assert q.capacity(32) == 2
+        assert q.capacity(128) == 1  # floor: never below 1
+        q.submit(_req(30, 32, t=0.0, sid="a"))
+        assert q.pop_ready(now=0.001) == []   # capacity 2: not full yet
+        q.submit(_req(31, 32, t=0.002, sid="b"))
+        q.submit(_req(29, 32, t=0.003, sid="c"))
+        batch = q.pop_ready(now=0.004)        # full at 2, capped at 2
+        assert [r.slide_id for r in batch] == ["a", "b"]
+        assert q.pending() == 1
+
+    def test_wait_for_work_parks_on_pending_but_undispatchable(self):
+        # a pending request whose deadline is still far away must PARK
+        # the worker (early-returning would busy-spin it for the whole
+        # max_wait_s window); a full lane or an expired deadline must
+        # return immediately
+        q = RequestQueue(max_batch=2, max_wait_s=10.0)
+        q.submit(_req(3, 16, t=0.0, sid="young"))
+        t0 = time.monotonic()
+        q.wait_for_work(timeout=0.2, now=0.001)  # young + not full: park
+        assert time.monotonic() - t0 >= 0.15
+        q.wait_for_work(timeout=5.0, now=11.0)   # deadline expired: immediate
+        assert time.monotonic() - t0 < 2.0
+        q.submit(_req(4, 16, t=0.002, sid="fills"))
+        t1 = time.monotonic()
+        q.wait_for_work(timeout=5.0, now=0.003)  # lane full: immediate
+        assert time.monotonic() - t1 < 2.0
+
+
+# ---------------------------------------------------------------------------
+# content-hash cache
+# ---------------------------------------------------------------------------
+
+class TestEmbeddingCache:
+    def test_content_key_is_content_not_identity(self, rng):
+        feats = rng.normal(size=(5, 4)).astype(np.float32)
+        coords = rng.uniform(0, 10, (5, 2)).astype(np.float32)
+        assert content_key(feats, coords) == content_key(
+            feats.copy(), coords.copy()
+        )
+        bumped = feats.copy()
+        bumped[0, 0] += 1e-3
+        assert content_key(feats, coords) != content_key(bumped, coords)
+        assert content_key(feats, coords) != content_key(feats, None)
+        assert content_key(feats, coords) != content_key(
+            feats, coords, extra="other-model"
+        )
+
+    def test_lru_eviction_respects_byte_budget_and_recency(self):
+        a = np.zeros(10, np.float64)  # 80 bytes each
+        cache = EmbeddingCache(budget_bytes=200)
+        cache.put("k1", a)
+        cache.put("k2", a.copy())
+        assert cache.get("k1") is not None  # refresh k1 -> k2 is LRU
+        cache.put("k3", a.copy())           # evicts k2
+        assert cache.get("k2") is None
+        assert cache.get("k1") is not None and cache.get("k3") is not None
+        assert cache.evictions == 1 and cache.bytes <= 200
+
+    def test_oversized_value_served_but_never_cached(self):
+        cache = EmbeddingCache(budget_bytes=64)
+        assert not cache.put("big", np.zeros(100, np.float64))
+        assert len(cache) == 0
+
+    def test_stats_hit_rate(self):
+        cache = EmbeddingCache()
+        cache.put("k", np.zeros(2))
+        cache.get("k")
+        cache.get("missing")
+        s = cache.stats()
+        assert s["hits"] == 1 and s["misses"] == 1
+        assert s["hit_rate"] == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# padding parity (satellite): bucketed+masked forward == exact forward
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from gigapath_tpu.models.classification_head import get_model
+
+    # f32 (dtype=None), unlike inference.load_model's bf16 default: the
+    # 1e-5 parity bar is a float32 statement (bf16 resolution is ~2^-8)
+    return get_model(
+        input_dim=16, latent_dim=32, feat_layer="1", n_classes=2,
+        model_arch="gigapath_slide_enc_tiny", dtype=None,
+    )
+
+
+def _forward_fn(model):
+    def forward(p, embeds, coords, pad_mask):
+        return model.apply({"params": p}, embeds, coords,
+                           pad_mask=pad_mask, deterministic=True)
+
+    return forward
+
+
+class TestPaddingParity:
+    @pytest.mark.parametrize("n_tiles", [1, 5, 16, 17, 31, 32])
+    def test_bucketed_logits_match_exact(self, tiny_model, rng, n_tiles):
+        """Ragged tile counts, including the bucket-boundary fits (16,
+        32 land exactly ON a rung of this ladder) and the N=1 edge."""
+        model, params = tiny_model
+        ladder = BucketLadder(n_min=16, growth=2.0, n_max=64, align=16)
+        feats = rng.normal(size=(n_tiles, 16)).astype(np.float32)
+        coords = rng.uniform(0, 25000, (n_tiles, 2)).astype(np.float32)
+
+        exact = np.asarray(model.apply(
+            {"params": params}, feats[None], coords[None],
+            deterministic=True,
+        ), np.float32)
+
+        bucket_n = ladder.bucket_for(n_tiles)
+        embeds, c, mask = assemble_batch([(feats, coords)], bucket_n,
+                                         capacity=3)
+        out = np.asarray(_forward_fn(model)(params, embeds, c, mask),
+                         np.float32)
+        np.testing.assert_allclose(out[0], exact[0], atol=1e-5)
+        # dummy batch rows stay finite (cls attends to itself) so they
+        # can never poison a dispatch
+        assert np.isfinite(out).all()
+
+    def test_batch_position_does_not_change_logits(self, tiny_model, rng):
+        """A slide's logits are independent of its batch row and of its
+        batch company — the property that makes coalescing safe."""
+        model, params = tiny_model
+        forward = _forward_fn(model)
+        a = rng.normal(size=(7, 16)).astype(np.float32)
+        ca = rng.uniform(0, 25000, (7, 2)).astype(np.float32)
+        b = rng.normal(size=(12, 16)).astype(np.float32)
+        cb = rng.uniform(0, 25000, (12, 2)).astype(np.float32)
+
+        alone = np.asarray(forward(
+            params, *assemble_batch([(a, ca)], 16, capacity=2)
+        ), np.float32)[0]
+        together = np.asarray(forward(
+            params, *assemble_batch([(b, cb), (a, ca)], 16, capacity=2)
+        ), np.float32)
+        np.testing.assert_allclose(together[1], alone, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# the service end to end (acceptance: queue -> bucket -> AOT -> cache)
+# ---------------------------------------------------------------------------
+
+class _XlaCompileCounter(logging.Handler):
+    """XLA-layer compile truth via jax_log_compiles, independent of the
+    watchdog's own accounting (same pattern as tests/test_anomaly.py)."""
+
+    def __init__(self):
+        super().__init__()
+        self.count = 0
+
+    def emit(self, record):
+        if "Finished XLA compilation of" in record.getMessage():
+            self.count += 1
+
+
+class _count_xla_compiles:
+    def __enter__(self):
+        self.counter = _XlaCompileCounter()
+        self.logger = logging.getLogger("jax._src.dispatch")
+        self.prev_level = self.logger.level
+        self.logger.addHandler(self.counter)
+        self.logger.setLevel(logging.DEBUG)
+        jax.config.update("jax_log_compiles", True)
+        return self.counter
+
+    def __exit__(self, *exc):
+        jax.config.update("jax_log_compiles", False)
+        self.logger.setLevel(self.prev_level)
+        self.logger.removeHandler(self.counter)
+
+
+def _tiny_config(tmp_path, **overrides):
+    base = dict(
+        max_batch=3, max_wait_s=0.01, bucket_min=16, bucket_growth=2.0,
+        bucket_max=64, bucket_align=16, feature_dim=16,
+        artifact_dir=str(tmp_path / "artifacts"),
+    )
+    base.update(overrides)
+    return ServeConfig(**base)
+
+
+def _make_slides(rng, lengths):
+    return [
+        (
+            f"s{i}_n{n}",
+            rng.normal(size=(n, 16)).astype(np.float32),
+            rng.uniform(0, 25000, (n, 2)).astype(np.float32),
+        )
+        for i, n in enumerate(lengths)
+    ]
+
+
+class TestSlideServiceEndToEnd:
+    def test_queue_bucket_aot_cache_path(self, tiny_model, rng, tmp_path,
+                                         monkeypatch):
+        """The tier-1 acceptance: M=10 slides of K=5 distinct lengths
+        over J=3 buckets -> exactly J executables (watchdog AND
+        XLA-layer counted), repeats served from the cache without a
+        dispatch, warm restart compiles zero."""
+        monkeypatch.delenv("GIGAPATH_OBS", raising=False)
+        model, params = tiny_model
+        forward = _forward_fn(model)
+        config = _tiny_config(tmp_path)
+        # 5 distinct lengths -> buckets {16, 32, 64}
+        lengths = [1, 7, 16, 20, 33, 1, 7, 16, 20, 33]
+        slides = _make_slides(rng, lengths[:5]) + _make_slides(
+            np.random.default_rng(7), lengths[5:]
+        )
+        assert len({f.shape[0] for _, f, _ in slides}) == 5
+
+        service = SlideService(forward, params, config=config,
+                               out_dir=str(tmp_path), identity="tiny")
+        with _count_xla_compiles() as xla:
+            futs = [service.submit(sid, f, c) for sid, f, c in slides]
+            service.drain()
+            results = [fut.result(timeout=60) for fut in futs]
+        assert all(r.shape == (2,) for r in results)
+
+        # -- compile-count pin: exactly J executables, both layers ------
+        assert service.aot.compiled_count == 3
+        assert sum(service.watchdog.compile_count.values()) == 3
+        assert service.watchdog.unexpected_retraces == []
+        assert xla.count == 3
+        assert service.stats()["buckets_used"] == 3
+
+        # -- parity: every slide matches its exact-shape forward --------
+        for (sid, f, c), res in zip(slides, results):
+            exact = np.asarray(model.apply(
+                {"params": params}, f[None], c[None], deterministic=True,
+            ), np.float32)[0]
+            np.testing.assert_allclose(res, exact, atol=1e-5)
+
+        # -- cache short-circuit: repeats cause ZERO dispatches ---------
+        dispatches = service.dispatch_count
+        with _count_xla_compiles() as xla2:
+            repeat_futs = [
+                service.submit(f"again_{sid}", f, c) for sid, f, c in slides
+            ]
+            repeats = [fut.result(timeout=5) for fut in repeat_futs]
+        assert service.dispatch_count == dispatches
+        assert xla2.count == 0
+        for orig, again in zip(results, repeats):
+            np.testing.assert_array_equal(orig, again)
+        assert service.cache.stats()["hits"] == len(slides)
+
+        # -- results are COPIES of their row, never views of the padded
+        # batch buffer (a view would pin capacity x bucket_n x D bytes
+        # per cache line against a budget that accounts one row), and
+        # read-only (the same array backs the future AND the cache line
+        # — silent mutation would corrupt later hits)
+        for res in results:
+            assert res.base is None or res.base.shape == res.shape
+            assert not res.flags.writeable
+            with pytest.raises(ValueError):
+                res[0] = 0.0
+        service.close()
+
+        # -- obs artifact: serving telemetry + report section -----------
+        run_files = [
+            p for p in glob.glob(str(tmp_path / "obs" / "serve-*.jsonl"))
+            if "flight-" not in os.path.basename(p)
+        ]
+        assert len(run_files) == 1
+        events = [json.loads(line) for line in open(run_files[0])]
+        kinds = {ev["kind"] for ev in events}
+        assert {"run_start", "serve_dispatch", "cache_hit", "compile",
+                "compile_profile", "step", "span", "run_end"} <= kinds
+        serve_events = [ev for ev in events if ev["kind"] == "serve_dispatch"]
+        assert sum(ev["slides"] for ev in serve_events) == len(slides)
+        assert all(ev["capacity"] == 3 for ev in serve_events)
+        # the ledger adopted each executable with a FULL profile and no
+        # extra XLA compile (xla.count above pinned that already)
+        profiles = [ev for ev in events if ev["kind"] == "compile_profile"]
+        assert len(profiles) == 3
+        assert all(ev.get("cost") is not None for ev in profiles)
+
+        import obs_report
+
+        buf = io.StringIO()
+        assert obs_report.render(events, out=buf) == 0
+        text = buf.getvalue()
+        assert "== serving ==" in text
+        assert "per-bucket dispatch table" in text
+        assert "hit rate" in text
+
+        # -- warm restart: artifacts load, nothing compiles -------------
+        warm = SlideService(forward, params, config=config,
+                            out_dir=str(tmp_path), identity="tiny")
+        with _count_xla_compiles() as xla3:
+            futs = [warm.submit(sid, f, c) for sid, f, c in slides[:5]]
+            warm.drain()
+            warm_results = [fut.result(timeout=60) for fut in futs]
+        assert xla3.count == 0
+        assert warm.aot.compiled_count == 0
+        assert warm.aot.loaded_count == 3
+        for orig, again in zip(results[:5], warm_results):
+            np.testing.assert_allclose(orig, again, atol=1e-6)
+        warm.close()
+
+        # -- stale-code guard: a restart whose FORWARD changed (same
+        # arch name, same param shapes) must RECOMPILE, not serve the
+        # old artifact's semantics
+        def changed_forward(p, embeds, coords, pad_mask):
+            return forward(p, embeds, coords, pad_mask) * 2.0
+
+        stale = SlideService(changed_forward, params, config=config,
+                             out_dir=str(tmp_path), identity="tiny")
+        fut = stale.submit(*slides[0])
+        stale.drain()
+        np.testing.assert_allclose(
+            fut.result(timeout=60), 2.0 * results[0], atol=1e-5
+        )
+        assert stale.aot.loaded_count == 0  # fingerprint mismatch
+        assert stale.aot.compiled_count == 1
+        stale.close()
+
+    def test_concurrent_submitters_through_worker_thread(
+        self, tiny_model, rng, tmp_path
+    ):
+        """Async shape: the dispatch worker coalesces submissions from
+        concurrent threads; every future resolves, nothing retraces."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        model, params = tiny_model
+        config = _tiny_config(tmp_path, max_batch=2, bucket_max=32)
+        slides = _make_slides(rng, [1, 5, 9, 17, 20, 30, 12, 3])
+        with SlideService(_forward_fn(model), params, config=config,
+                          out_dir=str(tmp_path), identity="tiny") as service:
+            with ThreadPoolExecutor(max_workers=4) as pool:
+                futs = list(pool.map(lambda s: service.submit(*s), slides))
+            results = [f.result(timeout=60) for f in futs]
+            assert all(np.isfinite(r).all() for r in results)
+            assert service.watchdog.unexpected_retraces == []
+            assert service.aot.compiled_count == 2  # buckets {16, 32}
+            assert service.slides_served == len(slides)
+        for (sid, f, c), res in zip(slides[:2], results[:2]):
+            exact = np.asarray(model.apply(
+                {"params": params}, f[None], c[None], deterministic=True,
+            ), np.float32)[0]
+            np.testing.assert_allclose(res, exact, atol=1e-5)
+
+    def test_inflight_duplicates_share_one_dispatch(self, tiny_model, rng,
+                                                    tmp_path):
+        model, params = tiny_model
+        config = _tiny_config(tmp_path, artifact_dir=None)
+        service = SlideService(_forward_fn(model), params, config=config,
+                               out_dir=str(tmp_path), identity="tiny")
+        feats = rng.normal(size=(5, 16)).astype(np.float32)
+        coords = rng.uniform(0, 25000, (5, 2)).astype(np.float32)
+        f1 = service.submit("a", feats, coords)
+        f2 = service.submit("b", feats, coords)  # identical content
+        assert f2 is f1  # joined the pending request
+        assert service.inflight_joins == 1
+        # a join is not a cache MISS: it never probes the result cache,
+        # so duplicate-heavy traffic can't deflate the hit-rate metric
+        assert service.cache.stats()["misses"] == 1
+        service.drain()
+        assert service.dispatch_count == 1
+        assert f1.result(timeout=60) is f2.result(timeout=60)
+        service.close()
+
+    def test_batch_tokens_caps_big_bucket_capacity(self, tiny_model, rng,
+                                                   tmp_path):
+        """The token budget shrinks the batch axis for big buckets: with
+        batch_tokens=64, bucket 16 batches 3 (max_batch) but bucket 64
+        batches 1 — the compiled shapes (AOT keys) prove it."""
+        model, params = tiny_model
+        config = _tiny_config(tmp_path, artifact_dir=None, batch_tokens=64)
+        service = SlideService(_forward_fn(model), params, config=config,
+                               out_dir=str(tmp_path), identity="tiny")
+        assert service.capacity_for(16) == 3   # min(max_batch=3, 64//16)
+        assert service.capacity_for(64) == 1
+        futs = [
+            service.submit(f"s{i}", rng.normal(size=(n, 16)).astype(np.float32))
+            for i, n in enumerate([5, 6, 7, 40])
+        ]
+        service.drain()
+        for f in futs:
+            f.result(timeout=60)
+        assert set(service.aot.sources) == {(3, 16), (1, 64)}
+        service.close()
+
+    def test_submit_validation_and_close_semantics(self, tiny_model, rng,
+                                                   tmp_path):
+        model, params = tiny_model
+        config = _tiny_config(tmp_path, artifact_dir=None)
+        service = SlideService(_forward_fn(model), params, config=config,
+                               out_dir=str(tmp_path), identity="tiny")
+        with pytest.raises(ValueError):  # wrong feature dim
+            service.submit("bad", rng.normal(size=(5, 8)).astype(np.float32))
+        with pytest.raises(ValueError):  # exceeds the ladder's top rung
+            service.submit("huge", rng.normal(size=(65, 16)).astype(np.float32))
+        service.close()
+        with pytest.raises(RuntimeError):
+            service.submit("late", rng.normal(size=(5, 16)).astype(np.float32))
+
+    def test_obs_off_service_leaves_no_artifacts(self, tiny_model, rng,
+                                                 tmp_path, monkeypatch):
+        """GIGAPATH_OBS=0: the service still serves (NullRunLog,
+        NullLedger) and writes no obs files."""
+        monkeypatch.setenv("GIGAPATH_OBS", "0")
+        model, params = tiny_model
+        config = _tiny_config(tmp_path, artifact_dir=None, bucket_max=16)
+        service = SlideService(_forward_fn(model), params, config=config,
+                               out_dir=str(tmp_path), identity="tiny")
+        fut = service.submit(
+            "s", rng.normal(size=(5, 16)).astype(np.float32),
+            rng.uniform(0, 25000, (5, 2)).astype(np.float32),
+        )
+        service.drain()
+        assert np.isfinite(fut.result(timeout=60)).all()
+        service.close()
+        assert not os.path.exists(tmp_path / "obs")
+
+
+# ---------------------------------------------------------------------------
+# the smoke script's own contract (small sizes; defaults run in the
+# slow tier — scripts/serve_smoke.py itself is the ISSUE acceptance run)
+# ---------------------------------------------------------------------------
+
+class TestServeSmokeScript:
+    def test_pick_lengths_terminates_on_tight_ladders(self):
+        import serve_smoke
+
+        from gigapath_tpu.serve import BucketLadder
+
+        ladder = BucketLadder(n_min=16, growth=2.0, n_max=16, align=16)
+        picked = serve_smoke.pick_lengths(ladder, 16)  # every length 1..16
+        assert sorted(picked) == list(range(1, 17))
+        with pytest.raises(ValueError):  # impossible ask: error, not a hang
+            serve_smoke.pick_lengths(ladder, 20)
+
+    def _run(self, tmp_path, extra):
+        import serve_smoke
+
+        json_path = str(tmp_path / "SERVE_SMOKE.json")
+        rc = serve_smoke.main([
+            "--out-dir", str(tmp_path / "out"), "--json", json_path,
+        ] + extra)
+        with open(json_path) as fh:
+            return rc, json.load(fh)
+
+    def test_small_smoke_end_to_end(self, tmp_path):
+        rc, payload = self._run(tmp_path, [
+            "--slides", "8", "--distinct-lengths", "4", "--repeats", "4",
+            "--threads", "4", "--max-batch", "2", "--bucket-max", "64",
+        ])
+        assert rc == 0, payload
+        assert payload["rc"] == 0
+        assert payload["unexpected_retraces"] == 0
+        assert payload["compiled_executables"] == payload["buckets_used"]
+        assert payload["warm_compiled_executables"] == 0
+        assert payload["warm_loaded_executables"] == payload["buckets_used"]
+        assert payload["cache_hits"] >= 4
+        assert payload["distinct_lengths"] == 4
+        for key in ("slides_per_sec", "occupancy_mean", "queue_wait_p50_s",
+                    "queue_wait_p90_s", "cache_hit_rate", "backend"):
+            assert key in payload
+
+    @pytest.mark.slow
+    def test_default_scale_smoke(self, tmp_path):
+        """The literal acceptance run: >= 32 concurrent slides of >= 6
+        distinct lengths, zero mid-serve retraces, cache-pinned repeats,
+        warm restart from artifacts."""
+        rc, payload = self._run(tmp_path, [])
+        assert rc == 0, payload
+        assert payload["slides"] >= 32
+        assert payload["distinct_lengths"] >= 6
+        assert payload["unexpected_retraces"] == 0
+        assert payload["warm_compiled_executables"] == 0
+
+
+# ---------------------------------------------------------------------------
+# ServeConfig env surface
+# ---------------------------------------------------------------------------
+
+class TestServeConfig:
+    def test_from_env_reads_flags_once_with_override_priority(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv("GIGAPATH_SERVE_MAX_BATCH", "5")
+        monkeypatch.setenv("GIGAPATH_SERVE_MAX_WAIT_S", "0.25")
+        monkeypatch.setenv("GIGAPATH_SERVE_BATCH_TOKENS", "4096")
+        monkeypatch.setenv("GIGAPATH_SERVE_CACHE_MB", "64")
+        monkeypatch.setenv("GIGAPATH_SERVE_ARTIFACT_DIR", "/tmp/aots")
+        monkeypatch.setenv("GIGAPATH_SERVE_BUCKET_MIN", "32")
+        monkeypatch.setenv("GIGAPATH_SERVE_BUCKET_ALIGN", "32")
+        cfg = ServeConfig.from_env()
+        assert cfg.max_batch == 5
+        assert cfg.max_wait_s == 0.25
+        assert cfg.batch_tokens == 4096
+        assert cfg.cache_budget_mb == 64
+        assert cfg.artifact_dir == "/tmp/aots"
+        assert cfg.bucket_min == 32 and cfg.bucket_align == 32
+        # explicit overrides win over env
+        assert ServeConfig.from_env(max_batch=2).max_batch == 2
+
+    def test_defaults_without_env(self, monkeypatch):
+        for flag in ("GIGAPATH_SERVE_MAX_BATCH", "GIGAPATH_SERVE_MAX_WAIT_S",
+                     "GIGAPATH_SERVE_CACHE_MB",
+                     "GIGAPATH_SERVE_ARTIFACT_DIR"):
+            monkeypatch.delenv(flag, raising=False)
+        cfg = ServeConfig.from_env()
+        assert cfg.max_batch == 8 and cfg.artifact_dir is None
+        assert cfg.bucket_min == 1024 and cfg.bucket_align == 128
