@@ -4,8 +4,20 @@ Parity with reference ``finetune/datasets/slide_datatset.py``: validates
 which slides have stored tile encodings, maps labels per task setting
 (multi_class / binary / multi_label via the task-config ``label_dict``),
 reads ``features``/``coords`` from h5 (or a bare tensor from ``.pt``),
-optionally shuffles tiles, truncates to ``max_tiles``, and retries a sample
-3x with a random re-draw before skipping (``get_sample_with_try:219``).
+optionally shuffles tiles, truncates to ``max_tiles``, and retries a
+failing sample before skipping (``get_sample_with_try:219``).
+
+Loader hardening (PR 8): a corrupt/missing tile-feature read retries the
+SAME sample ``retry`` times with exponential backoff (transient NFS /
+object-store hiccups heal; the reference's random re-draw silently
+changed the epoch's data distribution), then skips it with a
+``recovery`` event (``action="data_retry"``) on the attached runlog —
+one bad slide costs one sample, never the epoch. A skipped sample
+shrinks that batch's collated batch dim by one, the same ragged shape
+the loader's natural final partial batch already produces (an expected
+new bucket compile, not an unexpected retrace). Chaos injection
+(``GIGAPATH_CHAOS=fail_loader@I`` / ``slow_loader@I``) drives the same
+path deterministically in tests.
 
 TPU deltas: samples are numpy arrays (the host side of a jax pipeline);
 torch is only touched to deserialize ``.pt`` payloads.
@@ -14,6 +26,7 @@ torch is only touched to deserialize ``.pt`` payloads.
 from __future__ import annotations
 
 import os
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -116,11 +129,29 @@ class SlideDatasetForTasks:
 
 
 class SlideDataset(SlideDatasetForTasks):
-    """Sample access with shuffle/truncate/retry (reference ``:118-237``)."""
+    """Sample access with shuffle/truncate/retry (reference ``:118-237``).
 
-    def __init__(self, *args, seed: int = 0, **kwargs):
+    ``retry``/``retry_backoff_s`` bound the per-sample retry loop
+    (module docstring); ``set_runlog`` attaches the run's obs bus so
+    retry-exhausted skips land as ``recovery`` events."""
+
+    def __init__(self, *args, seed: int = 0, retry: int = 3,
+                 retry_backoff_s: float = 0.05, **kwargs):
         super().__init__(*args, **kwargs)
         self._rng = np.random.default_rng(seed)
+        self.retry = max(int(retry), 1)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self._runlog = None
+        # GIGAPATH_CHAOS read once, host-side, at dataset construction
+        # (= driver start): deterministic loader-fault injection
+        from gigapath_tpu.resilience.chaos import get_chaos
+
+        self._chaos = get_chaos()
+
+    def set_runlog(self, runlog) -> None:
+        """Attach the driver's runlog (drivers call this right after
+        ``get_run_log``) so skip events ride the run artifact."""
+        self._runlog = runlog
 
     def shuffle_data(self, images: np.ndarray, coords: np.ndarray):
         indices = self._rng.permutation(len(images))
@@ -160,14 +191,40 @@ class SlideDataset(SlideDatasetForTasks):
             "labels": np.asarray(self.labels[idx]),
         }
 
-    def get_sample_with_try(self, idx: int, n_try: int = 3) -> Optional[dict]:
-        for _ in range(n_try):
+    def get_sample_with_try(self, idx: int,
+                            n_try: Optional[int] = None) -> Optional[dict]:
+        """Bounded same-sample retry with exponential backoff; after
+        exhaustion the sample is SKIPPED (None — the collate drops it)
+        with a ``recovery`` event, never an epoch-killing raise."""
+        n_try = self.retry if n_try is None else max(int(n_try), 1)
+        last_err: Optional[BaseException] = None
+        for attempt in range(n_try):
             try:
+                if self._chaos:
+                    self._chaos.loader_fault(idx)
                 return self.get_one_sample(idx)
-            except Exception:
-                console("Error in getting the sample, try another index")
-                idx = int(self._rng.integers(0, len(self.slide_data)))
-        console("Error in getting the sample, skip the sample")
+            except Exception as e:
+                last_err = e
+                console(
+                    f"Error reading sample {idx} "
+                    f"(attempt {attempt + 1}/{n_try}): "
+                    f"{type(e).__name__}: {e}"
+                )
+                if attempt + 1 < n_try and self.retry_backoff_s > 0:
+                    time.sleep(self.retry_backoff_s * (2 ** attempt))
+        slide_id = (
+            self.images[idx] if 0 <= idx < len(self.images) else None
+        )
+        if self._runlog is not None:
+            self._runlog.event(
+                "recovery", action="data_retry", index=int(idx),
+                slide_id=slide_id, attempts=n_try,
+                error=f"{type(last_err).__name__}: {last_err}",
+            )
+        console(
+            f"Sample {idx} failed {n_try} attempt(s); skipping it "
+            "(the collate drops None samples)"
+        )
         return None
 
     def __len__(self) -> int:

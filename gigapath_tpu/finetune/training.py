@@ -49,6 +49,7 @@ from gigapath_tpu.obs import (
     get_run_log,
     span,
 )
+from gigapath_tpu.obs.runlog import fail_run
 from gigapath_tpu.obs.telemetry import step_scalars
 from gigapath_tpu.utils.checkpoint import MonitorScore, restore_checkpoint, save_checkpoint
 
@@ -118,6 +119,13 @@ def train(dataloader, fold: int, args):
     # GIGAPATH_OBS is read HERE, once, at driver start — never at trace
     # time (gigalint GL001): the event stream lands under fold_dir/obs/
     runlog = get_run_log("finetune", out_dir=fold_dir, config=_obs_config(args))
+    # loader hardening (data/slide_dataset.py): retry-exhausted sample
+    # skips emit `recovery` events (action="data_retry") on THIS run's
+    # bus instead of vanishing into console noise
+    for loader in (train_loader, val_loader, test_loader):
+        dataset = getattr(loader, "dataset", None)
+        if hasattr(dataset, "set_runlog"):
+            dataset.set_runlog(runlog)
 
     dtype = jnp.bfloat16 if getattr(args, "bf16", True) else None
     model, params = get_model(
@@ -165,7 +173,21 @@ def train(dataloader, fold: int, args):
     )
     opt_state = optimizer.init(params)
     loss_fn = get_loss_function(args.task_config)
-    monitor = MonitorScore()
+    ckpt_path = os.path.join(fold_dir, "checkpoint")
+    # re-arm the monitor from a previous run's persisted best_score, so
+    # a resumed fold's first (possibly worse) epoch cannot overwrite the
+    # best checkpoint (PR-8 satellite). Only the "val" selection policy
+    # ever consults the monitor — probing for last_epoch runs would pay
+    # the fallback's full Orbax restore for a score nothing reads
+    if getattr(args, "model_select", "val") == "val":
+        monitor = MonitorScore.from_checkpoint(ckpt_path)
+        if monitor.best_score is not None:
+            runlog.echo(
+                f"[resume] best-checkpoint monitor re-armed at "
+                f"{monitor.best_score:.4f}"
+            )
+    else:
+        monitor = MonitorScore()
 
     multi_label = args.task_config.get("setting", "multi_class") == "multi_label"
 
@@ -206,7 +228,6 @@ def train(dataloader, fold: int, args):
         runlog.echo(f"Testing on {len(test_loader.dataset)} samples")
     runlog.echo("Training starts!")
 
-    ckpt_path = os.path.join(fold_dir, "checkpoint")
     rng = jax.random.PRNGKey(args.seed)
     val_records, test_records = None, None
 
@@ -279,7 +300,13 @@ def train(dataloader, fold: int, args):
             # still inside the heartbeat scope: the final test pass blocks
             # on the device too (fresh eval_step compiles for unseen
             # buckets) and must not be a stall-monitoring blind spot
-            params = restore_checkpoint(ckpt_path, {"params": jax.device_get(params)})["params"]
+            template = {"params": jax.device_get(params)}
+            if args.model_select == "val" and val_loader is not None:
+                # monitor-saved checkpoints carry the persisted
+                # best_score; the restore template must match the
+                # saved structure
+                template["best_score"] = np.asarray(0.0)
+            params = restore_checkpoint(ckpt_path, template)["params"]
             with span("test", runlog):
                 test_records = evaluate(
                     test_loader, eval_step, params, loss_fn, args.epochs, args,
@@ -295,8 +322,18 @@ def train(dataloader, fold: int, args):
         if report_to == "wandb":
             writer.finish()
     except Exception as e:
-        runlog.error("finetune.train", e)
-        runlog.run_end(status="error")
+        # the shared failure tail (error event -> flight dump -> emergency
+        # checkpoint -> terminal run_end) — one owner for all drivers
+        fail_run(
+            runlog, "finetune.train", e,
+            emergency=lambda: (
+                save_checkpoint(
+                    os.path.join(fold_dir, "emergency_checkpoint"),
+                    {"params": jax.device_get(params)},
+                )
+                or os.path.join(fold_dir, "emergency_checkpoint")
+            ),
+        )
         raise
 
     runlog.run_end(

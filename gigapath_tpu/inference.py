@@ -38,6 +38,7 @@ from gigapath_tpu.obs import (
     get_run_log,
     span,
 )
+from gigapath_tpu.obs.runlog import fail_run
 
 
 def load_model(
@@ -104,8 +105,7 @@ def _results_df(results, output_file, runlog, **run_end_fields):
     try:
         results_df.to_csv(output_file, index=False)
     except Exception as e:
-        runlog.error("inference.results", e)
-        runlog.run_end(status="error")
+        fail_run(runlog, "inference.results", e)
         raise
     label_counts = {
         str(k): int(v)
@@ -229,8 +229,7 @@ def _run_inference_bucketed(model, params, feature_files, output_file,
                     "confidence": float(probs[pred]),
                 })
     except Exception as e:
-        runlog.error("inference.run_inference", e)
-        runlog.run_end(status="error")
+        fail_run(runlog, "inference.run_inference", e)
         raise
     finally:
         service.close()
@@ -327,8 +326,7 @@ def run_inference(
                 )
                 heartbeat.beat(idx)
     except Exception as e:
-        runlog.error("inference.run_inference", e)
-        runlog.run_end(status="error")
+        fail_run(runlog, "inference.run_inference", e)
         raise
 
     return _results_df(

@@ -35,6 +35,7 @@ from gigapath_tpu.obs import (
     get_run_log,
     span,
 )
+from gigapath_tpu.obs.runlog import fail_run
 from gigapath_tpu.utils.checkpoint import restore_checkpoint, save_checkpoint
 
 
@@ -217,8 +218,9 @@ def train(
             f.write(f"Test f1: {f1} Test AUROC: {auroc} Test AUPRC: {auprc}\n")
     except Exception as e:
         # a crashed run must still leave a terminal event in its artifact
-        runlog.error("linear_probe.train", e)
-        runlog.run_end(status="error")
+        # (the shared obs failure tail: error event -> flight dump ->
+        # terminal run_end)
+        fail_run(runlog, "linear_probe.train", e)
         raise
     runlog.run_end(
         status="ok", val_f1=val_f1, test_f1=f1, test_auroc=auroc,

@@ -41,7 +41,11 @@ the traced programs are untouched, so the engine can add no retraces):
   events when ``device.memory_stats()`` exists — absent on CPU) grows
   past ``watermark_factor ×`` its first-seen baseline by at least
   ``watermark_min_delta`` bytes; the baseline re-arms at the fired
-  level so sustained growth keeps firing, a plateau does not.
+  level so sustained growth keeps firing, a plateau does not;
+- ``nonfinite_step``     — a ``step`` event tagged ``nonfinite=True``
+  by the in-graph non-finite guard
+  (:mod:`gigapath_tpu.resilience.guard`): the optimizer update was a
+  zero-update skip because loss or the grad norm went non-finite.
 
 ``error`` events trigger a flight dump (context for the post-mortem)
 without counting as an anomaly. Per-detector cooldowns (in step events)
@@ -67,7 +71,7 @@ from gigapath_tpu.obs.flight import FlightRecorder, register_signal_dump
 
 DETECTORS = (
     "step_time_spike", "throughput_dip", "stall", "unexpected_retrace",
-    "memory_watermark",
+    "memory_watermark", "nonfinite_step",
 )
 
 
@@ -334,6 +338,19 @@ class AnomalyEngine(NullAnomalyEngine):
         # synced step legitimately carries minutes of compile wall)
         paid_compile = self._compile_since_step
         self._compile_since_step = False
+
+        # nonfinite_step: the in-graph guard (gigapath_tpu.resilience.
+        # guard) tagged this step's event — the update was a zero-update
+        # skip. The event is the detector input (host-side, like every
+        # other detector); the per-detector cooldown keeps a long
+        # non-finite regime from emitting one anomaly per step (the
+        # guard's own recovery events still record every skip)
+        if record.get("nonfinite"):
+            self._fire(
+                "nonfinite_step",
+                value=record.get("loss"),
+                consecutive=record.get("consecutive"),
+            )
 
         # throughput: arrival gaps between consecutive step events
         t = record.get("t")

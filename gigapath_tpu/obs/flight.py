@@ -129,8 +129,14 @@ class FlightRecorder:
 
 # every live recorder gets a final dump on SIGTERM; the module-level set
 # (not a handler per recorder) keeps the process at ONE chained handler
-# no matter how many runs (finetune folds) a process opens
+# no matter how many runs (finetune folds) a process opens. The same
+# handler also runs the registered shutdown CALLBACKS (emergency
+# checkpoints from gigapath_tpu/resilience, graceful serving drains) —
+# this module is the single sanctioned signal.signal site in library
+# code (gigalint GL011), so a new handler can never silently clobber
+# the flight dump, and the flight dump can never clobber a recovery.
 _SIGNAL_FLIGHTS: list = []
+_SIGNAL_CALLBACKS: list = []
 _PREV_SIGTERM = None
 _SIGNAL_INSTALLED = False
 _SIGNAL_LOCK = threading.Lock()
@@ -142,6 +148,19 @@ def _on_sigterm(signum, frame):
             flight.dump_from_signal(f"signal-{signum}")
         except Exception:
             pass
+    # shutdown callbacks run AFTER the flight dumps (a callback that
+    # hangs in checkpoint IO must not cost the post-mortem context) and
+    # may claim a GRACEFUL shutdown by returning True: the process stays
+    # alive so the claimant can finish (drain a serving queue) and exit
+    # on its own terms — otherwise the prior disposition runs
+    graceful = False
+    for cb in list(_SIGNAL_CALLBACKS):
+        try:
+            graceful = bool(cb(signum)) or graceful
+        except Exception:
+            pass
+    if graceful:
+        return
     prev = _PREV_SIGTERM
     if callable(prev):
         prev(signum, frame)
@@ -158,20 +177,29 @@ def _on_sigterm(signum, frame):
         os.kill(os.getpid(), signum)
 
 
+def _ensure_handler_locked() -> bool:
+    """Install the single chaining handler (caller holds _SIGNAL_LOCK).
+    Only possible from the main thread — elsewhere the installation is
+    skipped, never fatal."""
+    global _PREV_SIGTERM, _SIGNAL_INSTALLED
+    if _SIGNAL_INSTALLED:
+        return True
+    if threading.current_thread() is not threading.main_thread():
+        return False
+    try:
+        _PREV_SIGTERM = signal.signal(signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError):  # non-main interpreter contexts
+        return False
+    _SIGNAL_INSTALLED = True
+    return True
+
+
 def register_signal_dump(flight: FlightRecorder) -> bool:
     """Arm a final flight dump on SIGTERM for ``flight``. Installs the
-    (single, chaining) handler on first use; only possible from the main
-    thread — elsewhere the registration is skipped, never fatal."""
-    global _PREV_SIGTERM, _SIGNAL_INSTALLED
+    (single, chaining) handler on first use."""
     with _SIGNAL_LOCK:
-        if not _SIGNAL_INSTALLED:
-            if threading.current_thread() is not threading.main_thread():
-                return False
-            try:
-                _PREV_SIGTERM = signal.signal(signal.SIGTERM, _on_sigterm)
-            except (ValueError, OSError):  # non-main interpreter contexts
-                return False
-            _SIGNAL_INSTALLED = True
+        if not _ensure_handler_locked():
+            return False
         _SIGNAL_FLIGHTS.append(flight)
     return True
 
@@ -180,3 +208,23 @@ def unregister_signal_dump(flight: FlightRecorder) -> None:
     with _SIGNAL_LOCK:
         if flight in _SIGNAL_FLIGHTS:
             _SIGNAL_FLIGHTS.remove(flight)
+
+
+def register_signal_callback(cb) -> bool:
+    """Chain ``cb(signum) -> bool`` onto the SIGTERM handler (after the
+    flight dumps). Returning True claims a graceful shutdown: the prior
+    signal disposition is NOT re-raised and the claimant owns process
+    exit (a serving drain); False/None lets the chain proceed to the
+    prior disposition — normally process death — after the callback
+    finishes (an emergency checkpoint). Exceptions are contained."""
+    with _SIGNAL_LOCK:
+        if not _ensure_handler_locked():
+            return False
+        _SIGNAL_CALLBACKS.append(cb)
+    return True
+
+
+def unregister_signal_callback(cb) -> None:
+    with _SIGNAL_LOCK:
+        if cb in _SIGNAL_CALLBACKS:
+            _SIGNAL_CALLBACKS.remove(cb)

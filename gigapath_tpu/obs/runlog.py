@@ -60,8 +60,8 @@ SCHEMA_VERSION = 1
 
 EVENT_KINDS = (
     "run_start", "step", "compile", "compile_profile", "span", "eval",
-    "heartbeat", "stall", "anomaly", "serve_dispatch", "cache_hit",
-    "error", "run_end",
+    "heartbeat", "stall", "anomaly", "recovery", "serve_dispatch",
+    "cache_hit", "error", "run_end",
 )
 
 
@@ -116,7 +116,7 @@ class NullRunLog:
         return None
 
     run_start = step = compile_event = eval_event = heartbeat = stall = \
-        error = run_end = event
+        recovery = error = run_end = event_from_signal = event
 
     def add_observer(self, fn) -> None:
         """No-op: the opt-out stream has no events to observe."""
@@ -141,6 +141,18 @@ class NullRunLog:
         if step is not None:
             head += f" step {step}"
         console(head + f"] {msg}", stream=self._echo_stream)
+
+    def echo_from_signal(self, msg: str) -> None:
+        """Signal-handler-safe echo: a raw ``os.write`` to stderr — the
+        buffered echo stream's internal lock may be held by the very
+        frame the signal interrupted, and a buffered write would
+        deadlock on it."""
+        if not self._echo:
+            return
+        try:
+            os.write(2, f"[{self.driver}] {msg}\n".encode())
+        except OSError:
+            pass
 
 
 class RunLog(NullRunLog):
@@ -201,6 +213,34 @@ class RunLog(NullRunLog):
                 observer(record)
             except Exception:  # observers must never take a run down
                 pass
+        return record
+
+    def event_from_signal(self, kind: str, **fields) -> Optional[Dict[str, Any]]:
+        """Signal-handler-safe event (the SIGTERM recovery callbacks):
+        the handler runs ON the main thread, which may be suspended
+        INSIDE :meth:`event` holding the write lock — a blocking acquire
+        would deadlock and make the process unkillable by the very
+        SIGTERM it is handling (``FlightRecorder.dump_from_signal``'s
+        discipline). Try briefly and drop the record on contention —
+        losing one event beats hanging the shutdown — and skip the
+        observers (an observer may emit events of its own)."""
+        record = {
+            "v": SCHEMA_VERSION,
+            "run": self.run_id,
+            "kind": kind,
+            "t": round(time.time(), 6),
+        }
+        record.update({k: _to_scalar(v) for k, v in fields.items()})
+        line = json.dumps(record)
+        if not self._lock.acquire(timeout=1.0):
+            return None
+        try:
+            if self._closed:
+                return record
+            self._fh.write(line + "\n")
+            self._fh.flush()
+        finally:
+            self._lock.release()
         return record
 
     def close(self) -> None:
@@ -265,6 +305,15 @@ class RunLog(NullRunLog):
                           since_progress_s=since_progress_s,
                           deadline_s=deadline_s, **fields)
 
+    def recovery(self, action: str, **fields):
+        """One recovery action taken by the fault-tolerance layer
+        (:mod:`gigapath_tpu.resilience` / the serving self-healing):
+        skip_step, rollback, rollback_unavailable, resume,
+        emergency_checkpoint, data_retry, shed, deadline, bisect,
+        poisoned_request, breaker_*, drain —
+        rendered by ``scripts/obs_report.py``'s ``== recovery ==``."""
+        return self.event("recovery", action=action, **fields)
+
     def error(self, where: str, err: BaseException):
         return self.event("error", where=where,
                           error=f"{type(err).__name__}: {err}")
@@ -274,6 +323,28 @@ class RunLog(NullRunLog):
                          wall_s=round(time.time() - self._t0, 3), **fields)
         self.close()
         return rec
+
+
+def fail_run(runlog, where: str, err: BaseException, *,
+             emergency=None) -> None:
+    """The ONE driver-failure tail (every driver's ``except Exception``
+    dedupes onto this): ``error`` event (which triggers the anomaly
+    engine's flight dump for free — error events are a dump trigger),
+    then — when the driver has live train state — an emergency
+    checkpoint via the zero-arg ``emergency()`` callable (returns the
+    saved path; failures contained — a broken disk must not mask the
+    original exception), then the terminal ``run_end(status="error")``.
+    The caller re-raises; this function never swallows."""
+    runlog.error(where, err)
+    if emergency is not None:
+        try:
+            path = emergency()
+            if path:
+                runlog.recovery(action="emergency_checkpoint",
+                                where=where, path=str(path))
+        except Exception:
+            pass
+    runlog.run_end(status="error")
 
 
 def _key_str(key) -> str:

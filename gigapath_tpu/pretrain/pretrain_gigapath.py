@@ -43,6 +43,7 @@ from gigapath_tpu.obs import (
     get_run_log,
     span,
 )
+from gigapath_tpu.obs.runlog import fail_run
 from gigapath_tpu.utils.checkpoint import restore_checkpoint, save_checkpoint
 
 
@@ -202,8 +203,16 @@ def pretrain_tile_encoder(
                         {"params": jax.device_get(params), "epoch": np.asarray(epoch)},
                     )
     except Exception as e:
-        runlog.error("pretrain_tile_encoder", e)
-        runlog.run_end(status="error")
+        fail_run(
+            runlog, "pretrain_tile_encoder", e,
+            emergency=lambda: (
+                save_checkpoint(
+                    os.path.join(output_dir, "emergency_tile_encoder"),
+                    {"params": jax.device_get(params)},
+                )
+                or os.path.join(output_dir, "emergency_tile_encoder")
+            ),
+        )
         raise
     runlog.echo(f"Pretraining done. Best loss: {best_loss:.6f}")
     runlog.run_end(
@@ -337,8 +346,16 @@ def pretrain_slide_encoder(
                         best_path, {"params": jax.device_get(params), "loss": np.asarray(loss)}
                     )
     except Exception as e:
-        runlog.error("pretrain_slide_encoder", e)
-        runlog.run_end(status="error")
+        fail_run(
+            runlog, "pretrain_slide_encoder", e,
+            emergency=lambda: (
+                save_checkpoint(
+                    os.path.join(output_dir, "emergency_slide_encoder"),
+                    {"params": jax.device_get(params)},
+                )
+                or os.path.join(output_dir, "emergency_slide_encoder")
+            ),
+        )
         raise
     runlog.echo(f"Slide pretraining done. Best loss: {best_loss:.6f}")
     runlog.run_end(

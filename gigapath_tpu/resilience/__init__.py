@@ -1,0 +1,54 @@
+"""Fault-tolerance layer: deterministic chaos injection, hardened
+checkpoints, in-graph non-finite guards (PR-8 tentpole).
+
+The PR-6 anomaly engine *detects* and the flight recorder *records*;
+this package *recovers* — and proves every recovery path against seeded
+fault injection instead of luck:
+
+- :mod:`gigapath_tpu.resilience.chaos` — ``GIGAPATH_CHAOS``-driven
+  injectors (non-finite loss at step k, corrupted feature batch, loader
+  failure/slowdown, SIGTERM at step k, corrupted checkpoint, poisoned
+  serve request), parsed ONCE host-side at driver start
+  (``get_chaos`` — the ``get_run_log`` discipline; GL001-clean);
+- :mod:`gigapath_tpu.resilience.checkpoint` — ``ResilientCheckpointer``:
+  atomic tmp-dir+rename saves, sha256 manifests verified on restore,
+  keep-last-K rotation with a best pointer, full train-state snapshots,
+  ``resume='auto'`` that falls back past corrupt checkpoints, and a
+  SIGTERM-triggered emergency checkpoint chained through
+  :mod:`gigapath_tpu.obs.flight`'s (single, GL011-sanctioned) handler;
+- :mod:`gigapath_tpu.resilience.guard` — in-graph non-finite guard
+  (``jnp.where`` zero-update skip-step; no retraces, byte-identical HLO
+  when off) plus the host-side ``SkipStepMonitor`` that rolls back to
+  the last checkpoint after M consecutive skips.
+
+Recovery actions emit schema'd ``recovery`` events on the obs bus
+(``scripts/obs_report.py`` renders them as ``== recovery ==``); obs off
+constructs nothing. ``scripts/chaos_smoke.py`` is the one-command CPU
+recovery checklist; ``tests/test_resilience.py`` pins the acceptance
+(kill-and-resume bit-exact parity, corrupt-checkpoint fallback,
+NaN-step skip, poisoned-serve-batch isolation).
+"""
+
+from gigapath_tpu.resilience.chaos import (
+    ChaosError,
+    ChaosInjector,
+    NullChaos,
+    get_chaos,
+)
+from gigapath_tpu.resilience.checkpoint import ResilientCheckpointer
+from gigapath_tpu.resilience.guard import (
+    SkipStepMonitor,
+    guard_update,
+    nonfinite_guard_enabled,
+)
+
+__all__ = [
+    "ChaosError",
+    "ChaosInjector",
+    "NullChaos",
+    "ResilientCheckpointer",
+    "SkipStepMonitor",
+    "get_chaos",
+    "guard_update",
+    "nonfinite_guard_enabled",
+]

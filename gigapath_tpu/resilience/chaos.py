@@ -1,0 +1,222 @@
+"""Deterministic, seeded fault injection (``GIGAPATH_CHAOS``).
+
+Every recovery path in this repo is proven against *injected* faults,
+never against luck: the resilience tests and ``scripts/chaos_smoke.py``
+set ``GIGAPATH_CHAOS`` and assert the recovery, so a regression in the
+skip-step guard or the resume scan fails deterministically on CPU.
+
+Spec grammar — comma-separated tokens, parsed ONCE host-side at driver
+start (:func:`get_chaos`, the ``get_run_log`` discipline; never read at
+trace time — GL001-clean because no injector is trace-reachable):
+
+- ``nan_loss@K``      — poison the step-``K`` feature batch with NaNs so
+  the loss goes non-finite (drives the in-graph guard);
+- ``corrupt_batch@K`` — overwrite the step-``K`` feature batch with huge
+  garbage (the corrupted-shard case: loss blows up to inf);
+- ``sigterm@K``       — deliver a real ``SIGTERM`` to this process after
+  step ``K`` completes (the preempted-worker case; lands in
+  :mod:`gigapath_tpu.obs.flight`'s chained handler);
+- ``fail_loader@I``   — the dataset read of sample index ``I`` raises
+  (``xN`` suffix = fail the first N attempts: ``fail_loader@2x3``);
+- ``slow_loader@I:S`` — the read of sample index ``I`` sleeps S seconds;
+- ``corrupt_ckpt``    — flip bytes in the LATEST checkpoint before a
+  ``resume='auto'`` scan (drives the fallback-past-corruption path);
+- ``poison@ID``       — serving: any dispatched batch containing slide
+  ``ID`` raises (drives poisoned-batch bisection);
+- ``seed=N``          — seed for the deterministic corruption bytes.
+
+All injection is host-side (batches are poisoned *before* they reach the
+jitted step), so chaos can change no compiled program and add no
+retraces.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Dict, List, Optional, Sequence
+
+
+class ChaosError(RuntimeError):
+    """An injected fault (loader failure, poisoned serve request)."""
+
+
+class NullChaos:
+    """Chaos off: falsy, every consult a no-op. Drivers guard their
+    consults with ``if chaos:`` so the off path costs one truthiness
+    check per step."""
+
+    def __bool__(self) -> bool:
+        return False
+
+    def batch_fault(self, step: int) -> Optional[str]:
+        return None
+
+    def apply_batch_fault(self, kind: str, arr):
+        return arr
+
+    def maybe_sigterm(self, step: int) -> bool:
+        return False
+
+    def loader_fault(self, index: int) -> None:
+        return None
+
+    def corrupts_checkpoint(self) -> bool:
+        return False
+
+    def corrupt_checkpoint(self, path: str) -> Optional[str]:
+        return None
+
+    def poisoned(self, slide_ids: Sequence[str]) -> Optional[str]:
+        return None
+
+
+class ChaosInjector(NullChaos):
+    """Parsed ``GIGAPATH_CHAOS`` spec. One instance per driver run."""
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        self.seed = 0
+        self._nan_steps: set = set()
+        self._corrupt_steps: set = set()
+        self._sigterm_steps: set = set()
+        self._fail_loader: Dict[int, int] = {}   # index -> remaining fails
+        self._slow_loader: Dict[int, float] = {}  # index -> sleep seconds
+        self._corrupt_ckpt = False
+        self._ckpt_corrupted = False
+        self._poison_ids: List[str] = []
+        for token in spec.split(","):
+            token = token.strip()
+            if not token:
+                continue
+            self._parse(token)
+
+    def __bool__(self) -> bool:
+        return True
+
+    def _parse(self, token: str) -> None:
+        if token.startswith("seed="):
+            self.seed = int(token.split("=", 1)[1])
+            return
+        kind, _, arg = token.partition("@")
+        if kind == "nan_loss":
+            self._nan_steps.add(int(arg))
+        elif kind == "corrupt_batch":
+            self._corrupt_steps.add(int(arg))
+        elif kind == "sigterm":
+            self._sigterm_steps.add(int(arg))
+        elif kind == "fail_loader":
+            idx, _, times = arg.partition("x")
+            self._fail_loader[int(idx)] = int(times) if times else 1
+        elif kind == "slow_loader":
+            idx, _, secs = arg.partition(":")
+            self._slow_loader[int(idx)] = float(secs) if secs else 1.0
+        elif kind == "corrupt_ckpt":
+            self._corrupt_ckpt = True
+        elif kind == "poison":
+            self._poison_ids.append(arg)
+        else:
+            raise ValueError(
+                f"GIGAPATH_CHAOS: unknown injector {token!r} (known: "
+                "nan_loss@K, corrupt_batch@K, sigterm@K, fail_loader@I[xN], "
+                "slow_loader@I[:S], corrupt_ckpt, poison@ID, seed=N)"
+            )
+
+    # -- batch faults (consulted by train loops, host-side) ---------------
+    def batch_fault(self, step: int) -> Optional[str]:
+        if step in self._nan_steps:
+            return "nan"
+        if step in self._corrupt_steps:
+            return "corrupt"
+        return None
+
+    def apply_batch_fault(self, kind: str, arr):
+        """Poisoned COPY of a host batch array: NaNs (non-finite loss) or
+        huge garbage (corrupted shard — the loss blows up to inf)."""
+        import numpy as np
+
+        out = np.array(arr, np.float32)
+        if kind == "nan":
+            out.reshape(-1)[:: max(out.size // 8, 1)] = np.nan
+        else:
+            out.reshape(-1)[:: max(out.size // 8, 1)] = 1e30
+        return out
+
+    # -- preemption -------------------------------------------------------
+    def maybe_sigterm(self, step: int) -> bool:
+        """Deliver a REAL SIGTERM after step ``step`` — the handler chain
+        (flight dump + registered emergency-checkpoint callbacks) runs at
+        the next bytecode boundary of the main thread."""
+        if step not in self._sigterm_steps:
+            return False
+        self._sigterm_steps.discard(step)  # one delivery per spec entry
+        os.kill(os.getpid(), signal.SIGTERM)
+        return True
+
+    # -- loader faults (consulted by SlideDataset reads) ------------------
+    def loader_fault(self, index: int) -> None:
+        sleep_s = self._slow_loader.get(index)
+        if sleep_s:
+            time.sleep(sleep_s)
+        remaining = self._fail_loader.get(index, 0)
+        if remaining > 0:
+            self._fail_loader[index] = remaining - 1
+            raise ChaosError(f"chaos: injected loader failure at sample {index}")
+
+    # -- checkpoint corruption -------------------------------------------
+    def corrupts_checkpoint(self) -> bool:
+        """One corruption per run: the resume scan consults this once."""
+        if self._corrupt_ckpt and not self._ckpt_corrupted:
+            self._ckpt_corrupted = True
+            return True
+        return False
+
+    def corrupt_checkpoint(self, path: str) -> Optional[str]:
+        return corrupt_checkpoint_dir(path, seed=self.seed)
+
+    # -- serving poison ---------------------------------------------------
+    def poisoned(self, slide_ids: Sequence[str]) -> Optional[str]:
+        for sid in slide_ids:
+            if sid in self._poison_ids:
+                return sid
+        return None
+
+
+def corrupt_checkpoint_dir(path: str, seed: int = 0) -> Optional[str]:
+    """Deterministically flip bytes in the largest payload file under a
+    checkpoint directory (manifest excluded — corruption the manifest
+    must CATCH, not corruption of the manifest itself). Returns the
+    corrupted file path, or None when nothing corruptible exists."""
+    import numpy as np
+
+    candidates = []
+    for root, _, files in os.walk(path):
+        for name in files:
+            if name == "manifest.json":
+                continue
+            full = os.path.join(root, name)
+            size = os.path.getsize(full)
+            if size > 0:
+                candidates.append((size, full))
+    if not candidates:
+        return None
+    _, target = max(candidates)
+    rng = np.random.default_rng(seed)
+    with open(target, "r+b") as fh:
+        data = bytearray(fh.read())
+        for pos in rng.integers(0, len(data), size=min(16, len(data))):
+            data[pos] ^= 0xFF
+        fh.seek(0)
+        fh.write(bytes(data))
+    return target
+
+
+def get_chaos():
+    """Build the run's chaos injector from ``GIGAPATH_CHAOS``, read ONCE
+    here, host-side, at driver start (never at trace time). Unset/empty
+    -> :class:`NullChaos` (falsy; drivers skip every consult)."""
+    spec = os.environ.get("GIGAPATH_CHAOS", "").strip()
+    if not spec:
+        return NullChaos()
+    return ChaosInjector(spec)

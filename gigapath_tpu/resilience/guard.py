@@ -1,0 +1,133 @@
+"""In-graph non-finite guard: skip the update, keep the run.
+
+One NaN batch (a corrupt shard, an fp overflow at a loss spike) poisons
+``params`` forever — every later step multiplies garbage. The guard
+makes the optimizer step conditional INSIDE the jitted program:
+
+    new_params, new_opt = ... ordinary update ...
+    (params, opt_state), skipped = guard_update(
+        loss, grads, old=(params, opt_state), new=(new_params, new_opt))
+
+``skipped`` is a device scalar (1.0 = the update was dropped because
+loss or the global grad norm went non-finite); the per-leaf
+``jnp.where`` select adds no retraces (same program every call) and no
+host syncs (drivers read ``skipped`` at their existing sync points).
+The guard is a HOST-side construction choice: drivers build the
+guarded step only when :func:`nonfinite_guard_enabled` says so
+(``GIGAPATH_NONFINITE_GUARD``, read once at driver start), so the
+guard-off program is byte-identical HLO to the unguarded one — pinned
+in ``tests/test_resilience.py``.
+
+The host half, :class:`SkipStepMonitor`, counts consecutive skips: each
+skip emits a ``recovery`` event (``action="skip_step"``) and tags the
+step event ``nonfinite=True`` (the anomaly engine's ``nonfinite_step``
+detector fires on that); after M consecutive skips it answers
+``"rollback"`` and the driver restores the last valid checkpoint —
+a persistently non-finite regime means the params are already garbage
+and skipping forward cannot save them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+
+def nonfinite_guard_enabled() -> bool:
+    """``GIGAPATH_NONFINITE_GUARD`` (host-side, read once at driver
+    start): unset -> ON; ``''``/``'0'``/``'false'``/``'no'`` -> OFF.
+    Off means the driver builds the unguarded step — byte-identical
+    HLO to the pre-guard program."""
+    from gigapath_tpu.obs.runlog import env_on_by_default
+
+    return env_on_by_default("GIGAPATH_NONFINITE_GUARD")
+
+
+def rollback_after() -> int:
+    """``GIGAPATH_GUARD_ROLLBACK_AFTER`` (host-side, read once): M
+    consecutive skipped steps before the monitor orders a rollback to
+    the last checkpoint (default 3; 0 disables rollback)."""
+    from gigapath_tpu.obs.runlog import env_number
+
+    return max(int(env_number("GIGAPATH_GUARD_ROLLBACK_AFTER", 3)), 0)
+
+
+def guard_update(loss, grads, old: Any, new: Any) -> Tuple[Any, Any]:
+    """In-graph: ``(state, skipped)`` where ``state = new`` when loss AND
+    the global grad norm are finite, else ``old`` (leafwise
+    ``jnp.where`` — the zero-update skip-step). Call INSIDE the jitted
+    step; ``old``/``new`` are matching pytrees (params, opt_state)."""
+    import jax
+    import jax.numpy as jnp
+
+    gnorm = jnp.sqrt(sum(
+        jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+        for leaf in jax.tree_util.tree_leaves(grads)
+    ))
+    ok = jnp.isfinite(jnp.asarray(loss, jnp.float32)) & jnp.isfinite(gnorm)
+    guarded = jax.tree_util.tree_map(
+        lambda n, o: jnp.where(ok, n, o), new, old
+    )
+    return guarded, (1.0 - ok.astype(jnp.float32))
+
+
+class SkipStepMonitor:
+    """Host-side skip accounting + rollback policy (module docstring)."""
+
+    def __init__(self, runlog, *, rollback_after_skips: Optional[int] = None):
+        self.runlog = runlog
+        self.rollback_after = (
+            rollback_after() if rollback_after_skips is None
+            else max(int(rollback_after_skips), 0)
+        )
+        self.skip_count = 0
+        self.rollback_count = 0
+        self._consecutive = 0
+        # run length of the CURRENT non-finite regime as of the last
+        # observed skip (survives the reset a rollback order performs):
+        # drivers put it on the step event so the anomaly engine's
+        # nonfinite_step detector can report it
+        self.last_consecutive = 0
+
+    def observe(self, step: int, skipped: float) -> Optional[str]:
+        """Feed one step's ``skipped`` scalar (host float, read at the
+        driver's sync point). Returns ``"rollback"`` when the driver
+        should restore the last checkpoint, else None."""
+        if float(skipped) < 0.5:
+            self._consecutive = 0
+            return None
+        self.skip_count += 1
+        self._consecutive += 1
+        self.last_consecutive = self._consecutive
+        self.runlog.event(
+            "recovery", action="skip_step", step=int(step),
+            consecutive=self._consecutive,
+        )
+        self.runlog.echo(
+            f"[guard] non-finite loss/grad at step {step}: update "
+            f"skipped ({self._consecutive} consecutive)"
+        )
+        if self.rollback_after and self._consecutive >= self.rollback_after:
+            self._consecutive = 0
+            return "rollback"
+        return None
+
+    def rollback_performed(self) -> None:
+        """The driver restored a checkpoint for an ordered rollback —
+        ``rollback_count`` counts PERFORMED rollbacks, not orders (an
+        order with no checkpoint to restore must not inflate the
+        ``run_end`` accounting)."""
+        self.rollback_count += 1
+
+    def rollback_unavailable(self, step: int) -> None:
+        """An ordered rollback found no valid checkpoint (the default
+        ``checkpoint_every=0`` run): loudly surfaced — the params are
+        likely garbage and nothing can restore them — instead of the
+        order dissolving into a silent no-op."""
+        self.runlog.event(
+            "recovery", action="rollback_unavailable", step=int(step),
+        )
+        self.runlog.echo(
+            f"[guard] rollback ordered at step {step} but no valid "
+            "checkpoint exists (checkpoint_every=0?): params may be "
+            "unrecoverable, continuing with skip-steps only"
+        )

@@ -9,9 +9,14 @@
   a fill-or-deadline (continuous batching) policy;
 - :mod:`gigapath_tpu.serve.cache` — content-hash embedding LRU with a
   byte budget (re-queried slides never recompute);
+- :mod:`gigapath_tpu.serve.health` — self-healing policies (PR-8):
+  token-budget load shedding, per-request deadlines, per-bucket circuit
+  breakers with half-open probes;
 - :mod:`gigapath_tpu.serve.service` — the orchestration loop, wired
   through the obs bus (runlog, watchdog, heartbeat, ledger, anomaly
-  engine; ``serve_dispatch`` / ``cache_hit`` events).
+  engine; ``serve_dispatch`` / ``cache_hit`` / ``recovery`` events),
+  with poisoned-batch bisection and a graceful SIGTERM drain chained
+  through :mod:`gigapath_tpu.obs.flight`.
 
 Smoke: ``python scripts/serve_smoke.py``; tier-1:
 ``tests/test_serve.py``; knobs: the ``GIGAPATH_SERVE_*`` rows of the
@@ -21,13 +26,23 @@ README flag table (all host-side, read once at service construction).
 from gigapath_tpu.serve.aot import AotExecutableCache
 from gigapath_tpu.serve.buckets import BucketLadder, assemble_batch, pad_slide
 from gigapath_tpu.serve.cache import EmbeddingCache, content_key
+from gigapath_tpu.serve.health import (
+    BreakerOpenError,
+    CircuitBreaker,
+    DeadlineExceededError,
+    LoadSheddedError,
+)
 from gigapath_tpu.serve.queue import RequestQueue, SlideRequest
 from gigapath_tpu.serve.service import ServeConfig, SlideService
 
 __all__ = [
     "AotExecutableCache",
+    "BreakerOpenError",
     "BucketLadder",
+    "CircuitBreaker",
+    "DeadlineExceededError",
     "EmbeddingCache",
+    "LoadSheddedError",
     "RequestQueue",
     "ServeConfig",
     "SlideRequest",

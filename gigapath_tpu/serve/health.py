@@ -1,0 +1,120 @@
+"""Serving self-healing policies: load shedding, deadlines, circuit
+breaking (the request-facing half of the PR-8 resilience layer).
+
+A serving process facing heavy traffic fails in ways a batch driver
+never sees: a queue that grows without bound until the host OOMs, a
+request that waits forever behind a hot bucket, one bucket whose
+executable (or data) is persistently broken taking every caller down
+with it. The policies here keep each failure contained:
+
+- **load shedding** (``GIGAPATH_SERVE_SHED_TOKENS``; the check lives in
+  ``SlideService.submit``, after the cache/pending probes): a submit
+  that would push the queue's pending PADDED-token depth past the
+  budget is rejected immediately (:class:`LoadSheddedError` on the
+  future) — back-pressure at the door instead of an OOM an hour later;
+- **per-request deadlines** (``GIGAPATH_SERVE_DEADLINE_S``): a request
+  that already waited past its deadline when its batch dispatches fails
+  with :class:`DeadlineExceededError` instead of burning device time on
+  an answer nobody is still waiting for;
+- **circuit breaker** (:class:`CircuitBreaker` via
+  ``GIGAPATH_SERVE_BREAKER_FAILURES`` /
+  ``GIGAPATH_SERVE_BREAKER_COOLDOWN_S``): per-bucket; N consecutive
+  failed dispatches OPEN the breaker (new batches for that bucket
+  fail fast with :class:`BreakerOpenError`), after the cooldown ONE
+  half-open probe batch is admitted — success closes the breaker,
+  failure re-opens it.
+
+All policy state is host-side and per-bucket; every trip/close/shed
+emits a ``recovery`` event through the service's runlog (rendered by
+``scripts/obs_report.py``'s ``== recovery ==``). Clocks are injectable
+(``now=``) so tests are deterministic, like ``serve/queue.py``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+
+class LoadSheddedError(RuntimeError):
+    """Rejected at submit: queue depth exceeded the token budget."""
+
+
+class DeadlineExceededError(RuntimeError):
+    """Expired before dispatch: queue wait exceeded the deadline."""
+
+
+class BreakerOpenError(RuntimeError):
+    """Fail-fast: this bucket's circuit breaker is open."""
+
+
+class CircuitBreaker:
+    """Per-bucket closed -> open -> half-open state machine."""
+
+    def __init__(self, failures: int = 3, cooldown_s: float = 30.0):
+        self.failures = max(int(failures), 1)
+        self.cooldown_s = float(cooldown_s)
+        # bucket -> {"state", "consecutive", "opened_at", "probing"}
+        self._buckets: Dict[int, dict] = {}
+        self.trips = 0
+
+    def _entry(self, bucket: int) -> dict:
+        return self._buckets.setdefault(bucket, {
+            "state": "closed", "consecutive": 0,
+            "opened_at": 0.0, "probing": False,
+        })
+
+    def state(self, bucket: int) -> str:
+        return self._entry(bucket)["state"]
+
+    def admit(self, bucket: int, now: Optional[float] = None) -> str:
+        """``"dispatch"`` (closed), ``"probe"`` (half-open: this batch is
+        THE probe), or ``"reject"`` (open, or a probe already in
+        flight)."""
+        now = time.monotonic() if now is None else now
+        entry = self._entry(bucket)
+        if entry["state"] == "closed":
+            return "dispatch"
+        if entry["state"] == "open":
+            if now - entry["opened_at"] >= self.cooldown_s:
+                entry["state"] = "half_open"
+                entry["probing"] = True
+                return "probe"
+            return "reject"
+        # half_open: one probe at a time
+        if entry["probing"]:
+            return "reject"
+        entry["probing"] = True
+        return "probe"
+
+    def record_success(self, bucket: int) -> Optional[str]:
+        """Returns ``"close"`` when a half-open probe just closed the
+        breaker, else None."""
+        entry = self._entry(bucket)
+        entry["consecutive"] = 0
+        if entry["state"] != "closed":
+            entry["state"] = "closed"
+            entry["probing"] = False
+            return "close"
+        return None
+
+    def record_failure(self, bucket: int,
+                       now: Optional[float] = None) -> Optional[str]:
+        """Returns ``"open"`` when this failure tripped (or re-tripped)
+        the breaker, else None."""
+        now = time.monotonic() if now is None else now
+        entry = self._entry(bucket)
+        entry["consecutive"] += 1
+        if entry["state"] == "half_open":
+            # the probe failed: straight back to open, fresh cooldown
+            entry["state"] = "open"
+            entry["opened_at"] = now
+            entry["probing"] = False
+            self.trips += 1
+            return "open"
+        if entry["state"] == "closed" and entry["consecutive"] >= self.failures:
+            entry["state"] = "open"
+            entry["opened_at"] = now
+            self.trips += 1
+            return "open"
+        return None

@@ -68,6 +68,10 @@ class RequestQueue:
         # smaller batch sizes than small ones
         self._capacity_for = capacity_for
         self._lanes: Dict[int, List[SlideRequest]] = {}
+        # incremental padded-token depth: the load-shed check runs on
+        # EVERY submit precisely when the queue is deepest, so summing
+        # the lanes there would make overloaded submits O(queue depth)
+        self._pending_tokens = 0
         self._cond = threading.Condition()
 
     def capacity(self, bucket_n: int) -> int:
@@ -79,12 +83,22 @@ class RequestQueue:
     def submit(self, req: SlideRequest) -> None:
         with self._cond:
             self._lanes.setdefault(req.bucket_n, []).append(req)
+            self._pending_tokens += req.bucket_n
             self._cond.notify_all()
 
     # -- consumer side ----------------------------------------------------
     def pending(self) -> int:
         with self._cond:
             return sum(len(lane) for lane in self._lanes.values())
+
+    def pending_tokens(self) -> int:
+        """Total PADDED tiles queued (each request costs its bucket's
+        rung, not its raw tile count — padded tiles are what the device
+        will actually materialize). The load-shedding budget
+        (``serve/health.py``) is denominated in these. O(1): kept
+        incrementally by ``submit``/``pop_ready``."""
+        with self._cond:
+            return self._pending_tokens
 
     def _oldest_head(self) -> Optional[SlideRequest]:
         heads = [lane[0] for lane in self._lanes.values() if lane]
@@ -137,6 +151,7 @@ class RequestQueue:
                 self._lanes[pick.bucket_n] = rest
             else:
                 del self._lanes[pick.bucket_n]
+            self._pending_tokens -= pick.bucket_n * len(batch)
         for req in batch:
             req.t_dispatch = now
         return batch

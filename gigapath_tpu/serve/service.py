@@ -62,9 +62,16 @@ from gigapath_tpu.obs import (
     get_run_log,
     span,
 )
+from gigapath_tpu.resilience.chaos import ChaosError, get_chaos
 from gigapath_tpu.serve.aot import AotExecutableCache
 from gigapath_tpu.serve.buckets import BucketLadder, assemble_batch
 from gigapath_tpu.serve.cache import EmbeddingCache, content_key
+from gigapath_tpu.serve.health import (
+    BreakerOpenError,
+    CircuitBreaker,
+    DeadlineExceededError,
+    LoadSheddedError,
+)
 from gigapath_tpu.serve.queue import RequestQueue, SlideRequest
 
 
@@ -87,6 +94,11 @@ class ServeConfig:
     bucket_max: int = 1 << 20
     bucket_align: int = 128     # rung alignment (the encoder's internal pad)
     feature_dim: int = 1536
+    # self-healing policies (serve/health.py); 0 = policy off
+    shed_tokens: int = 0        # load-shed submits past this queued-token depth
+    deadline_s: float = 0.0     # per-request deadline (fail expired at dispatch)
+    breaker_failures: int = 0   # consecutive failures that open a bucket breaker
+    breaker_cooldown_s: float = 30.0  # open -> half-open probe delay
 
     @classmethod
     def from_env(cls, **overrides) -> "ServeConfig":
@@ -114,6 +126,14 @@ class ServeConfig:
                                       cls.bucket_max)),
             bucket_align=int(env_number("GIGAPATH_SERVE_BUCKET_ALIGN",
                                         cls.bucket_align)),
+            shed_tokens=int(env_number("GIGAPATH_SERVE_SHED_TOKENS",
+                                       cls.shed_tokens)),
+            deadline_s=env_number("GIGAPATH_SERVE_DEADLINE_S",
+                                  cls.deadline_s),
+            breaker_failures=int(env_number(
+                "GIGAPATH_SERVE_BREAKER_FAILURES", cls.breaker_failures)),
+            breaker_cooldown_s=env_number(
+                "GIGAPATH_SERVE_BREAKER_COOLDOWN_S", cls.breaker_cooldown_s),
         )
         return replace(base, **overrides) if overrides else base
 
@@ -195,6 +215,17 @@ class SlideService:
             watchdog=self.watchdog, ledger=self.ledger,
         )
         self.heartbeat = Heartbeat(runlog, name=name)
+        # self-healing (serve/health.py): breaker state, chaos injection
+        # (GIGAPATH_CHAOS read once here, host-side — NullChaos when
+        # unset), the graceful-drain flag the SIGTERM chain flips
+        self.breaker = (
+            CircuitBreaker(self.config.breaker_failures,
+                           self.config.breaker_cooldown_s)
+            if self.config.breaker_failures > 0 else None
+        )
+        self.chaos = get_chaos()
+        self._draining = False
+        self._sigterm_cb = None
         self._pending: Dict[str, SlideRequest] = {}  # in-flight by content
         self._lock = threading.Lock()
         self._worker: Optional[threading.Thread] = None
@@ -203,6 +234,10 @@ class SlideService:
         self.dispatch_count = 0
         self.slides_served = 0
         self.inflight_joins = 0
+        self.shed_count = 0
+        self.deadline_failures = 0
+        self.bisections = 0
+        self.poisoned_requests = 0
         self.per_bucket_dispatches: Dict[int, int] = {}
 
     def capacity_for(self, bucket_n: int) -> int:
@@ -222,7 +257,46 @@ class SlideService:
                 target=self._run, daemon=True, name="serve-dispatch"
             )
             self._worker.start()
+            self._arm_signal_drain()
         return self
+
+    def _arm_signal_drain(self) -> None:
+        """Graceful SIGTERM drain for worker-mode services: the chained
+        handler (obs/flight.py — the GL011-sanctioned signal site) flips
+        the draining flag (new submits rejected) and CLAIMS the
+        shutdown, so the worker finishes the queued batches and the
+        owner exits via close() instead of dying mid-dispatch with
+        in-flight futures stranded."""
+        if self._sigterm_cb is not None:
+            return
+
+        def _drain(signum) -> bool:
+            if self._draining or self._closed:
+                # already draining (or dead): a REPEAT SIGTERM is the
+                # operator escalating past a drain that isn't finishing
+                # (hung dispatch) — don't re-claim graceful, let the
+                # chain proceed to the prior disposition (process death)
+                return False
+            self._draining = True
+            # signal-safe obs: the handler may have interrupted a thread
+            # INSIDE runlog.event() holding its write lock — the
+            # *_from_signal paths try-acquire and drop on contention
+            # instead of self-deadlocking the shutdown
+            pending = self.queue.pending()
+            self.runlog.event_from_signal(
+                "recovery", action="drain", signal=int(signum),
+                pending=pending,
+            )
+            self.runlog.echo_from_signal(
+                "[serve] SIGTERM: draining — new submits rejected, "
+                f"{pending} request(s) still dispatching"
+            )
+            return True  # graceful claim: don't re-raise process death
+
+        from gigapath_tpu.obs.flight import register_signal_callback
+
+        if register_signal_callback(_drain):
+            self._sigterm_cb = _drain
 
     def __enter__(self) -> "SlideService":
         return self.start()
@@ -247,6 +321,12 @@ class SlideService:
                 f"feature dim {feats.shape[1]} != configured "
                 f"{self.config.feature_dim}"
             )
+        if self._draining:
+            raise RuntimeError(
+                "SlideService is draining (SIGTERM received): queued "
+                "requests will finish, new submits are rejected"
+            )
+        bucket_n = self.ladder.bucket_for(feats.shape[0])
         key = content_key(feats, coords, extra=self.identity)
         # cache probe, pending probe and enqueue are ONE atomic section:
         # probing the cache outside the lock would let a dispatch finish
@@ -281,10 +361,35 @@ class SlideService:
                     n_tiles=int(feats.shape[0]), inflight=False,
                 )
                 return fut
+            if self.config.shed_tokens > 0:
+                # load shedding: back-pressure at the door, checked AFTER
+                # the cache/pending probes — a hit or an in-flight join
+                # adds zero padded tokens to the queue and zero device
+                # time, and the hot repeated-slide traffic the cache
+                # exists for is exactly what an earlier check would shed.
+                # The budget is in PADDED tiles (what the device will
+                # materialize); the rejected future fails immediately so
+                # the caller can retry elsewhere instead of waiting on a
+                # queue that cannot keep up
+                depth = self.queue.pending_tokens()
+                if depth + bucket_n > self.config.shed_tokens:
+                    self.shed_count += 1
+                    self.runlog.event(
+                        "recovery", action="shed", slide_id=slide_id,
+                        bucket=bucket_n, queued_tokens=depth,
+                        budget=self.config.shed_tokens,
+                    )
+                    from concurrent.futures import Future
+
+                    fut = Future()
+                    fut.set_exception(LoadSheddedError(
+                        f"queue depth {depth} + {bucket_n} padded tiles "
+                        f"exceeds GIGAPATH_SERVE_SHED_TOKENS="
+                        f"{self.config.shed_tokens}"
+                    ))
+                    return fut
             req = SlideRequest(
-                slide_id, feats, coords,
-                bucket_n=self.ladder.bucket_for(feats.shape[0]),
-                cache_key=key,
+                slide_id, feats, coords, bucket_n=bucket_n, cache_key=key,
             )
             self._pending[key] = req
         self.queue.submit(req)
@@ -295,46 +400,150 @@ class SlideService:
              now: Optional[float] = None) -> int:
         """Process at most ONE ready batch on the calling thread;
         returns the number of slides served. Drivers in sync mode call
-        this in a loop; the worker thread calls it forever."""
+        this in a loop; the worker thread calls it forever.
+
+        Self-healing order per batch: expired deadlines fail first (no
+        device time for answers nobody awaits), then the bucket's
+        circuit breaker gets a say (open -> fail fast; half-open -> this
+        batch is the probe), then the dispatch runs with poisoned-batch
+        bisection — one bad slide fails ONE future, the rest of the
+        batch still returns parity-correct results."""
         batch = self.queue.pop_ready(now=now, drain=drain)
         if not batch:
             return 0
         bucket_n = batch[0].bucket_n
-        capacity = self.capacity_for(bucket_n)
-        try:
-            with span("serve.dispatch", self.runlog, fence=True,
-                      bucket=bucket_n, slides=len(batch)) as sp:
-                embeds, coords, mask = assemble_batch(
-                    [(r.feats, r.coords) for r in batch], bucket_n, capacity,
-                    feature_dim=self.config.feature_dim,
-                )
-                out = self.aot(embeds, coords, mask)
-                sp.fence(out)
-            # host-side conversion and scatter stay INSIDE the poisoned-
-            # batch containment: a MemoryError copying rows out of a big
-            # batch must fail these futures too, not strand their waiters
-            out = _tree_np(out)
-            for i, req in enumerate(batch):
-                result = _to_host(out, i)
-                if req.cache_key is not None:
-                    self.cache.put(req.cache_key, result)
-                    with self._lock:
-                        self._pending.pop(req.cache_key, None)
-                if not req.future.done():
-                    req.future.set_result(result)
-        except Exception as e:
-            # a poisoned batch fails ITS futures, not the service: the
-            # batch was consumed from the queue, so waiters must hear
-            # about it here or hang forever
-            self.runlog.error("serve.dispatch", e)
-            with self._lock:
-                for req in batch:
-                    if req.cache_key is not None:
-                        self._pending.pop(req.cache_key, None)
+        if self.config.deadline_s > 0:
+            live = []
             for req in batch:
-                if not req.future.done():
-                    req.future.set_exception(e)
-            return 0
+                if req.wait_s() > self.config.deadline_s:
+                    self.deadline_failures += 1
+                    self.runlog.event(
+                        "recovery", action="deadline",
+                        slide_id=req.slide_id, bucket=bucket_n,
+                        waited_s=round(req.wait_s(), 6),
+                        deadline_s=self.config.deadline_s,
+                    )
+                    self._fail_requests([req], DeadlineExceededError(
+                        f"{req.slide_id}: waited {req.wait_s():.3f}s > "
+                        f"deadline {self.config.deadline_s}s"
+                    ))
+                else:
+                    live.append(req)
+            batch = live
+            if not batch:
+                return 0
+        if self.breaker is not None:
+            verdict = self.breaker.admit(bucket_n)
+            if verdict == "reject":
+                self.runlog.event(
+                    "recovery", action="breaker_shed", bucket=bucket_n,
+                    slides=len(batch), state=self.breaker.state(bucket_n),
+                )
+                self._fail_requests(batch, BreakerOpenError(
+                    f"bucket {bucket_n}: circuit breaker open"
+                ))
+                return 0
+            if verdict == "probe":
+                self.runlog.event(
+                    "recovery", action="breaker_probe", bucket=bucket_n,
+                    slides=len(batch),
+                )
+        had_failure = [False]
+        served = self._dispatch_with_bisection(batch, had_failure)
+        if self.breaker is not None:
+            transition = (
+                self.breaker.record_failure(bucket_n) if had_failure[0]
+                else self.breaker.record_success(bucket_n)
+            )
+            if transition == "open":
+                self.runlog.event(
+                    "recovery", action="breaker_open", bucket=bucket_n,
+                    cooldown_s=self.config.breaker_cooldown_s,
+                )
+                self.runlog.echo(
+                    f"[serve] circuit breaker OPEN for bucket {bucket_n} "
+                    f"(cooldown {self.config.breaker_cooldown_s:g}s)"
+                )
+            elif transition == "close":
+                self.runlog.event(
+                    "recovery", action="breaker_close", bucket=bucket_n,
+                )
+        return served
+
+    def _fail_requests(self, reqs: List[SlideRequest],
+                       err: Exception) -> None:
+        """Fail futures + drop their in-flight pending entries (waiters
+        must hear, joiners must not latch onto a dead dispatch)."""
+        with self._lock:
+            for req in reqs:
+                if req.cache_key is not None:
+                    self._pending.pop(req.cache_key, None)
+        for req in reqs:
+            if not req.future.done():
+                req.future.set_exception(err)
+
+    def _dispatch_with_bisection(self, batch: List[SlideRequest],
+                                 had_failure: List[bool]) -> int:
+        """Dispatch; on failure, bisect so one poisoned slide fails ONE
+        future instead of the whole batch. Halves re-dispatch at the
+        same bucket shape (batches always pad to full capacity), so
+        bisection adds ZERO compiles — only extra forward passes, and
+        only on the failure path."""
+        try:
+            return self._dispatch(batch)
+        except Exception as e:
+            self.runlog.error("serve.dispatch", e)
+            had_failure[0] = True
+            if len(batch) == 1:
+                req = batch[0]
+                self.poisoned_requests += 1
+                self.runlog.event(
+                    "recovery", action="poisoned_request",
+                    slide_id=req.slide_id, bucket=req.bucket_n,
+                    error=f"{type(e).__name__}: {e}",
+                )
+                self._fail_requests(batch, e)
+                return 0
+            self.bisections += 1
+            self.runlog.event(
+                "recovery", action="bisect", bucket=batch[0].bucket_n,
+                slides=len(batch),
+            )
+            mid = len(batch) // 2
+            return (
+                self._dispatch_with_bisection(batch[:mid], had_failure)
+                + self._dispatch_with_bisection(batch[mid:], had_failure)
+            )
+
+    def _dispatch(self, batch: List[SlideRequest]) -> int:
+        """One assembled forward for one same-bucket batch (the PR-7
+        dispatch body, factored out so bisection can re-enter it)."""
+        bucket_n = batch[0].bucket_n
+        capacity = self.capacity_for(bucket_n)
+        if self.chaos:
+            poisoned = self.chaos.poisoned([r.slide_id for r in batch])
+            if poisoned is not None:
+                raise ChaosError(f"chaos: poisoned slide {poisoned}")
+        with span("serve.dispatch", self.runlog, fence=True,
+                  bucket=bucket_n, slides=len(batch)) as sp:
+            embeds, coords, mask = assemble_batch(
+                [(r.feats, r.coords) for r in batch], bucket_n, capacity,
+                feature_dim=self.config.feature_dim,
+            )
+            out = self.aot(embeds, coords, mask)
+            sp.fence(out)
+        # host-side conversion and scatter stay INSIDE the poisoned-
+        # batch containment: a MemoryError copying rows out of a big
+        # batch must fail these futures too, not strand their waiters
+        out = _tree_np(out)
+        for i, req in enumerate(batch):
+            result = _to_host(out, i)
+            if req.cache_key is not None:
+                self.cache.put(req.cache_key, result)
+                with self._lock:
+                    self._pending.pop(req.cache_key, None)
+            if not req.future.done():
+                req.future.set_result(result)
         self.dispatch_count += 1
         self.slides_served += len(batch)
         self.per_bucket_dispatches[bucket_n] = (
@@ -386,6 +595,11 @@ class SlideService:
             "dispatches": self.dispatch_count,
             "slides_served": self.slides_served,
             "inflight_joins": self.inflight_joins,
+            "shed": self.shed_count,
+            "deadline_failures": self.deadline_failures,
+            "bisections": self.bisections,
+            "poisoned_requests": self.poisoned_requests,
+            "breaker_trips": self.breaker.trips if self.breaker else 0,
             "buckets_used": len(self.per_bucket_dispatches),
             "per_bucket_dispatches": {
                 str(k): v
@@ -401,6 +615,11 @@ class SlideService:
     def close(self, status: str = "ok") -> None:
         if self._closed:
             return
+        if self._sigterm_cb is not None:
+            from gigapath_tpu.obs.flight import unregister_signal_callback
+
+            unregister_signal_callback(self._sigterm_cb)
+            self._sigterm_cb = None
         if self._worker is not None:
             self._stop.set()
             # join until the worker is DEAD, not a fixed grace:

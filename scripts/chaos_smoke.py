@@ -1,0 +1,408 @@
+#!/usr/bin/env python
+"""One-command CPU recovery checklist: every resilience path exercised
+against deterministic chaos injection (ISSUE 8's acceptance driver).
+
+    python scripts/chaos_smoke.py
+    python scripts/chaos_smoke.py --json CHAOS_SMOKE.json
+
+Six checks, each a hard assertion (exit 1 + structured JSON on
+violation, bench.py-style; progress rides stderr):
+
+1. **kill_resume_bit_exact**: ``GIGAPATH_CHAOS=sigterm@1`` kills a REAL
+   subprocess ``train_model`` run (the chained handler lands an
+   emergency checkpoint first); ``resume="auto"`` completes the run and
+   the final params match an uninterrupted baseline BIT-exact with zero
+   unexpected retraces.
+2. **corrupt_ckpt_fallback**: ``corrupt_ckpt`` flips bytes in the
+   latest checkpoint before the resume scan; the scan emits a
+   ``corrupt_checkpoint`` anomaly and falls back to the previous valid
+   one.
+3. **nonfinite_skip**: ``nan_loss@1`` forces a non-finite loss; the
+   in-graph guard skips the update (``nonfinite_step`` anomaly, run
+   completes with finite history) with zero retraces.
+4. **rollback**: two consecutive forced NaN steps with
+   ``GIGAPATH_GUARD_ROLLBACK_AFTER=2`` roll params back to the last
+   checkpoint (``recovery`` event ``action="rollback"``).
+5. **poisoned_batch_bisection**: ``poison@<id>`` fails one slide of a
+   coalesced serve batch; bisection fails exactly ONE future while the
+   other slides return embeddings parity-equal to the exact forward.
+6. **loader_retry_skip**: ``fail_loader`` heals within the retry budget
+   on a transient fault, and an exhausted budget skips the sample with
+   a ``data_retry`` recovery event instead of killing the epoch.
+
+Pure-CPU, tiny arch, synthetic data — no chip, no checkpoint weights.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def echo(msg: str) -> None:
+    print(f"[chaos_smoke +{time.monotonic() - T0:.1f}s] {msg}",
+          file=sys.stderr)
+
+
+T0 = time.monotonic()
+
+TRAIN_KWARGS = dict(
+    num_epochs=2, latent_dim=32, model_arch="gigapath_slide_enc_tiny",
+    feat_layer="1", freeze_pretrained=False, checkpoint_every=2,
+)
+
+_SUBPROCESS_DRIVER = """\
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+from gigapath_tpu.train_gigapath import train_model
+train_model({feature_dir!r}, {labels!r}, {outdir!r}, num_epochs=2,
+            latent_dim=32, model_arch="gigapath_slide_enc_tiny",
+            feat_layer="1", freeze_pretrained=False, checkpoint_every=2)
+print("COMPLETED")
+"""
+
+
+def build_fixture(root: str, seed: int):
+    """Two cached slides of the SAME tile count (one compile per run,
+    unambiguous retrace accounting) + a labels csv."""
+    from gigapath_tpu.utils.checkpoint import save_checkpoint
+
+    feature_dir = os.path.join(root, "features")
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(2):
+        sid = f"s{i}"
+        save_checkpoint(
+            os.path.join(feature_dir, f"{sid}_features"),
+            {"features": rng.normal(size=(8, 16)).astype(np.float32),
+             "coords": rng.normal(size=(8, 2)).astype(np.float32)},
+        )
+        rows.append((sid, i % 2))
+    labels = os.path.join(root, "labels.csv")
+    with open(labels, "w", encoding="utf-8") as fh:
+        fh.write("slide_id,label\n")
+        for sid, lab in rows:
+            fh.write(f"{sid},{lab}\n")
+    return feature_dir, labels
+
+
+def run_events(out_dir: str):
+    files = [
+        p for p in glob.glob(os.path.join(out_dir, "obs", "*.jsonl"))
+        if not os.path.basename(p).startswith("flight-")
+    ]
+    assert files, f"no run files under {out_dir}/obs"
+    with open(max(files, key=os.path.getmtime), encoding="utf-8") as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+def events_of(events, kind, **match):
+    out = [ev for ev in events if ev.get("kind") == kind]
+    for k, v in match.items():
+        out = [ev for ev in out if ev.get(k) == v]
+    return out
+
+
+def chaos_env(spec=None, **extra):
+    """os.environ with GIGAPATH_CHAOS set (or scrubbed) — in-process
+    phases mutate the real env because train_model parses it at driver
+    start; each phase restores via try/finally in run()."""
+    os.environ.pop("GIGAPATH_CHAOS", None)
+    if spec is not None:
+        os.environ["GIGAPATH_CHAOS"] = spec
+    for k, v in extra.items():
+        os.environ[k] = v
+
+
+def train(feature_dir, labels, outdir, **kwargs):
+    from gigapath_tpu.train_gigapath import train_model
+
+    merged = dict(TRAIN_KWARGS)
+    merged.update(kwargs)
+    return train_model(feature_dir, labels, str(outdir), **merged)
+
+
+def final_params(outdir):
+    from gigapath_tpu.utils.checkpoint import restore_checkpoint
+
+    return restore_checkpoint(os.path.join(str(outdir), "model"))
+
+
+def unexpected_retraces(outdir):
+    return [ev for ev in run_events(str(outdir))
+            if ev["kind"] == "compile" and ev.get("unexpected")]
+
+
+def check_kill_resume(root, feature_dir, labels) -> dict:
+    import jax
+
+    echo("1/6 kill_resume_bit_exact: baseline run")
+    baseline = os.path.join(root, "out-baseline")
+    chaos_env(None)
+    train(feature_dir, labels, baseline)
+
+    echo("1/6 kill_resume_bit_exact: SIGTERM@1 subprocess run")
+    run_dir = os.path.join(root, "out-run")
+    env = dict(os.environ)
+    env.update({"GIGAPATH_CHAOS": "sigterm@1", "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": REPO})
+    script = _SUBPROCESS_DRIVER.format(
+        repo=REPO, feature_dir=feature_dir, labels=labels, outdir=run_dir,
+    )
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=600)
+    assert "COMPLETED" not in proc.stdout and proc.returncode != 0, (
+        "the chaos SIGTERM did not kill the driver"
+    )
+    emergencies = events_of(run_events(run_dir), "recovery",
+                            action="emergency_checkpoint")
+    assert emergencies, "no emergency checkpoint landed before death"
+
+    echo("1/6 kill_resume_bit_exact: resume='auto'")
+    chaos_env(None)
+    train(feature_dir, labels, run_dir, resume="auto")
+    resumes = events_of(run_events(run_dir), "recovery", action="resume")
+    assert resumes, "resume='auto' did not restore a checkpoint"
+    assert not unexpected_retraces(run_dir), "resume paid a retrace"
+
+    a = jax.tree_util.tree_leaves(final_params(baseline))
+    b = jax.tree_util.tree_leaves(final_params(run_dir))
+    assert len(a) == len(b) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(a, b)
+    ), "resumed params are NOT bit-exact vs the uninterrupted baseline"
+    echo("1/6 ok: resumed params bit-exact, zero retraces")
+    return {"resume_step": resumes[0].get("step"),
+            "emergency_step": emergencies[0].get("step")}
+
+
+def check_corrupt_fallback(root, feature_dir, labels) -> dict:
+    echo("2/6 corrupt_ckpt_fallback: corrupt latest, resume")
+    run_dir = os.path.join(root, "out-run")  # the killed+resumed dir
+    chaos_env("corrupt_ckpt")
+    train(feature_dir, labels, run_dir, resume="auto")
+    events = run_events(run_dir)
+    anomalies = events_of(events, "anomaly", detector="corrupt_checkpoint")
+    assert anomalies, "no corrupt_checkpoint anomaly on the poisoned scan"
+    resumes = events_of(events, "recovery", action="resume")
+    assert resumes and resumes[0].get("fallbacks", 0) >= 1, (
+        "the scan did not fall back past the corrupted checkpoint"
+    )
+    echo("2/6 ok: fell back past the corrupt checkpoint with an anomaly")
+    return {"fallbacks": resumes[0]["fallbacks"]}
+
+
+def check_nonfinite_skip(root, feature_dir, labels) -> dict:
+    echo("3/6 nonfinite_skip: nan_loss@1 run under the guard")
+    run_dir = os.path.join(root, "out-nan")
+    chaos_env("nan_loss@1")
+    result = train(feature_dir, labels, run_dir)
+    assert np.isfinite(result["loss_history"]).all(), (
+        "the skipped NaN leaked into the loss history"
+    )
+    events = run_events(run_dir)
+    assert events_of(events, "anomaly", detector="nonfinite_step"), (
+        "no nonfinite_step anomaly"
+    )
+    skips = events_of(events, "recovery", action="skip_step")
+    assert len(skips) == 1 and skips[0]["step"] == 1
+    assert not unexpected_retraces(run_dir), "the guard paid a retrace"
+    echo("3/6 ok: NaN step skipped, zero retraces")
+    return {"skipped_steps": len(skips)}
+
+
+def check_rollback(root, feature_dir, labels) -> dict:
+    echo("4/6 rollback: two consecutive NaN steps, rollback_after=2")
+    run_dir = os.path.join(root, "out-rollback")
+    chaos_env("nan_loss@1,nan_loss@2", GIGAPATH_GUARD_ROLLBACK_AFTER="2")
+    try:
+        train(feature_dir, labels, run_dir, checkpoint_every=1)
+    finally:
+        os.environ.pop("GIGAPATH_GUARD_ROLLBACK_AFTER", None)
+    rollbacks = events_of(run_events(run_dir), "recovery",
+                          action="rollback")
+    assert rollbacks, "no rollback after M consecutive skips"
+    echo("4/6 ok: rolled back to the last checkpoint")
+    return {"rollbacks": len(rollbacks)}
+
+
+def check_poisoned_bisection(root) -> dict:
+    echo("5/6 poisoned_batch_bisection: one bad slide in a batch of 3")
+    from gigapath_tpu.models.classification_head import get_model
+    from gigapath_tpu.resilience.chaos import ChaosError
+    from gigapath_tpu.serve import ServeConfig, SlideService
+
+    model, params = get_model(
+        input_dim=16, latent_dim=32, feat_layer="1", n_classes=2,
+        model_arch="gigapath_slide_enc_tiny", dtype=None,
+    )
+
+    def forward(p, embeds, coords, pad_mask):
+        return model.apply({"params": p}, embeds, coords,
+                           pad_mask=pad_mask, deterministic=True)
+
+    rng = np.random.default_rng(0)
+    slides = [
+        (f"s{i}_n{n}", rng.normal(size=(n, 16)).astype(np.float32),
+         rng.uniform(0, 25000, (n, 2)).astype(np.float32))
+        for i, n in enumerate([5, 7, 9])
+    ]
+    poisoned_id = slides[1][0]
+    chaos_env(f"poison@{poisoned_id}")
+    out_dir = os.path.join(root, "out-serve")
+    service = SlideService(
+        forward, params,
+        config=ServeConfig(
+            max_batch=4, max_wait_s=0.01, bucket_min=16,
+            bucket_growth=2.0, bucket_max=64, bucket_align=16,
+            feature_dim=16, artifact_dir=None,
+        ),
+        out_dir=out_dir, identity="chaos-smoke",
+    )
+    futs = [service.submit(*s) for s in slides]
+    while service.step(drain=True):
+        pass
+    failed = [i for i, f in enumerate(futs)
+              if isinstance(f.exception(timeout=10), ChaosError)]
+    assert failed == [1], (
+        f"bisection failed futures {failed}, expected exactly [1]"
+    )
+    for (sid, f, c), fut in zip(slides, futs):
+        if sid == poisoned_id:
+            continue
+        exact = np.asarray(model.apply(
+            {"params": params}, f[None], c[None], deterministic=True,
+        ), np.float32)[0]
+        np.testing.assert_allclose(
+            np.asarray(fut.result(timeout=10), np.float32), exact,
+            atol=1e-5,
+        )
+    assert service.poisoned_requests == 1 and service.bisections >= 1
+    service.close()
+    echo("5/6 ok: one future failed, the rest parity-correct")
+    return {"bisections": service.bisections}
+
+
+def check_loader_retry(root) -> dict:
+    echo("6/6 loader_retry_skip: transient heal + exhausted skip")
+    import h5py
+    import pandas as pd
+
+    from gigapath_tpu.data.slide_dataset import SlideDataset
+    from gigapath_tpu.obs.runlog import RunLog
+
+    h5_root = os.path.join(root, "h5_files")
+    os.makedirs(h5_root, exist_ok=True)
+    rng = np.random.default_rng(0)
+    rows = []
+    for i in range(2):
+        with h5py.File(os.path.join(h5_root, f"slide_{i}.h5"), "w") as f:
+            f.create_dataset(
+                "features", data=rng.normal(size=(8, 16)).astype(np.float32)
+            )
+            f.create_dataset(
+                "coords",
+                data=rng.integers(0, 5000, (8, 2)).astype(np.float32),
+            )
+        rows.append({"slide_id": f"slide_{i}.svs", "pat_id": f"pat_{i}",
+                     "label": ["neg", "pos"][i]})
+    cfg = {"setting": "multi_class", "label_dict": {"neg": 0, "pos": 1},
+           "max_tiles": 10}
+
+    def make(retry):
+        df = pd.DataFrame(rows)
+        return SlideDataset(df, h5_root, splits=df["pat_id"].tolist(),
+                            task_config=cfg, retry=retry,
+                            retry_backoff_s=0.0)
+
+    chaos_env("fail_loader@0x1")
+    assert make(retry=3).get_sample_with_try(0) is not None, (
+        "a transient fault did not heal within the retry budget"
+    )
+    chaos_env("fail_loader@0x9")
+    ds = make(retry=2)
+    log = RunLog(os.path.join(root, "loader-run.jsonl"), driver="smoke",
+                 echo=False)
+    ds.set_runlog(log)
+    assert ds.get_sample_with_try(0) is None, "exhausted retries must skip"
+    with open(log.path, encoding="utf-8") as fh:
+        events = [json.loads(line) for line in fh if line.strip()]
+    assert events_of(events, "recovery", action="data_retry"), (
+        "no data_retry recovery event on the skip"
+    )
+    echo("6/6 ok: transient heals, exhaustion skips with an event")
+    return {"retry": 2}
+
+
+def run(args) -> dict:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    root = args.out_dir or tempfile.mkdtemp(prefix="chaos-smoke-")
+    feature_dir, labels = build_fixture(root, args.seed)
+    checks = {}
+    checks["kill_resume_bit_exact"] = check_kill_resume(
+        root, feature_dir, labels)
+    checks["corrupt_ckpt_fallback"] = check_corrupt_fallback(
+        root, feature_dir, labels)
+    checks["nonfinite_skip"] = check_nonfinite_skip(
+        root, feature_dir, labels)
+    checks["rollback"] = check_rollback(root, feature_dir, labels)
+    checks["poisoned_batch_bisection"] = check_poisoned_bisection(root)
+    checks["loader_retry_skip"] = check_loader_retry(root)
+    chaos_env(None)
+    return {
+        "metric": "chaos_smoke",
+        "checks": checks,
+        "checks_passed": len(checks),
+        "wall_s": round(time.monotonic() - T0, 3),
+        "backend": jax.default_backend(),
+        "out_dir": root,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="one-command CPU recovery checklist (module docstring)"
+    )
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out-dir", default=None,
+                    help="work dir (default: fresh temp dir)")
+    ap.add_argument("--json", default=None, help="also write the payload here")
+    args = ap.parse_args(argv)
+
+    try:
+        payload = run(args)
+        payload["rc"] = 0
+    except Exception as e:
+        payload = {
+            "metric": "chaos_smoke", "rc": 1,
+            "error": f"{type(e).__name__}: {e}",
+        }
+    finally:
+        os.environ.pop("GIGAPATH_CHAOS", None)
+    line = json.dumps(payload, sort_keys=True)
+    print(line)
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as fh:
+            fh.write(line + "\n")
+    return payload["rc"]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,8 +13,10 @@ retrace table with unexpected retraces flagged), spans (per-name
 durations; with multi-host input a per-rank skew/straggler table —
 max/median step span per rank, worst rank called out), anomalies (per
 detector, with the reactions taken — flight-dump path, profiler trace
-dir), eval history, timeline (heartbeats, stalls, silent gaps between
-consecutive events). Passing a flight recorder dump
+dir), recovery (the fault-tolerance layer's actions — skips,
+rollbacks, resumes, data retries, sheds, deadline failures, breaker
+trips, drains — per action with its context), eval history, timeline
+(heartbeats, stalls, silent gaps between consecutive events). Passing a flight recorder dump
 (``flight-<run-id>.jsonl``) renders a flight-dumps summary (reason,
 dump ordinal, buffered-context size) above the usual sections folded
 from the dumped context events.
@@ -253,6 +255,56 @@ def render(events: List[dict], out=None) -> int:
             )
         w("\n")
 
+    # -- recovery (gigapath_tpu.resilience + serving self-healing) --------
+    recoveries = by_kind.get("recovery", [])
+    if recoveries:
+        w("== recovery ==\n")
+        by_action: Dict[str, int] = {}
+        for ev in recoveries:
+            action = str(ev.get("action", "?"))
+            by_action[action] = by_action.get(action, 0) + 1
+        w("recovery actions: {} ({})\n".format(
+            len(recoveries),
+            ", ".join(f"{a} x{n}" for a, n in sorted(by_action.items())),
+        ))
+        for ev in recoveries:
+            bits = []
+            if ev.get("step") is not None:
+                bits.append(f"step {ev['step']}")
+            if ev.get("to_step") is not None:
+                bits.append(f"-> step {ev['to_step']}")
+            if ev.get("fallbacks"):
+                bits.append(f"past {ev['fallbacks']} corrupt checkpoint(s)")
+            if ev.get("consecutive") is not None:
+                bits.append(f"{ev['consecutive']} consecutive")
+            if ev.get("slide_id") is not None:
+                bits.append(f"slide {ev['slide_id']}")
+            if ev.get("index") is not None:
+                bits.append(f"sample {ev['index']}")
+            if ev.get("attempts") is not None:
+                bits.append(f"after {ev['attempts']} attempt(s)")
+            if ev.get("bucket") is not None:
+                bits.append(f"bucket {ev['bucket']}")
+            if ev.get("queued_tokens") is not None:
+                bits.append(
+                    f"{ev['queued_tokens']} queued tokens vs budget "
+                    f"{ev.get('budget')}"
+                )
+            if ev.get("waited_s") is not None:
+                bits.append(
+                    f"waited {_fmt_s(ev['waited_s'])} vs deadline "
+                    f"{_fmt_s(ev.get('deadline_s'))}"
+                )
+            if ev.get("path"):
+                bits.append(f"-> {ev['path']}")
+            w(
+                f"  {str(ev.get('action', '?')).upper()} at "
+                f"+{ev.get('t', 0.0) - t0:.1f}s"
+                + ((": " + ", ".join(bits)) if bits else "")
+                + "\n"
+            )
+        w("\n")
+
     # -- serving (gigapath_tpu.serve: dispatch/cache telemetry) -----------
     serves = by_kind.get("serve_dispatch", [])
     cache_hits = by_kind.get("cache_hit", [])
@@ -415,6 +467,17 @@ def selftest() -> int:
                   inflight=False)
         log.event("cache_hit", slide_id="s1", key="abcd", n_tiles=100,
                   inflight=True)
+        # recovery telemetry (gigapath_tpu.resilience + serving
+        # self-healing): one event per action family the layer emits
+        log.recovery(action="skip_step", step=7, consecutive=1)
+        log.recovery(action="rollback", step=9, to_step=5)
+        log.recovery(action="resume", step=5, path="/ckpts/ckpt-00000005",
+                     fallbacks=1)
+        log.recovery(action="data_retry", index=3, slide_id="s3",
+                     attempts=3, error="OSError: truncated h5")
+        log.recovery(action="shed", slide_id="s9", bucket=256,
+                     queued_tokens=4096, budget=4096)
+        log.recovery(action="breaker_open", bucket=512, cooldown_s=30.0)
         with Heartbeat(log, interval_s=0.05, stall_after_s=0.15,
                        name="selftest") as hb:
             hb.beat(24)
@@ -457,7 +520,13 @@ def selftest() -> int:
                 "== serving ==", "batch occupancy", "queue wait",
                 "2 hit(s) / 11 request(s)", "1 in-flight join(s)",
                 "per-bucket dispatch table", "256: 2 dispatch(es)",
-                "512: 1 dispatch(es)")
+                "512: 1 dispatch(es)",
+                "== recovery ==", "breaker_open x1", "resume x1",
+                "skip_step x1",
+                "ROLLBACK at", "step 9, -> step 5",
+                "RESUME at", "past 1 corrupt checkpoint(s)",
+                "DATA_RETRY at", "sample 3, after 3 attempt(s)",
+                "SHED at", "4096 queued tokens vs budget 4096")
     missing = [s for s in required if s not in text]
     required_fl = ("== flight dumps ==", "reason=step_time_spike")
     missing_fl = [s for s in required_fl if s not in text_fl]

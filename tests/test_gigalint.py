@@ -50,7 +50,7 @@ def test_fixture_tree_fires_every_rule_class():
     assert result.exit_code != 0
     fired = {f.rule for f in result.findings}
     expected = {"GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-                "GL007", "GL008", "GL009", "GL010"}
+                "GL007", "GL008", "GL009", "GL010", "GL011"}
     assert fired >= expected, (
         f"missing rule classes: {sorted(expected - fired)}"
     )
@@ -95,6 +95,9 @@ def test_fixture_specific_findings():
         # open-ended jax.profiler pair outside obs/spans.py (the
         # fixture's own obs/spans.py twin is the negative control)
         ("GL010", "profiler.py", "trace_by_hand"),
+        # signal.signal outside obs/flight.py (the fixture's own
+        # obs/flight.py twin is the negative control)
+        ("GL011", "handlers.py", "install_cleanup_handler"),
     }
     assert expected <= got, f"missing: {sorted(expected - got)}"
 

@@ -572,6 +572,90 @@ def check_profiler_hygiene(project: Project) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# GL011 — signal-handler hygiene
+# ---------------------------------------------------------------------------
+
+# A second signal.signal(SIGTERM, ...) call silently REPLACES the first:
+# whichever library module installs its handler last wins, and the
+# flight recorder's final dump (plus every chained recovery callback —
+# emergency checkpoints, serving drains) silently stops running. Library
+# code must register through gigapath_tpu/obs/flight.py's single
+# chaining handler (register_signal_dump / register_signal_callback) —
+# the one sanctioned signal.signal site.
+_GL011_SIGNAL_SUFFIXES = ("signal.signal",)
+_GL011_FULL_NAMES = frozenset({"signal.signal"})
+# matched by path suffix so fixture trees can carry their own
+# obs/flight.py twin as a negative control (the GL010 pattern)
+_GL011_SANCTIONED_SUFFIX = "obs/flight.py"
+_GL011_EXEMPT_SEGMENTS = frozenset({"scripts", "tests", "demo"})
+
+
+@register(
+    "GL011",
+    "signal.signal() called directly in library code — a handler installed "
+    "outside gigapath_tpu/obs/flight.py silently clobbers the chained "
+    "SIGTERM handler (flight dump, emergency checkpoint, serving drain); "
+    "register via flight.register_signal_dump/register_signal_callback",
+)
+def check_signal_hygiene(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.modules.values():
+        segments = mod.path.split("/")[:-1]
+        if mod.is_test_file or any(
+            s in _GL011_EXEMPT_SEGMENTS for s in segments
+        ):
+            continue
+        if (
+            mod.path == _GL011_SANCTIONED_SUFFIX.split("/")[-1]
+            or mod.path.endswith("/" + _GL011_SANCTIONED_SUFFIX)
+            or mod.path == _GL011_SANCTIONED_SUFFIX
+        ):
+            continue
+        spans = sorted(
+            (
+                (fn.lineno, getattr(fn.node, "end_lineno", fn.lineno), fn)
+                for fn in mod.functions.values()
+            ),
+            key=lambda t: t[1] - t[0],
+        )
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if not name:
+                continue
+            # expand a leading import alias (``from signal import
+            # signal``; ``import signal as sig``)
+            head, sep, rest = name.partition(".")
+            target = mod.imports.get(head)
+            resolved = (f"{target}.{rest}" if sep else target) if target else name
+            # suffix match only at a dotted boundary: a bare endswith
+            # would flag e.g. ``shutdown_signal.signal(...)`` (the name
+            # 'shutdown_signal.signal' ends with 'signal.signal' without
+            # ever touching the signal module)
+            if not (
+                resolved in _GL011_FULL_NAMES
+                or any(resolved.endswith("." + s)
+                       for s in _GL011_SIGNAL_SUFFIXES)
+            ):
+                continue
+            symbol = "<module>"
+            for lo, hi, fn in spans:
+                if lo <= node.lineno <= hi:
+                    symbol = fn.qualname
+                    break
+            findings.append(Finding(
+                "GL011", mod.path, node.lineno, symbol,
+                f"direct {resolved}() in library code: the last installer "
+                "wins and the chained SIGTERM handler (flight dump + "
+                "recovery callbacks) is silently clobbered — register via "
+                "gigapath_tpu.obs.flight.register_signal_callback/"
+                "register_signal_dump instead",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # GL004 — forbidden APIs
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,28 @@
+"""Seeded GL011 violation (never imported — parsed only).
+
+This module installs its own SIGTERM handler with ``signal.signal`` in
+library code — the exact handler-clobbering class GL011 exists to
+catch: whichever module installs last wins, and the flight recorder's
+final dump plus every chained recovery callback (emergency checkpoint,
+serving drain) silently stops running. The sanctioned twin lives in the
+fixture's ``obs/flight.py`` (path-suffix sanctioned, like the real
+``gigapath_tpu/obs/flight.py``).
+"""
+
+import signal
+
+
+def install_cleanup_handler(cleanup_fn):
+    # GL011: signal.signal outside the sanctioned flight module — this
+    # handler silently REPLACES the chained flight-dump handler
+    def _handler(signum, frame):
+        cleanup_fn()
+
+    signal.signal(signal.SIGTERM, _handler)
+
+
+def negative_control_boundary_signal(shutdown_signal):
+    # NOT a violation: 'shutdown_signal.signal' ends with the literal
+    # 'signal.signal' but never touches the signal module — the rule
+    # must match suffixes only at a dotted boundary
+    shutdown_signal.signal("drain")

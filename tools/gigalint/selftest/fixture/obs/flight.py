@@ -1,0 +1,12 @@
+"""GL011 negative control (never imported — parsed only).
+
+Same ``signal.signal`` call as ``../models/handlers.py``, but this
+module's path ends in ``obs/flight.py`` — the sanctioned single-
+chaining-handler location — so no finding may fire here.
+"""
+
+import signal
+
+
+def negative_control_sanctioned_install(handler):
+    return signal.signal(signal.SIGTERM, handler)
