@@ -46,6 +46,7 @@ from gigapath_tpu.obs import (
     Heartbeat,
     NullRunLog,
     get_ledger,
+    get_metrics,
     get_run_log,
     span,
 )
@@ -356,6 +357,12 @@ def train_one_epoch(
     """One epoch (reference ``train_one_epoch:223``); per-iteration LR rides
     inside the optimizer schedule."""
     runlog = runlog if runlog is not None else NullRunLog(driver="finetune")
+    # typed metrics (attach-once: one registry per run across epochs;
+    # the final snapshot flushes inside run_end via the registry's
+    # closer). Only the synced 20-iteration walls are observed — they
+    # are the device-truth numbers the report already trusts
+    metrics = get_metrics(runlog)
+    step_walls = metrics.histogram("finetune.step_wall_s")
     start_time = time.time()
     seq_len = 0
     records = get_records_array(len(train_loader), args.n_classes)
@@ -430,6 +437,8 @@ def train_one_epoch(
                 seq_len=seq_len / (batch_idx + 1),
                 **scalars,
             )
+            step_walls.observe(round(t_now - t_prev, 6))
+            metrics.maybe_flush()
             runlog.echo(
                 "Epoch: {}, Batch: {}, Loss: {:.4f}, Time: {:.4f} sec/it, "
                 "Seq len: {:.1f}, Slide ID: {}".format(

@@ -35,6 +35,7 @@ from gigapath_tpu.obs import (
     Heartbeat,
     console,
     get_ledger,
+    get_metrics,
     get_run_log,
     span,
 )
@@ -284,6 +285,10 @@ def run_inference(
     ledger = get_ledger(runlog)
     watchdog = CompileWatchdog("inference.forward", runlog, ledger=ledger)
     instrumented_forward = watchdog.wrap(forward)
+    # typed metrics (obs/metrics.py): per-slide wall histogram; the
+    # final snapshot flushes inside run_end via the registry's closer
+    metrics = get_metrics(runlog)
+    slide_walls = metrics.histogram("inference.slide_wall_s")
 
     results = []
     warned = False
@@ -324,6 +329,9 @@ def run_inference(
                     n_tiles=int(feats.shape[1]), predicted_label=pred,
                     confidence=float(probs[pred]),
                 )
+                if sp.dur_s is not None:
+                    slide_walls.observe(sp.dur_s)
+                metrics.maybe_flush()
                 heartbeat.beat(idx)
     except Exception as e:
         fail_run(runlog, "inference.run_inference", e)

@@ -32,6 +32,7 @@ from gigapath_tpu.obs import (
     Heartbeat,
     console,
     get_ledger,
+    get_metrics,
     get_run_log,
     span,
 )
@@ -239,6 +240,10 @@ def _train_loop(
     """The heartbeat-monitored iteration loop; returns
     ``(params, best_f1, last_f1)``."""
     best_f1, f1 = 0.0, 0.0
+    # typed metrics (attach-once: same registry as the driver's; the
+    # final snapshot flushes inside run_end via the registry's closer)
+    metrics = get_metrics(runlog)
+    step_walls = metrics.histogram("linear_probe.step_wall_s")
     with Heartbeat(runlog, name="linear_probe") as heartbeat:
         t_prev = time.time()
         for i, (embed, target) in enumerate(itertools.islice(train_stream, train_iters)):
@@ -253,6 +258,8 @@ def _train_loop(
                     i, wall_s=round(t_now - t_prev, 6), synced=True,
                     loss=float(loss), lr=cur_lr,
                 )
+                step_walls.observe(round(t_now - t_prev, 6))
+                metrics.maybe_flush()
                 t_prev = t_now
                 runlog.echo(
                     f"Iteration [{i}/{train_iters}]\tLoss: {float(loss)}\tLR: {cur_lr}",

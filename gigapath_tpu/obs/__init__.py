@@ -22,7 +22,18 @@
   (:mod:`gigapath_tpu.obs.flight`), budgeted profiler captures;
 - :mod:`gigapath_tpu.obs.history` — the cross-run perf-history surface:
   fold BENCH/MULTICHIP snapshots and per-run ledgers into one
-  append-only trend file that ``scripts/perf_history.py`` gates on.
+  append-only trend file that ``scripts/perf_history.py`` gates on;
+- :mod:`gigapath_tpu.obs.metrics` — typed metrics registry (counters,
+  gauges, exponential-bucket histograms with atomic snapshot/merge,
+  JSON + Prometheus exporters, periodic ``metrics`` events) and the
+  :class:`~gigapath_tpu.obs.metrics.SloTracker` whose burn-rate
+  transitions feed the anomaly engine's ``slo_burn`` detector — plus
+  the ONE shared :func:`~gigapath_tpu.obs.metrics.percentile`
+  implementation (GL012);
+- :mod:`gigapath_tpu.obs.reqtrace` — end-to-end request tracing:
+  ``RequestTrace`` contexts with stable ``trace_id``/``span_id`` pairs
+  threaded submit -> queue -> dispatch -> forward -> cache store ->
+  resolution, exported per run as Perfetto-loadable Chrome-trace JSON.
 
 Fold a run's JSONL into a human report with ``scripts/obs_report.py``.
 """
@@ -42,6 +53,21 @@ from gigapath_tpu.obs.ledger import (
     capture_profile,
     get_ledger,
     jaxpr_fingerprint,
+)
+from gigapath_tpu.obs.metrics import (
+    Histogram,
+    MetricsRegistry,
+    NullMetricsRegistry,
+    NullSloTracker,
+    SloTracker,
+    get_metrics,
+    merge_snapshots,
+    percentile,
+)
+from gigapath_tpu.obs.reqtrace import (
+    RequestTrace,
+    TraceCollector,
+    get_tracer,
 )
 from gigapath_tpu.obs.runlog import (
     EVENT_KINDS,
@@ -70,20 +96,31 @@ __all__ = [
     "CompileWatchdog",
     "FlightRecorder",
     "Heartbeat",
+    "Histogram",
+    "MetricsRegistry",
     "NullAnomalyEngine",
     "NullLedger",
+    "NullMetricsRegistry",
     "NullRunLog",
+    "NullSloTracker",
     "PerfLedger",
+    "RequestTrace",
     "RunLog",
+    "SloTracker",
     "Span",
+    "TraceCollector",
     "annotate",
     "attach_anomaly_engine",
     "capture_profile",
     "console",
     "get_ledger",
+    "get_metrics",
     "get_run_log",
+    "get_tracer",
     "jaxpr_fingerprint",
     "memory_watermarks",
+    "merge_snapshots",
+    "percentile",
     "span",
     "start_trace",
     "stop_trace",

@@ -45,7 +45,16 @@ the traced programs are untouched, so the engine can add no retraces):
 - ``nonfinite_step``     — a ``step`` event tagged ``nonfinite=True``
   by the in-graph non-finite guard
   (:mod:`gigapath_tpu.resilience.guard`): the optimizer update was a
-  zero-update skip because loss or the grad norm went non-finite.
+  zero-update skip because loss or the grad norm went non-finite;
+- ``slo_burn``           — an ``slo`` event with ``burning: true`` from
+  the :class:`~gigapath_tpu.obs.metrics.SloTracker` (the serving
+  stack's latency SLO spent its error budget past the burn threshold on
+  both the short and the long window — no re-detection: the tracker
+  owns the multi-window math and is transition-edged, so a sustained
+  bad regime is ONE anomaly, the same "one deadline, one owner" rule as
+  ``stall``). The reaction — flight dump + armed profiler capture — is
+  exactly what a degrading p99 needs: the next few dispatches run
+  inside a trace.
 
 ``error`` events trigger a flight dump (context for the post-mortem)
 without counting as an anomaly. Per-detector cooldowns (in step events)
@@ -71,7 +80,7 @@ from gigapath_tpu.obs.flight import FlightRecorder, register_signal_dump
 
 DETECTORS = (
     "step_time_spike", "throughput_dip", "stall", "unexpected_retrace",
-    "memory_watermark", "nonfinite_step",
+    "memory_watermark", "nonfinite_step", "slo_burn",
 )
 
 
@@ -316,6 +325,20 @@ class AnomalyEngine(NullAnomalyEngine):
                     "unexpected_retrace",
                     fn=record.get("fn"), key=record.get("key"),
                     compile_count=record.get("count"),
+                )
+            elif kind == "slo" and record.get("burning") and not \
+                    record.get("final"):
+                # the SloTracker's burning TRANSITION (terminal status
+                # events are marked final and never fire — a run that
+                # ends while burning already fired at entry)
+                self._fire(
+                    "slo_burn",
+                    value=record.get("burn_short"),
+                    baseline=record.get("threshold"),
+                    target_s=record.get("target_s"),
+                    budget=record.get("budget"),
+                    burn_long=record.get("burn_long"),
+                    latency_s=record.get("latency_s"),
                 )
             elif kind == "error":
                 # context dump only — the error event is its own record

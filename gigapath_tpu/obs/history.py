@@ -48,6 +48,12 @@ _DIRECTION_RULES: Tuple[Tuple[str, str], ...] = (
     ("cache_hit_rate", "up"),
     ("queue_wait_p50_s", "down"),
     ("queue_wait_p90_s", "down"),
+    # latency-histogram quantiles (the serve|latency entry and any
+    # future *_pNN_s metric): tail latency down-is-good
+    ("_p50_s", "down"),
+    ("_p90_s", "down"),
+    ("_p95_s", "down"),
+    ("_p99_s", "down"),
     ("compile_seconds_total", "down"),
     ("vs_baseline", "up"),
     ("mfu", "up"),
@@ -195,15 +201,15 @@ _SERVE_METRICS = (
 )
 
 
-def fold_serve(doc: dict, snapshot: dict, label: str,
-               source: Optional[str] = None, force: bool = False) -> dict:
-    """One serve_smoke JSON -> one point under ``serve|smoke``.
-
-    A failed run (rc != 0 / error) or a NON-CHIP backend lands STALE:
+def _fold_serve_snapshot(doc: dict, snapshot: dict, label: str, *,
+                         key: str, metric_keys: Tuple[str, ...],
+                         source: Optional[str], force: bool) -> dict:
+    """The ONE serve-smoke staleness policy (shared by the throughput
+    and latency entries so the two verdicts can never diverge): a
+    failed run (rc != 0 / error) or a NON-CHIP backend lands STALE —
     CPU smoke numbers carry the metric KEYS for future on-chip rounds
-    (the acceptance surface of ROADMAP item 1) without ever moving the
-    trend — a laptop's queue-wait percentiles are not a perf baseline.
-    """
+    without ever moving the trend; a laptop's percentiles are not a
+    perf baseline."""
     parsed = snapshot.get("parsed", snapshot)
     if not isinstance(parsed, dict):
         parsed = {}
@@ -214,7 +220,7 @@ def fold_serve(doc: dict, snapshot: dict, label: str,
         or backend not in ("tpu", "gpu")
     )
     metrics = {
-        k: parsed[k] for k in _SERVE_METRICS
+        k: parsed[k] for k in metric_keys
         if _finite_number(parsed.get(k)) is not None
     }
     note = None
@@ -224,8 +230,40 @@ def fold_serve(doc: dict, snapshot: dict, label: str,
             or f"backend={backend or '?'}: not an on-chip measurement"
         )[:200]
     return append_point(
-        doc, "serve|smoke", label, metrics, source=source,
+        doc, key, label, metrics, source=source,
         stale=stale, note=note, force=force,
+    )
+
+
+def fold_serve(doc: dict, snapshot: dict, label: str,
+               source: Optional[str] = None, force: bool = False) -> dict:
+    """One serve_smoke JSON -> one point under ``serve|smoke``."""
+    return _fold_serve_snapshot(
+        doc, snapshot, label, key="serve|smoke",
+        metric_keys=_SERVE_METRICS, source=source, force=force,
+    )
+
+
+# serve_smoke latency keys (the metrics-snapshot half of the payload —
+# PR 9's tail-latency acceptance surface) worth trending separately
+# from the throughput-shaped serve|smoke entry: the ISSUE's operating
+# point (10^5-10^6 tiles/slide) is decided by the p99, not the mean
+_SERVE_LATENCY_METRICS = (
+    "e2e_p50_s", "e2e_p90_s", "e2e_p99_s",
+    "dispatch_p50_s", "dispatch_p99_s",
+    "queue_wait_p50_s", "queue_wait_p90_s", "queue_wait_p99_s",
+)
+
+
+def fold_serve_latency(doc: dict, snapshot: dict, label: str,
+                       source: Optional[str] = None,
+                       force: bool = False) -> dict:
+    """One serve_smoke JSON -> one point under ``serve|latency`` (the
+    tail-latency twin of :func:`fold_serve` — same shared staleness
+    policy, different metric keys)."""
+    return _fold_serve_snapshot(
+        doc, snapshot, label, key="serve|latency",
+        metric_keys=_SERVE_LATENCY_METRICS, source=source, force=force,
     )
 
 

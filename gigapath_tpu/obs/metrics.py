@@ -1,0 +1,713 @@
+"""Typed metrics registry: counters, gauges, exponential histograms,
+and the SLO burn-rate tracker — the *measured* half of the serving and
+training stacks.
+
+The bus so far records **events** (runlog), **compiles** (watchdog /
+ledger) and **reactions** (anomaly engine); what it cannot answer is the
+operating question ROADMAP's north star actually asks — *what is the
+p99?* A latency distribution does not live in any single event, and
+folding a JSONL stream per question is a report-time luxury the SLO gate
+cannot afford. This module is the aggregation layer:
+
+- :class:`Counter` / :class:`Gauge` / :class:`Histogram` — typed
+  instruments created once by name on a :class:`MetricsRegistry`. The
+  histogram is exponential-bucketed (upper bounds ``start x growth^i``
+  plus a ``+inf`` overflow), so a 100 us cache probe and a 90 s flagship
+  dispatch land in ONE instrument with bounded memory and conservative
+  (bucket-upper-bound) quantiles.
+- **atomic snapshot / merge** — every instrument shares the registry
+  lock, so :meth:`MetricsRegistry.snapshot` is one consistent cut (no
+  torn histogram where ``count`` moved but a bucket did not), concurrent
+  ``observe`` calls are exact (no dropped or double-counted points —
+  pinned by tests), and :func:`merge_snapshots` folds per-process cuts
+  into a fleet view.
+- **exporters** — :func:`to_json_line` (one JSON object, the bench.py
+  output discipline) and :func:`to_prometheus` (the textfile-collector
+  exposition format), plus a periodic ``metrics`` event on the run log
+  (:meth:`MetricsRegistry.maybe_flush` at observation sites; a final
+  flush rides the runlog's closers, so every ``run_end`` leaves a
+  terminal snapshot in the artifact).
+- :class:`SloTracker` — SRE-style error-budget burn: a latency target
+  plus a budget (allowed slow fraction) over a SHORT and a LONG window;
+  the tracker emits an ``slo`` event when both windows burn past the
+  threshold (fast window: it is happening *now*; long window: it is
+  *sustained*, not one hiccup), which the anomaly engine's ``slo_burn``
+  detector turns into the usual reactions (flight dump + profiler
+  capture).
+
+This module is also the ONE home of the nearest-rank
+:func:`percentile` and the histogram-bucket math — ``scripts/obs_report.py``
+and ``scripts/serve_smoke.py`` import it from here (gigalint GL012
+exists because three hand-rolled copies of "append walls, sort, index"
+had already grown by PR 9).
+
+Pure stdlib, no jax import — snapshots must render on a workstation far
+from any chip, and the registry itself never touches traced code (it
+can add no retraces by construction; the ON-vs-OFF HLO identity is
+pinned anyway). Env gates (``GIGAPATH_METRICS``,
+``GIGAPATH_METRICS_INTERVAL_S``, ``GIGAPATH_METRICS_TEXTFILE``) are
+read ONCE in :func:`get_metrics` at driver/service start — never at
+trace time (GL001-clean: no registry entry point is trace-reachable).
+"""
+
+from __future__ import annotations
+
+import bisect
+import collections
+import json
+import math
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+METRICS_SCHEMA_VERSION = 1
+
+# default latency ladder: 0.1 ms x 2^i for 24 rungs (~839 s top rung) —
+# wide enough for a cache probe and a flagship cold dispatch alike
+DEFAULT_BUCKET_START = 1e-4
+DEFAULT_BUCKET_GROWTH = 2.0
+DEFAULT_BUCKET_COUNT = 24
+
+
+def percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile on an already-sorted list — THE shared
+    implementation (scripts/obs_report.py, scripts/serve_smoke.py and
+    the histogram quantiles below all call this one; GL012 flags
+    hand-rolled copies)."""
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def exponential_bounds(start: float = DEFAULT_BUCKET_START,
+                       growth: float = DEFAULT_BUCKET_GROWTH,
+                       count: int = DEFAULT_BUCKET_COUNT) -> List[float]:
+    """Finite histogram upper bounds ``start x growth^i`` (the ``+inf``
+    overflow bucket is implicit — ``counts`` carries one more slot)."""
+    if start <= 0 or growth <= 1 or count < 1:
+        raise ValueError(
+            f"need start > 0, growth > 1, count >= 1 "
+            f"(got {start}, {growth}, {count})"
+        )
+    return [start * growth ** i for i in range(count)]
+
+
+def histogram_quantile(bounds: List[float], counts: List[int], q: float,
+                       *, vmax: Optional[float] = None) -> float:
+    """Nearest-rank quantile off bucket counts: the answer is the
+    containing bucket's UPPER bound (conservative — a tail-latency gate
+    must over-estimate, never under), clamped to the observed max for
+    the overflow bucket. NaN on an empty histogram."""
+    total = sum(counts)
+    if total == 0:
+        return float("nan")
+    rank = min(total - 1, max(0, int(round(q * (total - 1)))))
+    seen = 0
+    for i, c in enumerate(counts):
+        seen += c
+        if rank < seen:
+            if i < len(bounds):
+                bound = bounds[i]
+                return min(bound, vmax) if vmax is not None else bound
+            # overflow bucket: the only honest upper bound is the max
+            return vmax if vmax is not None else float("inf")
+    return vmax if vmax is not None else float("inf")  # unreachable
+
+
+class Counter:
+    """Monotonic count. ``inc`` under the registry lock — exact under
+    concurrent writers."""
+
+    __slots__ = ("name", "_lock", "value")
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self._lock = lock
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name}: inc({n}) must be >= 0")
+        with self._lock:
+            self.value += n
+
+
+class Gauge:
+    """Last-written value (queue depth, cache bytes)."""
+
+    __slots__ = ("name", "_lock", "value")
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self._lock = lock
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
+
+
+class Histogram:
+    """Exponential-bucket histogram (see module docstring).
+
+    ``counts`` has ``len(bounds) + 1`` slots — the last is the ``+inf``
+    overflow. ``observe`` is one bisect + a handful of scalar updates
+    under the registry lock, so the serving hot path pays O(log buckets)
+    per request and nothing on the device."""
+
+    __slots__ = ("name", "_lock", "bounds", "counts", "count", "sum",
+                 "vmin", "vmax")
+
+    def __init__(self, name: str, lock: threading.Lock,
+                 bounds: Optional[List[float]] = None):
+        self.name = name
+        self._lock = lock
+        self.bounds = list(bounds) if bounds is not None else \
+            exponential_bounds()
+        if any(b <= a for a, b in zip(self.bounds, self.bounds[1:])):
+            raise ValueError(
+                f"histogram {name}: bounds must strictly increase"
+            )
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.vmin: Optional[float] = None
+        self.vmax: Optional[float] = None
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        if not math.isfinite(v):
+            return  # a NaN/inf observation would poison sum/quantiles
+        idx = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self.counts[idx] += 1
+            self.count += 1
+            self.sum += v
+            self.vmin = v if self.vmin is None else min(self.vmin, v)
+            self.vmax = v if self.vmax is None else max(self.vmax, v)
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            return histogram_quantile(self.bounds, self.counts, q,
+                                      vmax=self.vmax)
+
+
+class NullMetricsRegistry:
+    """Obs-off twin: every instrument is shared and absorbs everything —
+    no locks taken, no files, no events (the zero-overhead-when-off
+    guarantee the rest of the bus pins)."""
+
+    path: Optional[str] = None
+
+    class _NullInstrument:
+        name = "null"
+        value = 0.0
+        bounds: List[float] = []
+        counts: List[int] = []
+        count = 0
+        sum = 0.0
+        vmin = vmax = None
+
+        def inc(self, n: float = 1.0) -> None:
+            return None
+
+        def set(self, v: float) -> None:
+            return None
+
+        def observe(self, v: float) -> None:
+            return None
+
+        def quantile(self, q: float) -> float:
+            return float("nan")
+
+    _NULL = _NullInstrument()
+
+    def counter(self, name: str):
+        return self._NULL
+
+    def gauge(self, name: str):
+        return self._NULL
+
+    def histogram(self, name: str, bounds=None):
+        return self._NULL
+
+    def snapshot(self) -> dict:
+        return {"v": METRICS_SCHEMA_VERSION, "counters": {}, "gauges": {},
+                "histograms": {}}
+
+    def flush(self, reason: str = "periodic") -> None:
+        return None
+
+    def maybe_flush(self, now: Optional[float] = None) -> None:
+        return None
+
+
+class MetricsRegistry(NullMetricsRegistry):
+    """Named instruments + the one lock that makes snapshots atomic.
+
+    ``runlog`` (optional) receives ``metrics`` events on flush;
+    ``textfile`` (optional) is rewritten atomically on every flush in
+    the Prometheus textfile-collector format, so a node exporter can
+    scrape a long run without touching the process."""
+
+    def __init__(self, *, runlog=None, interval_s: float = 60.0,
+                 textfile: Optional[str] = None):
+        self.runlog = runlog
+        self.interval_s = float(interval_s)
+        self.textfile = textfile or None
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self._last_flush = time.monotonic()
+        self.flush_count = 0
+
+    # -- instruments (create-once by name; type collisions are bugs) ------
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            inst = self._counters.get(name)
+            if inst is None:
+                self._check_free(name, self._counters)
+                inst = self._counters[name] = Counter(name, self._lock)
+            return inst
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            inst = self._gauges.get(name)
+            if inst is None:
+                self._check_free(name, self._gauges)
+                inst = self._gauges[name] = Gauge(name, self._lock)
+            return inst
+
+    def histogram(self, name: str,
+                  bounds: Optional[List[float]] = None) -> Histogram:
+        with self._lock:
+            inst = self._histograms.get(name)
+            if inst is None:
+                self._check_free(name, self._histograms)
+                inst = self._histograms[name] = Histogram(
+                    name, self._lock, bounds
+                )
+            return inst
+
+    def _check_free(self, name: str, own: dict) -> None:
+        for kind in (self._counters, self._gauges, self._histograms):
+            if kind is not own and name in kind:
+                raise ValueError(
+                    f"metric '{name}' already registered as a different type"
+                )
+
+    # -- atomic snapshot ---------------------------------------------------
+    def snapshot(self) -> dict:
+        """One consistent cut of every instrument (single lock hold)."""
+        with self._lock:
+            return {
+                "v": METRICS_SCHEMA_VERSION,
+                "counters": {n: c.value for n, c in
+                             sorted(self._counters.items())},
+                "gauges": {n: g.value for n, g in
+                           sorted(self._gauges.items())},
+                # quantiles are None (not NaN) on an empty histogram: the
+                # snapshot rides RunLog.event -> json.dumps, and a bare
+                # NaN token breaks the one-strict-JSON-object-per-line
+                # artifact contract every downstream consumer relies on
+                "histograms": {
+                    n: {
+                        "bounds": list(h.bounds),
+                        "counts": list(h.counts),
+                        "count": h.count,
+                        "sum": round(h.sum, 9),
+                        "min": h.vmin,
+                        "max": h.vmax,
+                        "p50": histogram_quantile(h.bounds, h.counts, 0.50,
+                                                  vmax=h.vmax)
+                        if h.count else None,
+                        "p90": histogram_quantile(h.bounds, h.counts, 0.90,
+                                                  vmax=h.vmax)
+                        if h.count else None,
+                        "p99": histogram_quantile(h.bounds, h.counts, 0.99,
+                                                  vmax=h.vmax)
+                        if h.count else None,
+                    }
+                    for n, h in sorted(self._histograms.items())
+                },
+            }
+
+    # -- flushing ----------------------------------------------------------
+    def flush(self, reason: str = "periodic") -> Optional[dict]:
+        """Emit the snapshot: one ``metrics`` event on the run log and
+        (when configured) an atomic textfile rewrite. The final flush is
+        registered as a runlog closer by :func:`get_metrics`, so it runs
+        inside ``run_end`` for free."""
+        snap = self.snapshot()
+        self._last_flush = time.monotonic()
+        self.flush_count += 1
+        if self.runlog is not None:
+            self.runlog.event("metrics", reason=reason, **{
+                k: snap[k] for k in ("counters", "gauges", "histograms")
+            })
+        if self.textfile:
+            try:
+                parent = os.path.dirname(os.path.abspath(self.textfile))
+                os.makedirs(parent, exist_ok=True)
+                tmp = f"{self.textfile}.tmp.{os.getpid()}"
+                with open(tmp, "w", encoding="utf-8") as fh:
+                    fh.write(to_prometheus(snap))
+                os.replace(tmp, self.textfile)  # scrapers never see a torn file
+            except OSError:
+                pass  # metrics must never take a run down
+        return snap
+
+    def maybe_flush(self, now: Optional[float] = None) -> Optional[dict]:
+        """Periodic flush at observation sites: cheap monotonic check,
+        flush when ``interval_s`` elapsed (<= 0 disables the periodic
+        path — the final closer flush still runs)."""
+        if self.interval_s <= 0:
+            return None
+        now = time.monotonic() if now is None else now
+        if now - self._last_flush < self.interval_s:
+            return None
+        return self.flush(reason="periodic")
+
+
+# ---------------------------------------------------------------------------
+# snapshot algebra + exporters
+# ---------------------------------------------------------------------------
+
+def merge_snapshots(a: dict, b: dict) -> dict:
+    """Fold two snapshots (counters add, gauges keep the second cut's
+    value, histograms add bucket-wise — bounds must match, a merged
+    histogram from two ladders would be a silent lie)."""
+    out = {"v": METRICS_SCHEMA_VERSION,
+           "counters": dict(a.get("counters", {})),
+           "gauges": dict(a.get("gauges", {})),
+           "histograms": {k: dict(v) for k, v in
+                          a.get("histograms", {}).items()}}
+    for name, val in b.get("counters", {}).items():
+        out["counters"][name] = out["counters"].get(name, 0.0) + val
+    for name, val in b.get("gauges", {}).items():
+        out["gauges"][name] = val
+    for name, h in b.get("histograms", {}).items():
+        mine = out["histograms"].get(name)
+        if mine is None:
+            out["histograms"][name] = dict(h)
+            continue
+        if list(mine["bounds"]) != list(h["bounds"]):
+            raise ValueError(
+                f"histogram '{name}': cannot merge mismatched bucket "
+                f"bounds ({len(mine['bounds'])} vs {len(h['bounds'])} rungs)"
+            )
+        counts = [x + y for x, y in zip(mine["counts"], h["counts"])]
+        vmaxes = [v for v in (mine.get("max"), h.get("max")) if v is not None]
+        vmins = [v for v in (mine.get("min"), h.get("min")) if v is not None]
+        vmax = max(vmaxes) if vmaxes else None
+        merged = {
+            "bounds": list(mine["bounds"]),
+            "counts": counts,
+            "count": mine["count"] + h["count"],
+            "sum": round(mine["sum"] + h["sum"], 9),
+            "min": min(vmins) if vmins else None,
+            "max": vmax,
+        }
+        for q in (0.50, 0.90, 0.99):
+            merged[f"p{int(q * 100)}"] = histogram_quantile(
+                merged["bounds"], counts, q, vmax=vmax
+            ) if merged["count"] else None
+        out["histograms"][name] = merged
+    return out
+
+
+def to_json_line(snapshot: dict) -> str:
+    """One-line JSON (sorted keys — the bench.py stdout discipline)."""
+    def _clean(v):
+        if isinstance(v, float) and not math.isfinite(v):
+            return None
+        if isinstance(v, dict):
+            return {k: _clean(x) for k, x in v.items()}
+        if isinstance(v, list):
+            return [_clean(x) for x in v]
+        return v
+
+    return json.dumps(_clean(snapshot), sort_keys=True)
+
+
+def _prom_name(name: str) -> str:
+    out = "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+    return out if not out[:1].isdigit() else "_" + out
+
+
+def to_prometheus(snapshot: dict, *, prefix: str = "gigapath_") -> str:
+    """Prometheus textfile-collector exposition: counters and gauges as
+    single samples, histograms with CUMULATIVE ``_bucket{le=...}``
+    series plus ``_sum``/``_count`` (the standard histogram contract)."""
+    lines: List[str] = []
+    for name, val in snapshot.get("counters", {}).items():
+        pn = prefix + _prom_name(name)
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {val:g}")
+    for name, val in snapshot.get("gauges", {}).items():
+        pn = prefix + _prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {val:g}")
+    for name, h in snapshot.get("histograms", {}).items():
+        pn = prefix + _prom_name(name)
+        lines.append(f"# TYPE {pn} histogram")
+        cum = 0
+        for bound, c in zip(h["bounds"], h["counts"]):
+            cum += c
+            lines.append(f'{pn}_bucket{{le="{bound:g}"}} {cum}')
+        cum += h["counts"][len(h["bounds"])] if len(h["counts"]) > len(
+            h["bounds"]) else 0
+        lines.append(f'{pn}_bucket{{le="+Inf"}} {cum}')
+        lines.append(f"{pn}_sum {h['sum']:g}")
+        lines.append(f"{pn}_count {h['count']}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# SLO tracking (error-budget burn rate)
+# ---------------------------------------------------------------------------
+
+class NullSloTracker:
+    """SLO-off twin (no target configured, or obs off)."""
+
+    burning = False
+    target_s = 0.0
+    total = 0
+    violations = 0
+    burn_entries = 0
+
+    def observe(self, latency_s: float, now: Optional[float] = None) -> None:
+        return None
+
+    def observe_failure(self, now: Optional[float] = None) -> None:
+        return None
+
+    def status(self, now: Optional[float] = None) -> dict:
+        return {}
+
+    def emit_status(self, reason: str = "final") -> None:
+        return None
+
+
+class SloTracker(NullSloTracker):
+    """Latency SLO with multi-window error-budget burn (SRE style).
+
+    The SLO: at most ``budget`` of requests may exceed ``target_s``
+    end-to-end. Burn rate per window = (observed slow fraction) /
+    ``budget`` — burn 1.0 spends the budget exactly at the allowed
+    pace, burn >= ``burn_threshold`` on BOTH windows means the budget is
+    being torched *right now* (short window) and it is *not one blip*
+    (long window): that is the page. Transition-edged: one ``slo`` event
+    per entry into the burning state (the anomaly engine's ``slo_burn``
+    detector reacts to it), one per recovery — a sustained bad regime is
+    one anomaly, not one per request.
+
+    All host-side, monotonic-clocked, deterministic under an explicit
+    ``now`` (the queue's testability discipline).
+    """
+
+    def __init__(self, target_s: float, *, budget: float = 0.01,
+                 short_window_s: float = 60.0, long_window_s: float = 300.0,
+                 burn_threshold: float = 2.0, min_events: int = 8,
+                 runlog=None, name: str = "serve"):
+        if target_s <= 0:
+            raise ValueError(f"target_s must be > 0, got {target_s}")
+        if not 0 < budget <= 1:
+            raise ValueError(f"budget must be in (0, 1], got {budget}")
+        if long_window_s < short_window_s:
+            raise ValueError("long window must be >= short window")
+        self.name = name
+        self.target_s = float(target_s)
+        self.budget = float(budget)
+        self.short_window_s = float(short_window_s)
+        self.long_window_s = float(long_window_s)
+        self.burn_threshold = float(burn_threshold)
+        self.min_events = int(min_events)
+        self.runlog = runlog
+        self._lock = threading.Lock()
+        # 1-second time bins (sec -> [events, slow]) pruned to the LONG
+        # window: per-observe cost and memory are O(window seconds), not
+        # O(requests in window) — a deque of every request would walk
+        # (and hold) tens of thousands of tuples per observe on a busy
+        # dispatch worker. The 1 s quantization of the window edge is
+        # noise against minutes-scale windows
+        self._bins: "collections.OrderedDict[int, list]" = \
+            collections.OrderedDict()
+        self.burning = False
+        self.total = 0
+        self.violations = 0
+        self.burn_entries = 0
+
+    def _prune(self, now: float) -> None:
+        horizon = now - self.long_window_s
+        while self._bins:
+            first = next(iter(self._bins))
+            if first + 1 > horizon:  # bin [first, first+1) still overlaps
+                break
+            del self._bins[first]
+
+    def _burn(self, now: float, window_s: float) -> Tuple[float, int]:
+        horizon = now - window_s
+        n = bad = 0
+        for sec in reversed(self._bins):
+            if sec + 1 <= horizon:
+                break
+            count, slow = self._bins[sec]
+            n += count
+            bad += slow
+        if n == 0:
+            return 0.0, 0
+        return (bad / n) / self.budget, n
+
+    def observe(self, latency_s: float,
+                now: Optional[float] = None) -> Optional[dict]:
+        """Record one request's end-to-end latency; returns the emitted
+        ``slo`` event record on a state transition, else None."""
+        return self._record(bool(latency_s > self.target_s),
+                            float(latency_s), now)
+
+    def observe_failure(self, now: Optional[float] = None) -> Optional[dict]:
+        """Record one FAILED request (deadline-expired, breaker-shed,
+        dispatch error) as a spent unit of error budget. Failures must
+        burn the SLO: a deadline storm where every request is failed at
+        dispatch produces NO successful latencies — an SLO fed only by
+        successes would read a 100%-failing service as healthy, which is
+        exactly the incident ``slo_burn`` exists to page on."""
+        return self._record(True, None, now)
+
+    def _record(self, slow: bool, latency_s: Optional[float],
+                now: Optional[float]) -> Optional[dict]:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            slot = self._bins.get(int(now))
+            if slot is None:
+                slot = self._bins[int(now)] = [0, 0]
+            slot[0] += 1
+            slot[1] += slow
+            self._prune(now)
+            self.total += 1
+            self.violations += slow
+            burn_short, n_short = self._burn(now, self.short_window_s)
+            burn_long, n_long = self._burn(now, self.long_window_s)
+            burning_now = (
+                n_long >= self.min_events
+                and burn_short >= self.burn_threshold
+                and burn_long >= self.burn_threshold
+            )
+            if burning_now == self.burning:
+                return None
+            self.burning = burning_now
+            if burning_now:
+                self.burn_entries += 1
+            record = dict(
+                name=self.name, burning=burning_now,
+                target_s=self.target_s, budget=self.budget,
+                burn_short=round(burn_short, 4),
+                burn_long=round(burn_long, 4),
+                threshold=self.burn_threshold,
+                window_short_s=self.short_window_s,
+                window_long_s=self.long_window_s,
+                events_short=n_short, events_long=n_long,
+                latency_s=(round(latency_s, 6)
+                           if latency_s is not None else None),
+            )
+        if self.runlog is not None:
+            return self.runlog.event("slo", **record)
+        return record
+
+    def status(self, now: Optional[float] = None) -> dict:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            burn_short, n_short = self._burn(now, self.short_window_s)
+            burn_long, n_long = self._burn(now, self.long_window_s)
+            return dict(
+                name=self.name, burning=self.burning,
+                target_s=self.target_s, budget=self.budget,
+                burn_short=round(burn_short, 4),
+                burn_long=round(burn_long, 4),
+                threshold=self.burn_threshold,
+                total=self.total, violations=self.violations,
+                burn_entries=self.burn_entries,
+                events_short=n_short, events_long=n_long,
+            )
+
+    def emit_status(self, reason: str = "final") -> None:
+        """Terminal ``slo`` status event (registered as a runlog closer
+        by the service) — the report's ``== slo ==`` section renders a
+        clean run from this even when no transition ever fired. Never
+        carries ``burning=True`` re-entry semantics: the detector only
+        reacts to transition events, and this one is marked ``final``."""
+        if self.runlog is None:
+            return
+        self.runlog.event("slo", reason=reason, final=True,
+                          **{k: v for k, v in self.status().items()})
+
+
+# ---------------------------------------------------------------------------
+# env-gated construction
+# ---------------------------------------------------------------------------
+
+_NULL_REGISTRY = NullMetricsRegistry()
+
+
+def _metrics_enabled() -> bool:
+    from gigapath_tpu.obs.runlog import env_on_by_default
+
+    return env_on_by_default("GIGAPATH_METRICS")
+
+
+def get_metrics(runlog, *, interval_s: Optional[float] = None,
+                textfile: Optional[str] = None):
+    """The registry factory (the ``get_run_log`` discipline): reads the
+    ``GIGAPATH_METRICS*`` env surface ONCE, here, at driver/service
+    start. Against a ``NullRunLog`` — or with ``GIGAPATH_METRICS`` off —
+    returns the shared :class:`NullMetricsRegistry`: no locks, no
+    events, no files. Attach-once per runlog (``runlog.metrics``), so a
+    driver and the service it owns share one registry; the FINAL flush
+    is registered as a runlog closer, so every ``run_end`` leaves a
+    terminal ``metrics`` event without any driver bookkeeping."""
+    if getattr(runlog, "path", None) is None:
+        return _NULL_REGISTRY
+    if not _metrics_enabled():
+        return _NULL_REGISTRY
+    existing = getattr(runlog, "metrics", None)
+    if isinstance(existing, MetricsRegistry):
+        return existing
+    from gigapath_tpu.obs.runlog import env_number
+
+    if interval_s is None:
+        interval_s = env_number("GIGAPATH_METRICS_INTERVAL_S", 60.0)
+    if textfile is None:
+        textfile = os.environ.get("GIGAPATH_METRICS_TEXTFILE") or None
+    registry = MetricsRegistry(runlog=runlog, interval_s=interval_s,
+                               textfile=textfile)
+    runlog.metrics = registry
+    runlog.add_closer(lambda: registry.flush(reason="final"))
+    return registry
+
+
+__all__ = [
+    "METRICS_SCHEMA_VERSION",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "NullMetricsRegistry",
+    "NullSloTracker",
+    "SloTracker",
+    "exponential_bounds",
+    "get_metrics",
+    "histogram_quantile",
+    "merge_snapshots",
+    "percentile",
+    "to_json_line",
+    "to_prometheus",
+]

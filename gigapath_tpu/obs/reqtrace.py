@@ -1,0 +1,279 @@
+"""End-to-end request tracing: one ``trace_id`` from submit to future
+resolution, exported as Chrome-trace-event JSON (Perfetto-loadable).
+
+The obs ``span`` (:mod:`gigapath_tpu.obs.spans`) times REGIONS of one
+thread; a serving request is neither — it is born on a submitter
+thread, waits in a queue lane, and resolves on the dispatch worker,
+possibly joined mid-flight by other submitters. What a tail-latency
+investigation needs is the REQUEST's own timeline: how much of this
+p99 slide's 1.3 s was queue wait vs bucket padding vs the AOT forward
+vs the cache store? This module carries that:
+
+- :class:`RequestTrace` — the per-request context: a stable
+  ``trace_id`` (run id + monotone sequence number — stable across every
+  span of the request and across export), a dedicated Chrome-trace
+  track (``tid``), and an append-only list of closed spans
+  (``submit -> queue -> dispatch[forward, cache_store]``), each a
+  ``span_id``'d interval on the shared monotonic clock. The serving
+  stack threads it through ``serve/service.py`` on the request object
+  itself; anything else with a request-shaped lifecycle can do the
+  same.
+- :class:`TraceCollector` — the per-run sink: hands out traces
+  (thread-safe), bounds memory (``max_traces`` — the overflow is
+  COUNTED and reported in the ``trace`` event, never silently
+  dropped), and exports one ``<run-file-stem>.trace.json`` next to the
+  run's JSONL in the Chrome ``traceEvents`` format (``ph: "X"``
+  complete events; ``ts``/``dur`` in microseconds; one named track per
+  request) that https://ui.perfetto.dev and ``chrome://tracing`` load
+  directly. Export rides the runlog's closers, so every ``run_end``
+  leaves the artifact; a ``trace`` event in the run JSONL records the
+  path + totals for ``scripts/obs_report.py``'s ``== traces ==``.
+
+Zero-overhead contract: :func:`get_tracer` against a ``NullRunLog``
+(or with ``GIGAPATH_OBS`` off) returns the shared null collector whose
+traces absorb every call — no clocks, no memory, no file. Tracing
+never touches traced (jit) code, so it can add no retraces; the
+ON-vs-OFF HLO identity is pinned by tests anyway.
+
+Pure stdlib, no jax import.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+TRACE_FILE_SUFFIX = ".trace.json"
+
+
+class TraceSpan:
+    """One closed interval on a request's timeline."""
+
+    __slots__ = ("name", "t0", "t1", "args")
+
+    def __init__(self, name: str, t0: float, t1: float, args: Dict[str, Any]):
+        self.name = name
+        self.t0 = float(t0)
+        self.t1 = max(float(t1), float(t0))  # clamp clock jitter, never negative
+        self.args = args
+
+
+class NullRequestTrace:
+    """Absorbs the whole tracing surface; the one instance is shared."""
+
+    trace_id = ""
+    tid = 0
+    spans: tuple = ()
+
+    def add_span(self, name: str, t0: float, t1: float, **args) -> None:
+        return None
+
+    def finish(self, now: Optional[float] = None,
+               status: str = "ok") -> None:
+        return None
+
+    @property
+    def t_last(self) -> float:
+        return 0.0
+
+
+NULL_REQUEST_TRACE = NullRequestTrace()
+
+
+class RequestTrace(NullRequestTrace):
+    """Per-request context (see module docstring). Times are raw
+    ``time.monotonic`` values; the collector rebases them onto its own
+    origin at export. Span appends are lock-free by design: each request
+    is owned by one thread at a time (submitter, then the single
+    dispatch worker), the same ownership handoff the queue already
+    guarantees."""
+
+    __slots__ = ("trace_id", "tid", "name", "t_start", "t_end", "status",
+                 "args", "spans", "_seq")
+
+    def __init__(self, trace_id: str, tid: int, name: str, t_start: float,
+                 args: Optional[Dict[str, Any]] = None):
+        self.trace_id = trace_id
+        self.tid = tid
+        self.name = name
+        self.t_start = float(t_start)
+        self.t_end: Optional[float] = None
+        self.status = "open"
+        self.args = dict(args) if args else {}
+        self.spans: List[TraceSpan] = []
+        self._seq = 0
+
+    def add_span(self, name: str, t0: float, t1: float, **args) -> None:
+        self._seq += 1
+        args["span_id"] = f"{self.trace_id}.{self._seq}"
+        self.spans.append(TraceSpan(name, t0, t1, args))
+
+    @property
+    def t_last(self) -> float:
+        """End of the most recent span (the next span's natural start —
+        keeps siblings non-overlapping so Perfetto nests them cleanly)."""
+        return self.spans[-1].t1 if self.spans else self.t_start
+
+    def finish(self, now: Optional[float] = None,
+               status: str = "ok") -> None:
+        if self.t_end is None:  # first close wins (joins may race resolve)
+            self.t_end = time.monotonic() if now is None else float(now)
+            self.status = status
+
+
+class NullTraceCollector:
+    """Obs-off twin: hands out the shared null trace, exports nothing."""
+
+    path: Optional[str] = None
+    dropped = 0
+
+    def start(self, name: str, now: Optional[float] = None,
+              **args) -> NullRequestTrace:
+        return NULL_REQUEST_TRACE
+
+    def export(self) -> Optional[str]:
+        return None
+
+    def stats(self) -> dict:
+        return {"traces": 0, "spans": 0, "dropped": 0}
+
+
+class TraceCollector(NullTraceCollector):
+    def __init__(self, runlog, *, max_traces: int = 4096):
+        self.runlog = runlog
+        self.max_traces = int(max_traces)
+        # export next to the run JSONL, named by the run FILE's stem so
+        # shared-run-id ranks never clobber each other's trace file
+        stem = os.path.splitext(os.path.abspath(runlog.path))[0]
+        self.path = stem + TRACE_FILE_SUFFIX
+        self._t0 = time.monotonic()
+        self._lock = threading.Lock()
+        self._traces: List[RequestTrace] = []
+        self._next = 0
+        self.dropped = 0
+        self._exported = False
+
+    def start(self, name: str, now: Optional[float] = None,
+              **args) -> NullRequestTrace:
+        """Open a request trace. Past ``max_traces`` the shared null
+        trace is handed out instead — the overflow count lands in the
+        ``trace`` event, so a truncated export never reads as complete."""
+        t = time.monotonic() if now is None else float(now)
+        with self._lock:
+            self._next += 1
+            if len(self._traces) >= self.max_traces:
+                self.dropped += 1
+                return NULL_REQUEST_TRACE
+            tr = RequestTrace(
+                f"{self.runlog.run_id}-{self._next:06d}", self._next, name, t,
+                args,
+            )
+            self._traces.append(tr)
+        return tr
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "traces": len(self._traces),
+                "spans": sum(len(t.spans) for t in self._traces),
+                "dropped": self.dropped,
+            }
+
+    # -- chrome trace export ----------------------------------------------
+    def _us(self, t: float) -> float:
+        return round((t - self._t0) * 1e6, 1)
+
+    def export(self) -> Optional[str]:
+        """Write the Chrome-trace JSON (idempotent: re-export rewrites
+        with whatever has accumulated) and file one ``trace`` event with
+        path + totals. No traces -> no file, no event (an obs-on run
+        that never served a request leaves no empty artifact)."""
+        with self._lock:
+            traces = list(self._traces)
+            dropped = self.dropped
+        if not traces:
+            return None
+        events: List[dict] = []
+        n_spans = 0
+        for tr in traces:
+            events.append({
+                "ph": "M", "pid": 1, "tid": tr.tid, "name": "thread_name",
+                "args": {"name": f"{tr.name} [{tr.trace_id}]"},
+            })
+            t_end = tr.t_end if tr.t_end is not None else tr.t_last
+            events.append({
+                "ph": "X", "pid": 1, "tid": tr.tid, "name": "request",
+                "ts": self._us(tr.t_start),
+                "dur": max(round((t_end - tr.t_start) * 1e6, 1), 0.0),
+                "args": dict(tr.args, trace_id=tr.trace_id,
+                             status=tr.status, slide_id=tr.name),
+            })
+            for sp in tr.spans:
+                n_spans += 1
+                events.append({
+                    "ph": "X", "pid": 1, "tid": tr.tid, "name": sp.name,
+                    "ts": self._us(sp.t0),
+                    "dur": max(round((sp.t1 - sp.t0) * 1e6, 1), 0.0),
+                    "args": dict(sp.args, trace_id=tr.trace_id),
+                })
+        doc = {"traceEvents": events, "displayTimeUnit": "ms",
+               "metadata": {"run": self.runlog.run_id,
+                            "source": "gigapath_tpu.obs.reqtrace"}}
+        try:
+            parent = os.path.dirname(self.path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            tmp = f"{self.path}.tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh)
+            os.replace(tmp, self.path)
+        except OSError:
+            return None  # tracing must never take a run down
+        if not self._exported:
+            # one trace event per run (the re-export path just rewrites
+            # the file; a second event would double-count in the report)
+            self._exported = True
+            self.runlog.event(
+                "trace", path=self.path, traces=len(traces),
+                spans=n_spans, dropped=dropped,
+            )
+        return self.path
+
+
+_NULL_COLLECTOR = NullTraceCollector()
+
+
+def get_tracer(runlog, *, max_traces: Optional[int] = None):
+    """The collector factory (the ``get_run_log`` discipline): against a
+    ``NullRunLog`` returns the shared null collector; else attach-once
+    per runlog (``runlog.tracer``) with export registered as a closer,
+    so the Perfetto artifact lands at ``run_end`` with no caller
+    bookkeeping. ``GIGAPATH_TRACE_MAX`` (host-side, read once here)
+    bounds per-run trace memory."""
+    if getattr(runlog, "path", None) is None:
+        return _NULL_COLLECTOR
+    existing = getattr(runlog, "tracer", None)
+    if isinstance(existing, TraceCollector):
+        return existing
+    if max_traces is None:
+        from gigapath_tpu.obs.runlog import env_number
+
+        max_traces = int(env_number("GIGAPATH_TRACE_MAX", 4096))
+    collector = TraceCollector(runlog, max_traces=max_traces)
+    runlog.tracer = collector
+    runlog.add_closer(collector.export)
+    return collector
+
+
+__all__ = [
+    "NULL_REQUEST_TRACE",
+    "NullRequestTrace",
+    "NullTraceCollector",
+    "RequestTrace",
+    "TraceCollector",
+    "TraceSpan",
+    "get_tracer",
+]

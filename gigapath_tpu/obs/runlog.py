@@ -37,6 +37,17 @@ Event kinds (schema v1, one JSON object per line, every record carries
   per-slide queue waits, wall seconds, executable provenance;
 - ``cache_hit``  — a serving request short-circuited by the
   content-hash embedding cache (no forward pass);
+- ``metrics``    — one atomic snapshot of the typed metrics registry
+  (:mod:`gigapath_tpu.obs.metrics`): counters, gauges, and
+  exponential-bucket histograms with p50/p90/p99 — periodic
+  (``GIGAPATH_METRICS_INTERVAL_S``) plus a final flush at ``run_end``;
+- ``slo``        — an SLO burn-rate transition or terminal status from
+  the :class:`~gigapath_tpu.obs.metrics.SloTracker` (target latency,
+  budget, short/long-window burn) — ``burning: true`` transitions feed
+  the anomaly engine's ``slo_burn`` detector;
+- ``trace``      — the per-run request-trace export
+  (:mod:`gigapath_tpu.obs.reqtrace`): path of the Perfetto-loadable
+  Chrome-trace JSON plus trace/span/dropped totals;
 - ``error``      — exception surfaced by a driver;
 - ``run_end``    — terminal status + summary payload.
 
@@ -61,7 +72,7 @@ SCHEMA_VERSION = 1
 EVENT_KINDS = (
     "run_start", "step", "compile", "compile_profile", "span", "eval",
     "heartbeat", "stall", "anomaly", "recovery", "serve_dispatch",
-    "cache_hit", "error", "run_end",
+    "cache_hit", "metrics", "slo", "trace", "error", "run_end",
 )
 
 

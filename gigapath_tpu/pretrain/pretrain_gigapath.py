@@ -40,6 +40,7 @@ from gigapath_tpu.obs import (
     Heartbeat,
     console,
     get_ledger,
+    get_metrics,
     get_run_log,
     span,
 )
@@ -149,6 +150,10 @@ def pretrain_tile_encoder(
     ledger = get_ledger(runlog)
     watchdog = CompileWatchdog("pretrain_tile.step", runlog, ledger=ledger)
     instrumented_step = watchdog.wrap(step)
+    # typed metrics (obs/metrics.py): synced step-wall histogram; the
+    # final snapshot flushes inside run_end via the registry's closer
+    metrics = get_metrics(runlog)
+    step_walls = metrics.histogram("pretrain_tile.step_wall_s")
     order_rng = np.random.default_rng(seed)
     best_loss = float("inf")
     best_path = os.path.join(output_dir, "best_tile_encoder")
@@ -180,6 +185,9 @@ def pretrain_tile_encoder(
                         global_step, wall_s=sp.dur_s,
                         synced=True, epoch=epoch, loss=loss,
                     )
+                    if sp.dur_s is not None:
+                        step_walls.observe(sp.dur_s)
+                    metrics.maybe_flush()
                     heartbeat.beat(global_step)
                     global_step += 1
                 epoch_loss /= max(n_steps, 1)
@@ -322,6 +330,8 @@ def pretrain_slide_encoder(
     ledger = get_ledger(runlog)
     watchdog = CompileWatchdog("pretrain_slide.step", runlog, ledger=ledger)
     instrumented_step = watchdog.wrap(step)
+    metrics = get_metrics(runlog)
+    step_walls = metrics.histogram("pretrain_slide.step_wall_s")
     best_loss = float("inf")
     best_path = os.path.join(output_dir, "best_slide_encoder")
     try:
@@ -336,6 +346,9 @@ def pretrain_slide_encoder(
                     epoch, wall_s=sp.dur_s, synced=True,
                     loss=loss,
                 )
+                if sp.dur_s is not None:
+                    step_walls.observe(sp.dur_s)
+                metrics.maybe_flush()
                 heartbeat.beat(epoch)
                 runlog.echo(
                     f"Epoch: {epoch}, Contrastive loss: {loss:.6f}", step=epoch

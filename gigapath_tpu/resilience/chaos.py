@@ -23,6 +23,9 @@ trace time — GL001-clean because no injector is trace-reachable):
   ``resume='auto'`` scan (drives the fallback-past-corruption path);
 - ``poison@ID``       — serving: any dispatched batch containing slide
   ``ID`` raises (drives poisoned-batch bisection);
+- ``slow_dispatch@K:S`` — serving: dispatch ``K`` sleeps S seconds
+  host-side inside the dispatch span (``K = *`` slows EVERY dispatch —
+  the forced-slow run that proves the SLO burn detector fires);
 - ``seed=N``          — seed for the deterministic corruption bytes.
 
 All injection is host-side (batches are poisoned *before* they reach the
@@ -71,6 +74,9 @@ class NullChaos:
     def poisoned(self, slide_ids: Sequence[str]) -> Optional[str]:
         return None
 
+    def slow_dispatch(self, dispatch_index: int) -> float:
+        return 0.0
+
 
 class ChaosInjector(NullChaos):
     """Parsed ``GIGAPATH_CHAOS`` spec. One instance per driver run."""
@@ -86,6 +92,7 @@ class ChaosInjector(NullChaos):
         self._corrupt_ckpt = False
         self._ckpt_corrupted = False
         self._poison_ids: List[str] = []
+        self._slow_dispatch: Dict[str, float] = {}  # index (or "*") -> s
         for token in spec.split(","):
             token = token.strip()
             if not token:
@@ -116,11 +123,15 @@ class ChaosInjector(NullChaos):
             self._corrupt_ckpt = True
         elif kind == "poison":
             self._poison_ids.append(arg)
+        elif kind == "slow_dispatch":
+            idx, _, secs = arg.partition(":")
+            self._slow_dispatch[idx or "*"] = float(secs) if secs else 1.0
         else:
             raise ValueError(
                 f"GIGAPATH_CHAOS: unknown injector {token!r} (known: "
                 "nan_loss@K, corrupt_batch@K, sigterm@K, fail_loader@I[xN], "
-                "slow_loader@I[:S], corrupt_ckpt, poison@ID, seed=N)"
+                "slow_loader@I[:S], corrupt_ckpt, poison@ID, "
+                "slow_dispatch@K[:S] (K='*' = all), seed=N)"
             )
 
     # -- batch faults (consulted by train loops, host-side) ---------------
@@ -181,6 +192,15 @@ class ChaosInjector(NullChaos):
             if sid in self._poison_ids:
                 return sid
         return None
+
+    def slow_dispatch(self, dispatch_index: int) -> float:
+        """Seconds dispatch ``dispatch_index`` must sleep (0 = no
+        injection). Host-side, slept by the service INSIDE its dispatch
+        span — the compiled program is untouched, only the wall the
+        latency telemetry measures."""
+        return self._slow_dispatch.get(
+            str(dispatch_index), self._slow_dispatch.get("*", 0.0)
+        )
 
 
 def corrupt_checkpoint_dir(path: str, seed: int = 0) -> Optional[str]:

@@ -31,7 +31,7 @@ class SlideRequest:
     """One slide awaiting a forward pass."""
 
     __slots__ = ("slide_id", "feats", "coords", "n_tiles", "bucket_n",
-                 "cache_key", "future", "t_submit", "t_dispatch")
+                 "cache_key", "future", "t_submit", "t_dispatch", "trace")
 
     def __init__(self, slide_id: str, feats: np.ndarray,
                  coords: Optional[np.ndarray], bucket_n: int,
@@ -46,6 +46,10 @@ class SlideRequest:
         self.future: Future = Future()
         self.t_submit = time.monotonic() if t_submit is None else t_submit
         self.t_dispatch: Optional[float] = None
+        # end-to-end request trace (obs/reqtrace.py), attached by the
+        # service at enqueue; None for bare-queue users and when obs is
+        # off (the trace rides the request through the worker handoff)
+        self.trace = None
 
     def wait_s(self, now: Optional[float] = None) -> float:
         end = self.t_dispatch if self.t_dispatch is not None else (

@@ -26,6 +26,21 @@ per-bucket). Serving-specific telemetry lands as schema'd
 ``scripts/obs_report.py``'s ``== serving ==`` section folds into batch
 occupancy, queue-wait and hit-rate tables.
 
+Since PR 9 the service is also *measured* (:mod:`gigapath_tpu.obs.metrics`
+/ :mod:`gigapath_tpu.obs.reqtrace`): queue-wait, dispatch and
+end-to-end latency land in exponential-bucket histograms (periodic +
+final ``metrics`` events; Prometheus textfile via
+``GIGAPATH_METRICS_TEXTFILE``), every request carries a
+``RequestTrace`` with a stable ``trace_id`` whose
+``submit -> queue -> dispatch[forward, cache_store]`` spans export as
+Perfetto-loadable Chrome-trace JSON at ``run_end``, and an optional
+latency SLO (``GIGAPATH_SERVE_SLO_TARGET_S``) tracks multi-window
+error-budget burn — a sustained p99 breach emits ONE ``slo`` event that
+the anomaly engine's ``slo_burn`` detector turns into a flight dump +
+profiler capture. All of it is host-side bookkeeping around the
+dispatch boundary: obs off means no registry, no tracer, no SLO — and
+the compiled programs are byte-identical either way (pinned).
+
 All ``GIGAPATH_SERVE_*`` flags are host-side, read ONCE at
 :meth:`ServeConfig.from_env` (service construction) — never at trace
 time (GL001-clean by construction; README flag table).
@@ -58,8 +73,12 @@ import numpy as np
 from gigapath_tpu.obs import (
     CompileWatchdog,
     Heartbeat,
+    NullSloTracker,
+    SloTracker,
     get_ledger,
+    get_metrics,
     get_run_log,
+    get_tracer,
     span,
 )
 from gigapath_tpu.resilience.chaos import ChaosError, get_chaos
@@ -99,6 +118,18 @@ class ServeConfig:
     deadline_s: float = 0.0     # per-request deadline (fail expired at dispatch)
     breaker_failures: int = 0   # consecutive failures that open a bucket breaker
     breaker_cooldown_s: float = 30.0  # open -> half-open probe delay
+    # latency SLO (obs/metrics.py SloTracker); target 0 = SLO off. The
+    # windows/min_events are config-only (tests and smokes shrink them
+    # via explicit ServeConfig overrides): at most `slo_budget` of
+    # requests may exceed `slo_target_s` end-to-end, and a burn rate
+    # >= `slo_burn_threshold` on BOTH windows emits the `slo` event the
+    # anomaly engine's slo_burn detector reacts to
+    slo_target_s: float = 0.0
+    slo_budget: float = 0.01
+    slo_burn_threshold: float = 2.0
+    slo_short_window_s: float = 60.0
+    slo_long_window_s: float = 300.0
+    slo_min_events: int = 8
 
     @classmethod
     def from_env(cls, **overrides) -> "ServeConfig":
@@ -134,6 +165,12 @@ class ServeConfig:
                 "GIGAPATH_SERVE_BREAKER_FAILURES", cls.breaker_failures)),
             breaker_cooldown_s=env_number(
                 "GIGAPATH_SERVE_BREAKER_COOLDOWN_S", cls.breaker_cooldown_s),
+            slo_target_s=env_number("GIGAPATH_SERVE_SLO_TARGET_S",
+                                    cls.slo_target_s),
+            slo_budget=env_number("GIGAPATH_SERVE_SLO_BUDGET",
+                                  cls.slo_budget),
+            slo_burn_threshold=env_number("GIGAPATH_SERVE_SLO_BURN",
+                                          cls.slo_burn_threshold),
         )
         return replace(base, **overrides) if overrides else base
 
@@ -215,6 +252,42 @@ class SlideService:
             watchdog=self.watchdog, ledger=self.ledger,
         )
         self.heartbeat = Heartbeat(runlog, name=name)
+        # typed metrics + end-to-end request tracing (obs/metrics.py,
+        # obs/reqtrace.py): attach-once per runlog — a driver-owned
+        # runlog shares ONE registry/collector with the service — and
+        # both are true no-ops against a NullRunLog (obs off). The
+        # instruments are resolved here once so the dispatch hot path
+        # pays a bisect + scalar updates, not name lookups
+        self.metrics = get_metrics(runlog)
+        self.tracer = get_tracer(runlog)
+        self._m_submits = self.metrics.counter("serve.submits")
+        self._m_hits = self.metrics.counter("serve.cache_hits")
+        self._m_joins = self.metrics.counter("serve.inflight_joins")
+        self._m_shed = self.metrics.counter("serve.shed")
+        self._m_dispatches = self.metrics.counter("serve.dispatches")
+        self._m_slides = self.metrics.counter("serve.slides")
+        self._g_queued_tokens = self.metrics.gauge("serve.queued_tokens")
+        self._h_queue_wait = self.metrics.histogram("serve.queue_wait_s")
+        self._h_dispatch = self.metrics.histogram("serve.dispatch_s")
+        self._h_e2e = self.metrics.histogram("serve.e2e_s")
+        # latency SLO: multi-window error-budget burn feeding the
+        # anomaly engine's slo_burn detector via `slo` events; the
+        # terminal status rides the runlog's closers so clean runs still
+        # render an `== slo ==` section
+        if (self.config.slo_target_s > 0
+                and getattr(runlog, "path", None) is not None):
+            self.slo = SloTracker(
+                self.config.slo_target_s,
+                budget=self.config.slo_budget,
+                short_window_s=self.config.slo_short_window_s,
+                long_window_s=self.config.slo_long_window_s,
+                burn_threshold=self.config.slo_burn_threshold,
+                min_events=self.config.slo_min_events,
+                runlog=runlog, name=name,
+            )
+            runlog.add_closer(self.slo.emit_status)
+        else:
+            self.slo = NullSloTracker()
         # self-healing (serve/health.py): breaker state, chaos injection
         # (GIGAPATH_CHAOS read once here, host-side — NullChaos when
         # unset), the graceful-drain flag the SIGTERM chain flips
@@ -328,6 +401,13 @@ class SlideService:
             )
         bucket_n = self.ladder.bucket_for(feats.shape[0])
         key = content_key(feats, coords, extra=self.identity)
+        # request trace + submit counter: t_sub is ALSO the request's
+        # queue-wait origin (one clock read, one origin — the trace's
+        # queue span and the queue_wait_s histogram must agree)
+        t_sub = time.monotonic()
+        tr = self.tracer.start(slide_id, now=t_sub,
+                               n_tiles=int(feats.shape[0]))
+        self._m_submits.inc()
         # cache probe, pending probe and enqueue are ONE atomic section:
         # probing the cache outside the lock would let a dispatch finish
         # in the gap (cache.put + _pending.pop) and this request re-run
@@ -345,6 +425,10 @@ class SlideService:
                 # (probed BEFORE the cache so a join never counts as a
                 # cache miss in the stats the hit-rate trend is fed by)
                 self.inflight_joins += 1
+                self._m_joins.inc()
+                tr.add_span("submit", t_sub, time.monotonic(),
+                            bucket=bucket_n, outcome="inflight_join")
+                tr.finish(status="inflight_join")
                 self.runlog.event(
                     "cache_hit", slide_id=slide_id, key=key[:16],
                     n_tiles=int(feats.shape[0]), inflight=True,
@@ -356,6 +440,10 @@ class SlideService:
 
                 fut: Future = Future()
                 fut.set_result(cached)
+                self._m_hits.inc()
+                tr.add_span("submit", t_sub, time.monotonic(),
+                            bucket=bucket_n, outcome="cache_hit")
+                tr.finish(status="cache_hit")
                 self.runlog.event(
                     "cache_hit", slide_id=slide_id, key=key[:16],
                     n_tiles=int(feats.shape[0]), inflight=False,
@@ -374,6 +462,10 @@ class SlideService:
                 depth = self.queue.pending_tokens()
                 if depth + bucket_n > self.config.shed_tokens:
                     self.shed_count += 1
+                    self._m_shed.inc()
+                    tr.add_span("submit", t_sub, time.monotonic(),
+                                bucket=bucket_n, outcome="shed")
+                    tr.finish(status="shed")
                     self.runlog.event(
                         "recovery", action="shed", slide_id=slide_id,
                         bucket=bucket_n, queued_tokens=depth,
@@ -390,9 +482,18 @@ class SlideService:
                     return fut
             req = SlideRequest(
                 slide_id, feats, coords, bucket_n=bucket_n, cache_key=key,
+                t_submit=t_sub,
             )
+            req.trace = tr
             self._pending[key] = req
+        # the submit span closes BEFORE the request becomes visible to
+        # the dispatch worker: a RequestTrace is single-owner (submitter,
+        # then worker — the queue's existing handoff), so the queue span
+        # the worker opens at tr.t_last must find the submit span closed
+        tr.add_span("submit", t_sub, time.monotonic(), bucket=bucket_n,
+                    outcome="enqueued")
         self.queue.submit(req)
+        self._g_queued_tokens.set(self.queue.pending_tokens())
         return req.future
 
     # -- dispatch side ----------------------------------------------------
@@ -479,8 +580,16 @@ class SlideService:
                 if req.cache_key is not None:
                     self._pending.pop(req.cache_key, None)
         for req in reqs:
-            if not req.future.done():
-                req.future.set_exception(err)
+            if req.future.done():
+                continue  # already resolved (bisection partial): not ours
+            if req.trace is not None:
+                req.trace.finish(status=type(err).__name__)
+            # a failed request is a spent unit of error budget: a
+            # deadline/breaker/poison storm produces no successful
+            # latencies, and an SLO fed only by successes would read a
+            # 100%-failing service as healthy
+            self.slo.observe_failure()
+            req.future.set_exception(err)
 
     def _dispatch_with_bisection(self, batch: List[SlideRequest],
                                  had_failure: List[bool]) -> int:
@@ -524,28 +633,79 @@ class SlideService:
             poisoned = self.chaos.poisoned([r.slide_id for r in batch])
             if poisoned is not None:
                 raise ChaosError(f"chaos: poisoned slide {poisoned}")
+        t_d0 = time.monotonic()
         with span("serve.dispatch", self.runlog, fence=True,
                   bucket=bucket_n, slides=len(batch)) as sp:
+            if self.chaos:
+                # chaos slow_dispatch: a host-side sleep INSIDE the
+                # dispatch span, so the injected slowness lands exactly
+                # where the latency telemetry (dispatch histogram, e2e,
+                # SLO burn) must see it — the compiled program untouched
+                slow_s = self.chaos.slow_dispatch(self.dispatch_count)
+                if slow_s:
+                    time.sleep(slow_s)
             embeds, coords, mask = assemble_batch(
                 [(r.feats, r.coords) for r in batch], bucket_n, capacity,
                 feature_dim=self.config.feature_dim,
             )
+            t_fwd0 = time.monotonic()
             out = self.aot(embeds, coords, mask)
             sp.fence(out)
+        # the span's fence (block_until_ready) ran at exit, so THIS is
+        # the moment device execution finished — the forward span's end
+        t_fwd1 = time.monotonic()
         # host-side conversion and scatter stay INSIDE the poisoned-
         # batch containment: a MemoryError copying rows out of a big
         # batch must fail these futures too, not strand their waiters
         out = _tree_np(out)
+        source = self.aot.sources.get((capacity, bucket_n), "?")
         for i, req in enumerate(batch):
             result = _to_host(out, i)
+            t_c0 = time.monotonic()
             if req.cache_key is not None:
                 self.cache.put(req.cache_key, result)
                 with self._lock:
                     self._pending.pop(req.cache_key, None)
-            if not req.future.done():
+            t_c1 = time.monotonic()
+            # bisection can re-enter this loop with requests that were
+            # ALREADY resolved before a partial failure (e.g. a
+            # MemoryError in _to_host halfway through the scatter): the
+            # first resolution owns the telemetry — a re-dispatch must
+            # not double-observe e2e/SLO or append spans past the
+            # trace's frozen end
+            first_resolution = not req.future.done()
+            if first_resolution:
                 req.future.set_result(result)
+            t_res = time.monotonic()
+            if first_resolution:
+                # per-request latency telemetry: the trace's spans, the
+                # histograms, and the SLO tracker all read the SAME
+                # clocks (t_submit from submit(), t_dispatch from
+                # pop_ready)
+                t_disp = (req.t_dispatch if req.t_dispatch is not None
+                          else t_d0)
+                tr = req.trace
+                if tr is not None:
+                    tr.add_span("queue", tr.t_last, t_disp, bucket=bucket_n)
+                    tr.add_span("dispatch", t_disp, t_res, bucket=bucket_n,
+                                slides=len(batch), capacity=capacity,
+                                source=source)
+                    tr.add_span("forward", t_fwd0, t_fwd1, bucket=bucket_n,
+                                batch=len(batch))
+                    if req.cache_key is not None:
+                        tr.add_span("cache_store", t_c0, t_c1)
+                    tr.finish(t_res)
+                self._h_queue_wait.observe(req.wait_s())
+                e2e = max(t_res - req.t_submit, 0.0)
+                self._h_e2e.observe(e2e)
+                self.slo.observe(e2e)
         self.dispatch_count += 1
         self.slides_served += len(batch)
+        self._m_dispatches.inc()
+        self._m_slides.inc(len(batch))
+        if sp.dur_s is not None:
+            self._h_dispatch.observe(sp.dur_s)
+        self._g_queued_tokens.set(self.queue.pending_tokens())
         self.per_bucket_dispatches[bucket_n] = (
             self.per_bucket_dispatches.get(bucket_n, 0) + 1
         )
@@ -553,8 +713,7 @@ class SlideService:
         self.runlog.event(
             "serve_dispatch", bucket=bucket_n, slides=len(batch),
             capacity=capacity, occupancy=round(len(batch) / capacity, 4),
-            queue_wait_s=waits, wall_s=sp.dur_s,
-            source=self.aot.sources.get((capacity, bucket_n), "?"),
+            queue_wait_s=waits, wall_s=sp.dur_s, source=source,
         )
         # dispatch walls also ride step events so the anomaly engine's
         # per-bucket spike/dip baselines cover serving for free
@@ -563,6 +722,7 @@ class SlideService:
             bucket=str(bucket_n), slides=len(batch),
         )
         self.heartbeat.beat(self.dispatch_count)
+        self.metrics.maybe_flush()
         return len(batch)
 
     def _run(self) -> None:
@@ -600,6 +760,8 @@ class SlideService:
             "bisections": self.bisections,
             "poisoned_requests": self.poisoned_requests,
             "breaker_trips": self.breaker.trips if self.breaker else 0,
+            "slo_violations": self.slo.violations,
+            "slo_burn_entries": self.slo.burn_entries,
             "buckets_used": len(self.per_bucket_dispatches),
             "per_bucket_dispatches": {
                 str(k): v

@@ -25,6 +25,7 @@ from gigapath_tpu.obs import (
     Heartbeat,
     console,
     get_ledger,
+    get_metrics,
     get_run_log,
     span,
 )
@@ -254,6 +255,10 @@ def train_model(
     ledger = get_ledger(runlog)
     watchdog = CompileWatchdog("train_gigapath.step", runlog, ledger=ledger)
     instrumented_step = watchdog.wrap(step)
+    # typed metrics (obs/metrics.py): synced step-wall histogram; the
+    # final snapshot flushes inside run_end via the registry's closer
+    metrics = get_metrics(runlog)
+    step_walls = metrics.histogram("train_gigapath.step_wall_s")
     history = []
     # run seed; a fresh per-step dropout key is split off below (a constant
     # key would freeze one dropout mask for the whole run)
@@ -349,6 +354,9 @@ def train_model(
                         global_step, wall_s=sp.dur_s,
                         synced=True, epoch=epoch, loss=loss_f, **extra,
                     )
+                    if sp.dur_s is not None:
+                        step_walls.observe(sp.dur_s)
+                    metrics.maybe_flush()
                     if verdict == "rollback":
                         # not a resume: the rollback reports its own
                         # recovery action below

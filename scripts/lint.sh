@@ -18,7 +18,11 @@
 #     growth and lost donations, and stay blind to stale rounds;
 #   - the gigalint GL008 selftest: the seeded timing-hygiene fixture
 #     must fire (and only on the seeded violations — the negative
-#     controls are covered by tests/test_gigalint.py).
+#     controls are covered by tests/test_gigalint.py);
+#   - the gigalint GL012 selftest: the seeded ad-hoc-latency-aggregation
+#     fixture must fire (hand-rolled perf_counter list-append-then-sort
+#     outside obs/ — the pattern obs/metrics.py's Histogram/percentile
+#     replace).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 python scripts/obs_report.py --selftest 1>&2
@@ -37,5 +41,18 @@ if [ "$gl008_rc" -ne 1 ]; then
     exit 1
 fi
 echo "gigalint GL008 selftest OK" 1>&2
+
+# GL012 selftest: the seeded latency-aggregation fixture MUST be found
+# (exit 1 = findings; 0 or 2 mean the rule went blind or crashed)
+set +e
+python -m tools.gigalint --no-waivers --select GL012 \
+    tools/gigalint/selftest/fixture/models/latency.py 1>&2
+gl012_rc=$?
+set -e
+if [ "$gl012_rc" -ne 1 ]; then
+    echo "GL012 selftest FAILED: expected findings (rc=1), got rc=$gl012_rc" 1>&2
+    exit 1
+fi
+echo "gigalint GL012 selftest OK" 1>&2
 
 exec python -m tools.gigalint gigapath_tpu scripts tests "$@"

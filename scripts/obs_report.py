@@ -15,7 +15,12 @@ max/median step span per rank, worst rank called out), anomalies (per
 detector, with the reactions taken — flight-dump path, profiler trace
 dir), recovery (the fault-tolerance layer's actions — skips,
 rollbacks, resumes, data retries, sheds, deadline failures, breaker
-trips, drains — per action with its context), eval history, timeline
+trips, drains — per action with its context), latency (the typed
+metrics registry's last ``metrics`` snapshot: per-histogram
+p50/p90/p99/max plus counters and gauges), slo (burn-rate transitions
+and the terminal error-budget status from the ``SloTracker``), traces
+(the per-run Perfetto-loadable request-trace export: trace/span
+totals + path), eval history, timeline
 (heartbeats, stalls, silent gaps between consecutive events). Passing a flight recorder dump
 (``flight-<run-id>.jsonl``) renders a flight-dumps summary (reason,
 dump ordinal, buffered-context size) above the usual sections folded
@@ -41,6 +46,13 @@ import os
 import sys
 from typing import Dict, List, Optional
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# THE shared nearest-rank percentile (gigalint GL012: one
+# implementation; scripts/serve_smoke.py and the metrics registry use
+# the same one — gigapath_tpu.obs.metrics is stdlib-only, no jax)
+from gigapath_tpu.obs.metrics import percentile  # noqa: E402,F401
+
 GAP_THRESHOLD_S = 30.0  # silence longer than this lands in the timeline
 
 
@@ -61,14 +73,6 @@ def load_events(path: str, run_id: Optional[str] = None) -> List[dict]:
                 continue
             events.append(ev)
     return events
-
-
-def percentile(sorted_vals: List[float], q: float) -> float:
-    """Nearest-rank percentile on an already-sorted list."""
-    if not sorted_vals:
-        return float("nan")
-    idx = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
-    return sorted_vals[idx]
 
 
 def _fmt_s(x) -> str:
@@ -367,6 +371,81 @@ def render(events: List[dict], out=None) -> int:
                 )
         w("\n")
 
+    # -- latency (obs/metrics.py: metrics-event snapshots) -----------------
+    metrics_events = by_kind.get("metrics", [])
+    if metrics_events:
+        w("== latency ==\n")
+        final = metrics_events[-1]  # last snapshot = the terminal flush
+        w(f"metrics snapshots: {len(metrics_events)} "
+          f"(rendering the last, reason={final.get('reason')})\n")
+        hists = final.get("histograms") or {}
+        for name in sorted(hists):
+            h = hists[name]
+            if not h.get("count"):
+                continue
+            w(
+                "  {}: n={} p50 {} p90 {} p99 {} max {}\n".format(
+                    name, h["count"], _fmt_s(h.get("p50")),
+                    _fmt_s(h.get("p90")), _fmt_s(h.get("p99")),
+                    _fmt_s(h.get("max")),
+                )
+            )
+        counters = final.get("counters") or {}
+        if counters:
+            w("counters: " + ", ".join(
+                f"{k}={v:g}" for k, v in sorted(counters.items())
+            ) + "\n")
+        gauges = final.get("gauges") or {}
+        if gauges:
+            w("gauges: " + ", ".join(
+                f"{k}={v:g}" for k, v in sorted(gauges.items())
+            ) + "\n")
+        w("\n")
+
+    # -- slo (obs/metrics.py SloTracker: burn-rate transitions + status) ---
+    slos = by_kind.get("slo", [])
+    if slos:
+        w("== slo ==\n")
+        burns = [ev for ev in slos if ev.get("burning") and not ev.get("final")]
+        w(f"slo events: {len(slos)} ({len(burns)} burn transition(s))\n")
+        for ev in slos:
+            if ev.get("final"):
+                w(
+                    "  final: target {} budget {:g} — {} violation(s) / {} "
+                    "request(s), {} burn entr(ies), burn short x{} long x{}\n"
+                    .format(
+                        _fmt_s(ev.get("target_s")), ev.get("budget") or 0,
+                        ev.get("violations"), ev.get("total"),
+                        ev.get("burn_entries"),
+                        ev.get("burn_short"), ev.get("burn_long"),
+                    )
+                )
+            else:
+                w(
+                    "  {} at +{:.1f}s: burn short x{} long x{} "
+                    "(threshold x{}, target {})\n".format(
+                        "BURNING" if ev.get("burning") else "recovered",
+                        ev.get("t", 0.0) - t0, ev.get("burn_short"),
+                        ev.get("burn_long"), ev.get("threshold"),
+                        _fmt_s(ev.get("target_s")),
+                    )
+                )
+        w("\n")
+
+    # -- traces (obs/reqtrace.py: per-run Chrome-trace export) -------------
+    trace_events = by_kind.get("trace", [])
+    if trace_events:
+        w("== traces ==\n")
+        for ev in trace_events:
+            w(
+                f"  {ev.get('traces')} request trace(s), "
+                f"{ev.get('spans')} span(s)"
+                + (f", {ev['dropped']} dropped past the cap"
+                   if ev.get("dropped") else "")
+                + f" -> {ev.get('path')} (Perfetto-loadable)\n"
+            )
+        w("\n")
+
     # -- flight dumps (records only present in flight-*.jsonl files) ------
     metas = by_kind.get("flight_meta", [])
     if metas:
@@ -422,18 +501,23 @@ def render(events: List[dict], out=None) -> int:
 
 def selftest() -> int:
     """Synthesize a run (RunLog + watchdog + spans + a forced stall +
-    the anomaly engine's closed loop) in a temp dir, render it, and
-    assert every section materializes — including ``== anomalies ==``
-    and the flight-dump summary rendered from the flight file; then a
-    two-rank merge of one run id must render the per-rank skew table —
-    the obs half of scripts/lint.sh."""
+    the anomaly engine's closed loop + a REAL traced serve smoke:
+    requests submitted through the serving RequestQueue, dispatched,
+    resolved — with request traces, latency histograms, and an SLO
+    burn) in a temp dir, render it, and assert every section
+    materializes — including ``== latency ==``, ``== slo ==``,
+    ``== traces ==``, ``== anomalies ==`` and the flight-dump summary
+    rendered from the flight file; then a two-rank merge of one run id
+    must render the per-rank skew table — the obs half of
+    scripts/lint.sh."""
     import io
     import tempfile
     import time as _time
 
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from gigapath_tpu.obs import Heartbeat, RunLog, span
     from gigapath_tpu.obs.anomaly import AnomalyConfig, attach_anomaly_engine
+    from gigapath_tpu.obs.metrics import MetricsRegistry, SloTracker
+    from gigapath_tpu.obs.reqtrace import TraceCollector
     from gigapath_tpu.obs.watchdog import CompileWatchdog
 
     with tempfile.TemporaryDirectory() as tmp:
@@ -478,6 +562,76 @@ def selftest() -> int:
         log.recovery(action="shed", slide_id="s9", bucket=256,
                      queued_tokens=4096, budget=4096)
         log.recovery(action="breaker_open", bucket=512, cooldown_s=30.0)
+
+        # -- a REAL traced smoke: submit -> dispatch -> resolve through
+        # the serving RequestQueue, with request traces, latency
+        # histograms and an SLO burn (the queue moves references — no
+        # jax anywhere in this selftest)
+        from gigapath_tpu.serve.queue import RequestQueue, SlideRequest
+
+        registry = MetricsRegistry(runlog=log, interval_s=0)
+        tracer = TraceCollector(log)
+        slo = SloTracker(0.05, budget=0.25, short_window_s=60,
+                         long_window_s=60, burn_threshold=1.5,
+                         min_events=4, runlog=log, name="selftest")
+        h_e2e = registry.histogram("serve.e2e_s")
+        h_wait = registry.histogram("serve.queue_wait_s")
+        queue = RequestQueue(max_batch=2, max_wait_s=0.0)
+        clock = [100.0]
+        for i in range(6):
+            t_sub = clock[0]
+            tr = tracer.start(f"slide_{i}", now=t_sub, n_tiles=64)
+            req = SlideRequest(f"slide_{i}", feats=[[0.0] * 4] * 3,
+                               coords=None, bucket_n=64, t_submit=t_sub)
+            req.trace = tr
+            tr.add_span("submit", t_sub, t_sub + 0.001, bucket=64,
+                        outcome="enqueued")
+            queue.submit(req)
+            clock[0] += 0.01
+        served = 0
+        while True:
+            batch = queue.pop_ready(now=clock[0], drain=True)
+            if not batch:
+                break
+            clock[0] += 0.2  # every dispatch blows the 50 ms SLO target
+            for req in batch:
+                tr = req.trace
+                tr.add_span("queue", tr.t_last, req.t_dispatch, bucket=64)
+                tr.add_span("dispatch", req.t_dispatch, clock[0], bucket=64)
+                tr.add_span("forward", req.t_dispatch + 0.01,
+                            clock[0] - 0.01, bucket=64)
+                tr.finish(clock[0])
+                req.future.set_result(served)
+                h_wait.observe(req.wait_s(now=req.t_dispatch))
+                e2e = clock[0] - req.t_submit
+                h_e2e.observe(e2e)
+                slo.observe(e2e, now=clock[0])
+                served += 1
+        assert served == 6 and all(
+            tr_.t_end is not None for tr_ in tracer._traces
+        ), "traced smoke failed to resolve every request"
+        registry.flush(reason="final")
+        slo.emit_status()
+        trace_path = tracer.export()
+        # the export must be a Perfetto-loadable Chrome trace whose
+        # spans nest inside their request (containment on one track)
+        with open(trace_path, encoding="utf-8") as fh:
+            trace_doc = json.load(fh)
+        by_tid: Dict[int, List[dict]] = {}
+        for tev in trace_doc["traceEvents"]:
+            if tev.get("ph") == "X":
+                by_tid.setdefault(tev["tid"], []).append(tev)
+        for tid, tevs in by_tid.items():
+            root = [e for e in tevs if e["name"] == "request"]
+            assert len(root) == 1, f"track {tid}: no single request root"
+            lo = root[0]["ts"]
+            hi = lo + root[0]["dur"]
+            for e in tevs:
+                assert lo - 0.5 <= e["ts"] and e["ts"] + e["dur"] <= hi + 0.5, (
+                    f"span {e['name']} escapes its request on track {tid}"
+                )
+                assert e["args"]["trace_id"] == root[0]["args"]["trace_id"]
+
         with Heartbeat(log, interval_s=0.05, stall_after_s=0.15,
                        name="selftest") as hb:
             hb.beat(24)
@@ -516,7 +670,12 @@ def selftest() -> int:
 
     required = ("== throughput ==", "== compile ==", "== timeline ==",
                 "retrace table", "STALL", "p50", "== spans ==",
-                "== anomalies ==", "STEP_TIME_SPIKE", "flight ->",
+                "== anomalies ==", "STEP_TIME_SPIKE", "SLO_BURN",
+                "flight ->",
+                "== latency ==", "serve.e2e_s: n=6",
+                "serve.queue_wait_s: n=6",
+                "== slo ==", "BURNING", "final: target 0.050s",
+                "== traces ==", "6 request trace(s)", "Perfetto-loadable",
                 "== serving ==", "batch occupancy", "queue wait",
                 "2 hit(s) / 11 request(s)", "1 in-flight join(s)",
                 "per-bucket dispatch table", "256: 2 dispatch(es)",

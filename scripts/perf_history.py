@@ -104,9 +104,18 @@ def cmd_ingest(args) -> int:
                                    source=os.path.basename(args.multichip),
                                    force=args.force)
         if args.serve:
-            history.fold_serve(doc, _load_json(args.serve), args.label,
+            serve_snapshot = _load_json(args.serve)
+            history.fold_serve(doc, serve_snapshot, args.label,
                                source=os.path.basename(args.serve),
                                force=args.force)
+            # the same smoke payload also carries the metrics-snapshot
+            # latency keys (e2e/dispatch/queue-wait p50/p90/p99) — one
+            # ingest lands BOTH the throughput (serve|smoke) and the
+            # tail-latency (serve|latency) trend entries
+            history.fold_serve_latency(
+                doc, serve_snapshot, args.label,
+                source=os.path.basename(args.serve), force=args.force,
+            )
         for path in args.ledger or []:
             history.fold_ledger(doc, _load_json(path), args.label,
                                 source=os.path.basename(path),
@@ -257,6 +266,43 @@ def selftest() -> int:
     if any("r01" in line for line in sv["decision"]["regressed"]):
         print("perf_history selftest FAILED: stale CPU serve point moved "
               "the trend", file=sys.stderr)
+        return 1
+
+    # serve|latency folding: the latency keys land under their own
+    # entry, CPU points stale WITH keys, and a p99 regression (tail
+    # latency UP) flips the gate while an improvement never does
+    history.fold_serve_latency(
+        serve_doc,
+        {"rc": 0, "parsed": {"backend": "cpu", "e2e_p99_s": 9.0,
+                             "queue_wait_p99_s": 0.5}}, "r01")
+    lat_points = serve_doc["entries"]["serve|latency"]["points"]
+    if not lat_points[0].get("stale") or "e2e_p99_s" not in \
+            lat_points[0]["metrics"]:
+        print("perf_history selftest FAILED: CPU latency point must be "
+              "stale WITH metric keys", file=sys.stderr)
+        return 1
+    history.fold_serve_latency(
+        serve_doc,
+        {"rc": 0, "parsed": {"backend": "tpu", "e2e_p50_s": 0.1,
+                             "e2e_p99_s": 0.5, "dispatch_p99_s": 0.2}},
+        "r02")
+    history.fold_serve_latency(
+        serve_doc,
+        {"rc": 0, "parsed": {"backend": "tpu", "e2e_p50_s": 0.1,
+                             "e2e_p99_s": 1.5, "dispatch_p99_s": 0.1}},
+        "r03")
+    lv = history.trend_verdict(serve_doc)
+    if lv["decision"]["ok"] or not any(
+        "serve|latency: e2e_p99_s 0.5" in line
+        for line in lv["decision"]["regressed"]
+    ):
+        print("perf_history selftest FAILED: e2e_p99_s tail regression "
+              "undetected", file=sys.stderr)
+        render(lv, out=sys.stderr)
+        return 1
+    if any("dispatch_p99_s" in line for line in lv["decision"]["regressed"]):
+        print("perf_history selftest FAILED: an IMPROVED dispatch p99 "
+              "counted as a regression", file=sys.stderr)
         return 1
 
     # append-only: reusing a label without force must refuse

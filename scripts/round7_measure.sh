@@ -48,9 +48,18 @@ tail -8 /tmp/r7_envelope.log
 # 7. the serving stack at flagship shape (ROADMAP item 1): bucketed AOT
 #    executables + continuous batching + content-hash cache, hard
 #    assertions baked in (zero mid-serve retraces, warm restart loads
-#    artifacts, repeats cache-served). On-chip numbers move the
-#    serve|smoke trend; the committed CPU point (r06-cpu) is stale
-#    provenance only.
+#    artifacts, repeats cache-served), plus the PR-9 latency surface —
+#    the smoke's metrics snapshot (queue-wait / dispatch / e2e
+#    histograms with p50/p90/p99) and Perfetto request-trace export.
+#    The ingest below lands BOTH trend entries (serve|smoke throughput
+#    AND serve|latency tail latency) in PERF_HISTORY.json; on-chip
+#    numbers move the trends, the committed CPU points are stale
+#    provenance only. NO SLO target here: the smoke's clean-run
+#    assertion demands ZERO slo_burn anomalies, but e2e latency counts
+#    queue wait stacked behind each bucket's cold AOT compile — minutes
+#    at flagship shape — so any honest target would fail a healthy
+#    measurement run. The latency histograms flow regardless; SLO
+#    tuning happens against warm serving, not a cold-compile sweep.
 timeout 2400 python scripts/serve_smoke.py \
   --arch gigapath_slide_enc12l768d --input-dim 1536 --latent-dim 768 \
   --bucket-min 1024 --bucket-align 128 --bucket-max 131072 \

@@ -23,11 +23,24 @@ violation, bench.py-style):
    over the same artifact dir serves one slide per bucket with ZERO
    compiles — every executable loads from its persisted artifact.
 
+The cold run's obs artifacts are part of the acceptance (PR 9): the
+typed metrics snapshot must carry queue-wait / dispatch / end-to-end
+latency histograms with p50/p90/p99, the per-run request-trace export
+must be Perfetto-loadable with ``submit -> queue -> dispatch ->
+forward`` spans nesting inside each request under a stable
+``trace_id``, and the SLO contract is asserted both ways: a
+``--slow-dispatch-s`` run (chaos ``slow_dispatch@*`` host-side sleeps)
+fires EXACTLY ONE ``slo_burn`` anomaly (flight dump + armed profiler
+capture), a clean run fires none.
+
 Emits one JSON line (stdout; ``--json`` also writes a file) whose
 metric keys (`slides_per_sec`, `occupancy_mean`, `cache_hit_rate`,
-`queue_wait_p50_s`, ...) are what ``scripts/perf_history.py ingest
---serve`` folds into PERF_HISTORY.json — CPU runs land as stale points
-(keys without trend weight) until a chip round measures them for real.
+`queue_wait_p50_s`, ..., plus the latency keys `e2e_p{50,90,99}_s`,
+`dispatch_p{50,99}_s`, `queue_wait_p99_s`) are what
+``scripts/perf_history.py ingest --serve`` folds into PERF_HISTORY.json
+(`serve|smoke` + `serve|latency` entries) — CPU runs land as stale
+points (keys without trend weight) until a chip round measures them
+for real.
 """
 
 from __future__ import annotations
@@ -45,7 +58,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
 
-from obs_report import percentile  # noqa: E402  (scripts/ is on sys.path)
+# THE shared nearest-rank percentile (gigalint GL012: one
+# implementation — obs_report.py and the metrics registry use the same)
+from gigapath_tpu.obs.metrics import percentile  # noqa: E402
 
 
 def make_slides(n_slides: int, lengths: List[int], dim: int, seed: int):
@@ -119,15 +134,45 @@ def run(args) -> dict:
         return model.apply({"params": p}, embeds, coords,
                            pad_mask=pad_mask, deterministic=True)
 
+    slo_overrides = {}
+    if args.slo_target_s > 0:
+        # smoke SLO policy: tight windows + a low event floor so a short
+        # CPU run can prove the burn detector both ways (the production
+        # defaults are minutes-scale; ServeConfig docstring)
+        slo_overrides = dict(
+            slo_target_s=args.slo_target_s, slo_budget=0.25,
+            slo_burn_threshold=1.5, slo_short_window_s=30.0,
+            slo_long_window_s=60.0, slo_min_events=4,
+        )
+    chaos_prev = os.environ.get("GIGAPATH_CHAOS")
+    if args.slow_dispatch_s > 0:
+        # forced-slow run: every dispatch sleeps host-side inside its
+        # span (resilience.chaos slow_dispatch@*) — the injected
+        # latency must fire EXACTLY ONE slo_burn anomaly below. The env
+        # is restored after the COLD service is built: the injection
+        # targets phase 1, not the warm-restart service of phase 3
+        spec = f"slow_dispatch@*:{args.slow_dispatch_s}"
+        os.environ["GIGAPATH_CHAOS"] = (
+            f"{chaos_prev},{spec}" if chaos_prev else spec
+        )
     config = ServeConfig.from_env(
         max_batch=args.max_batch, max_wait_s=args.max_wait_s,
         bucket_min=args.bucket_min, bucket_growth=args.bucket_growth,
         bucket_max=args.bucket_max, bucket_align=args.bucket_align,
         feature_dim=args.input_dim, artifact_dir=artifact_dir,
+        **slo_overrides,
     )
     identity = f"{args.arch}|{args.feat_layer}|{args.n_classes}"
     service = SlideService(forward, params, config=config,
                            out_dir=out_dir, identity=identity)
+    if args.slow_dispatch_s > 0:
+        # cold service built (get_chaos read the spec): restore the env
+        # so the warm-restart service is NOT chaos-slowed and the
+        # caller's environment is left as found
+        if chaos_prev is None:
+            os.environ.pop("GIGAPATH_CHAOS", None)
+        else:
+            os.environ["GIGAPATH_CHAOS"] = chaos_prev
     lengths = pick_lengths(service.ladder, args.distinct_lengths)
     slides = make_slides(args.slides, lengths, args.input_dim, args.seed)
     expected_buckets = sorted({
@@ -207,9 +252,14 @@ def run(args) -> dict:
             ),
         )
 
-        # queue-wait / occupancy distributions out of the run artifact
+        # queue-wait / occupancy / dispatch-wall distributions out of
+        # the run artifact (EXACT per-request/per-dispatch values — the
+        # trend keys below must not inherit the metrics histogram's
+        # factor-2 bucket quantization, which would let a 1% drift
+        # across a bucket boundary read as a 100% trend regression)
         waits: List[float] = []
         occs: List[float] = []
+        dispatch_walls: List[float] = []
         run_path = getattr(service.runlog, "path", None)
         if run_path and os.path.exists(run_path):
             with open(run_path, encoding="utf-8") as fh:
@@ -222,12 +272,143 @@ def run(args) -> dict:
                         waits.extend(ev.get("queue_wait_s") or [])
                         if ev.get("occupancy") is not None:
                             occs.append(float(ev["occupancy"]))
+                        if ev.get("wall_s") is not None:
+                            dispatch_walls.append(float(ev["wall_s"]))
         waits.sort()
+        dispatch_walls.sort()
         payload.update(
             occupancy_mean=round(sum(occs) / len(occs), 4) if occs else None,
             queue_wait_p50_s=percentile(waits, 0.50) if waits else None,
             queue_wait_p90_s=percentile(waits, 0.90) if waits else None,
         )
+
+        # -- the metrics snapshot (obs/metrics.py): queue-wait, dispatch
+        # and end-to-end latency histograms with p50/p90/p99 — the keys
+        # `perf_history.py ingest --serve` folds into the serve|latency
+        # trend entry. Skipped (like every obs artifact below) when the
+        # run opted out of obs/metrics — the obs-off twin must leave
+        # NO metrics surface, not a failed assertion
+        from gigapath_tpu.obs.metrics import MetricsRegistry
+
+        snap = service.metrics.snapshot()
+        hists = snap.get("histograms", {})
+        # gate on the registry actually being real: obs on but
+        # GIGAPATH_METRICS=0 is a legitimate opt-out, not a failed run
+        if run_path and isinstance(service.metrics, MetricsRegistry):
+            for want in ("serve.queue_wait_s", "serve.dispatch_s",
+                         "serve.e2e_s"):
+                if not hists.get(want, {}).get("count"):
+                    raise AssertionError(
+                        f"metrics snapshot missing observations for {want} "
+                        "(obs on but the registry saw no latency?)"
+                    )
+            payload["metrics"] = {
+                "counters": snap.get("counters", {}),
+                "histograms": {
+                    name: {k: h.get(k) for k in
+                           ("count", "p50", "p90", "p99", "max")}
+                    for name, h in hists.items()
+                },
+            }
+            # trend keys from the EXACT distributions (the histogram
+            # quantiles above are conservative bucket upper bounds —
+            # right for a live SLO gate, too coarse for a 5%-tolerance
+            # trend). e2e comes from the trace export below
+            payload.update(
+                dispatch_p50_s=percentile(dispatch_walls, 0.50)
+                if dispatch_walls else None,
+                dispatch_p99_s=percentile(dispatch_walls, 0.99)
+                if dispatch_walls else None,
+                queue_wait_p99_s=percentile(waits, 0.99) if waits else None,
+                slo_burn_entries=service.stats()["slo_burn_entries"],
+            )
+
+    # -- the run artifact half of the acceptance: a Perfetto-loadable
+    # trace whose spans nest submit -> queue -> dispatch -> forward per
+    # request with stable trace_ids, and the slo_burn contract (exactly
+    # one anomaly on the forced-slow run, none on a clean run). The
+    # service owns its runlog, so close() above ran run_end -> closers
+    # (metrics final flush, trace export)
+    if run_path and os.path.exists(run_path):
+        trace_path = os.path.splitext(run_path)[0] + ".trace.json"
+        if not os.path.exists(trace_path):
+            raise AssertionError(f"no request-trace export at {trace_path}")
+        with open(trace_path, encoding="utf-8") as fh:
+            tdoc = json.load(fh)
+        spans_by_tid: dict = {}
+        for tev in tdoc.get("traceEvents", []):
+            if tev.get("ph") == "X":
+                spans_by_tid.setdefault(tev["tid"], []).append(tev)
+        nested = 0
+        e2e_s: List[float] = []  # exact per-dispatched-request end-to-end
+        for tid, tevs in spans_by_tid.items():
+            roots = [e for e in tevs if e["name"] == "request"]
+            if len(roots) != 1:
+                raise AssertionError(
+                    f"trace track {tid}: want one request root, got "
+                    f"{len(roots)}"
+                )
+            root = roots[0]
+            lo, hi = root["ts"], root["ts"] + root["dur"]
+            tids = {e["args"].get("trace_id") for e in tevs}
+            if tids != {root["args"]["trace_id"]}:
+                raise AssertionError(
+                    f"trace track {tid}: unstable trace_id(s) {tids}"
+                )
+            names = {e["name"] for e in tevs}
+            if {"submit", "queue", "dispatch", "forward"} <= names:
+                nested += 1
+                e2e_s.append(root["dur"] / 1e6)
+                for e in tevs:
+                    if not (lo - 0.5 <= e["ts"]
+                            and e["ts"] + e["dur"] <= hi + 0.5):
+                        raise AssertionError(
+                            f"span {e['name']} escapes its request "
+                            f"(track {tid})"
+                        )
+        if nested == 0:
+            raise AssertionError(
+                "no request trace carries the full submit->queue->"
+                "dispatch->forward span chain"
+            )
+        e2e_s.sort()
+        payload.update(trace_json=trace_path,
+                       trace_requests=len(spans_by_tid),
+                       trace_nested_requests=nested,
+                       e2e_p50_s=percentile(e2e_s, 0.50),
+                       e2e_p90_s=percentile(e2e_s, 0.90),
+                       e2e_p99_s=percentile(e2e_s, 0.99))
+
+        slo_burns = []
+        with open(run_path, encoding="utf-8") as fh:
+            for line in fh:
+                try:
+                    ev = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if (ev.get("kind") == "anomaly"
+                        and ev.get("detector") == "slo_burn"):
+                    slo_burns.append(ev)
+        payload["slo_burn_anomalies"] = len(slo_burns)
+        if args.slow_dispatch_s > 0:
+            if len(slo_burns) != 1:
+                raise AssertionError(
+                    f"forced-slow run fired {len(slo_burns)} slo_burn "
+                    "anomalies (want exactly 1)"
+                )
+            if not slo_burns[0].get("flight"):
+                raise AssertionError("slo_burn anomaly took no flight dump")
+            if not slo_burns[0].get("trace_dir"):
+                raise AssertionError(
+                    "slo_burn anomaly armed no profiler capture"
+                )
+            payload["slo_burn_flight"] = slo_burns[0]["flight"]
+            payload["slo_burn_trace_dir"] = slo_burns[0]["trace_dir"]
+        elif slo_burns:
+            raise AssertionError(
+                f"clean run fired {len(slo_burns)} slo_burn anomalies "
+                "(want none)"
+            )
 
     # -- phase 3: warm restart loads artifacts, compiles nothing ----------
     if not args.no_warm_restart:
@@ -295,8 +476,24 @@ def main(argv=None) -> int:
     ap.add_argument("--artifact-dir", default=None,
                     help="persisted-executable dir (default: <out>/artifacts)")
     ap.add_argument("--no-warm-restart", action="store_true")
+    ap.add_argument("--slo-target-s", type=float, default=0.0,
+                    help="end-to-end latency SLO target in seconds "
+                    "(0 = SLO off); the smoke applies a tight "
+                    "test-friendly burn policy around it")
+    ap.add_argument("--slow-dispatch-s", type=float, default=0.0,
+                    help="FORCED-SLOW run: every dispatch sleeps this "
+                    "many seconds host-side (chaos slow_dispatch@*) — "
+                    "must fire exactly one slo_burn anomaly (flight "
+                    "dump + profiler capture); combine with "
+                    "--slo-target-s")
     ap.add_argument("--json", default=None, help="also write the payload here")
     args = ap.parse_args(argv)
+    if args.slow_dispatch_s > 0 and args.slo_target_s <= 0:
+        # without a target there is no tracker and the end-of-run
+        # "exactly one slo_burn" assertion is a GUARANTEED failure —
+        # refuse up front instead of after a full cold-compile sweep
+        ap.error("--slow-dispatch-s requires --slo-target-s > 0 (the "
+                 "forced-slow run exists to fire the SLO burn detector)")
 
     try:
         payload = run(args)

@@ -129,3 +129,18 @@ def pytest_collection_modifyitems(config, items):
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session")
+def serve_tiny_model():
+    """The ONE tiny f32 serving model shared by test_serve.py and
+    test_serve_obs.py (building it costs ~10 s of flax init — paying it
+    once per session instead of once per module keeps tier-1 inside its
+    wall budget). f32 (dtype=None) because the serving parity bars are
+    float32 statements."""
+    from gigapath_tpu.models.classification_head import get_model
+
+    return get_model(
+        input_dim=16, latent_dim=32, feat_layer="1", n_classes=2,
+        model_arch="gigapath_slide_enc_tiny", dtype=None,
+    )

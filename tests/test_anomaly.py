@@ -245,6 +245,47 @@ class TestDetectors:
         log.close()
 
 
+class TestSloBurnDetector:
+    """The slo_burn detector reacts to SloTracker transition events —
+    the full service-level loop (forced-slow dispatch -> one anomaly +
+    reactions) is pinned in tests/test_serve_obs.py; these are the
+    engine-side edges."""
+
+    def test_burning_transition_fires_with_reactions(self, tmp_path):
+        log, engine = make_engine(tmp_path)
+        for i in range(3):
+            log.step(i, wall_s=0.01, synced=True)
+        log.event("slo", name="serve", burning=True, target_s=0.05,
+                  budget=0.25, burn_short=4.0, burn_long=4.0,
+                  threshold=1.5, latency_s=0.5)
+        (ev,) = anomaly_events(log.path, "slo_burn")
+        assert ev["value"] == 4.0 and ev["baseline"] == 1.5
+        assert ev["target_s"] == 0.05
+        assert ev["flight"] and os.path.exists(ev["flight"])
+        log.close()
+
+    def test_final_status_and_recovery_never_fire(self, tmp_path):
+        log, engine = make_engine(tmp_path)
+        # terminal status events are marked final — never detector input
+        log.event("slo", name="serve", burning=True, final=True,
+                  target_s=0.05, burn_short=9.0, burn_long=9.0)
+        # a recovery transition is not an anomaly either
+        log.event("slo", name="serve", burning=False, target_s=0.05,
+                  burn_short=0.0, burn_long=0.0)
+        assert anomaly_events(log.path, "slo_burn") == []
+        log.close()
+
+    def test_cooldown_bounds_flapping_slo(self, tmp_path):
+        log, engine = make_engine(tmp_path, cooldown_steps=100)
+        for i in range(3):
+            log.step(i, wall_s=0.01, synced=True)
+        for _ in range(4):  # a flapping tracker re-enters burning
+            log.event("slo", name="serve", burning=True, target_s=0.05,
+                      burn_short=4.0, burn_long=4.0, threshold=1.5)
+        assert len(anomaly_events(log.path, "slo_burn")) == 1
+        log.close()
+
+
 # ---------------------------------------------------------------------------
 # flight recorder
 # ---------------------------------------------------------------------------

@@ -50,7 +50,7 @@ def test_fixture_tree_fires_every_rule_class():
     assert result.exit_code != 0
     fired = {f.rule for f in result.findings}
     expected = {"GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-                "GL007", "GL008", "GL009", "GL010", "GL011"}
+                "GL007", "GL008", "GL009", "GL010", "GL011", "GL012"}
     assert fired >= expected, (
         f"missing rule classes: {sorted(expected - fired)}"
     )
@@ -98,6 +98,15 @@ def test_fixture_specific_findings():
         # signal.signal outside obs/flight.py (the fixture's own
         # obs/flight.py twin is the negative control)
         ("GL011", "handlers.py", "install_cleanup_handler"),
+        # hand-rolled latency aggregation (time deltas -> list.append ->
+        # sort) outside obs/ (the fixture's own obs/metrics.py twin is
+        # the negative control, as are timing-without-sort and
+        # sort-without-timing)
+        ("GL012", "latency.py", "aggregate_latency_by_hand"),
+        ("GL012", "latency.py", "aggregate_latency_sorted_copy"),
+        # attribute-owned list (sorted(self._walls)) — the serving-stats
+        # shape must not slip past a bare-Name-only sorted() check
+        ("GL012", "latency.py", "LatencyStat.aggregate"),
     }
     assert expected <= got, f"missing: {sorted(expected - got)}"
 

@@ -1,0 +1,392 @@
+"""Typed metrics registry + SLO burn tracker (gigapath_tpu/obs/metrics.py).
+
+The pinned invariants (ISSUE 9):
+
+- **exactness**: concurrent observers drop nothing and double-count
+  nothing — histogram/counter totals are exact under threaded writers;
+- **atomic snapshot/merge**: one consistent cut; merges add bucket-wise
+  and refuse mismatched ladders;
+- **one percentile**: ``scripts/obs_report.py`` and the registry share
+  the single nearest-rank implementation (GL012's fix);
+- **zero overhead when off**: a NullRunLog (or ``GIGAPATH_METRICS=0``)
+  yields the null registry — no events, no files;
+- **SLO burn**: transition-edged both ways, multi-window, min-event
+  floored — the contract the anomaly engine's ``slo_burn`` detector
+  builds on.
+"""
+
+import json
+import os
+import sys
+import threading
+
+import pytest
+
+from gigapath_tpu.obs import NullRunLog, RunLog
+from gigapath_tpu.obs.metrics import (
+    MetricsRegistry,
+    NullMetricsRegistry,
+    NullSloTracker,
+    SloTracker,
+    exponential_bounds,
+    get_metrics,
+    histogram_quantile,
+    merge_snapshots,
+    percentile,
+    to_json_line,
+    to_prometheus,
+)
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "scripts"),
+)
+
+
+# ---------------------------------------------------------------------------
+# instruments
+# ---------------------------------------------------------------------------
+
+class TestInstruments:
+    def test_counter_gauge_basics(self):
+        m = MetricsRegistry()
+        c = m.counter("reqs")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        g = m.gauge("depth")
+        g.set(7)
+        g.inc(-2)
+        assert g.value == 5.0
+
+    def test_instruments_are_create_once_by_name(self):
+        m = MetricsRegistry()
+        assert m.counter("x") is m.counter("x")
+        assert m.histogram("h") is m.histogram("h")
+
+    def test_type_collision_refused(self):
+        m = MetricsRegistry()
+        m.counter("x")
+        with pytest.raises(ValueError, match="different type"):
+            m.gauge("x")
+        with pytest.raises(ValueError, match="different type"):
+            m.histogram("x")
+
+    def test_exponential_bounds_shape_and_validation(self):
+        bounds = exponential_bounds(1e-3, 2.0, 5)
+        assert bounds == [1e-3, 2e-3, 4e-3, 8e-3, 16e-3]
+        with pytest.raises(ValueError):
+            exponential_bounds(0, 2.0, 5)
+        with pytest.raises(ValueError):
+            exponential_bounds(1e-3, 1.0, 5)
+
+    def test_histogram_counts_sum_min_max(self):
+        m = MetricsRegistry()
+        h = m.histogram("lat", bounds=[0.1, 1.0, 10.0])
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        assert h.count == 5
+        assert h.counts == [1, 2, 1, 1]  # last slot = +inf overflow
+        assert h.sum == pytest.approx(56.05)
+        assert h.vmin == 0.05 and h.vmax == 50.0
+
+    def test_histogram_nonfinite_observation_ignored(self):
+        m = MetricsRegistry()
+        h = m.histogram("lat")
+        h.observe(float("nan"))
+        h.observe(float("inf"))
+        assert h.count == 0
+
+    def test_empty_histogram_snapshot_is_strict_json(self, tmp_path):
+        """A registered-but-never-observed histogram must flush None
+        quantiles, not NaN — a bare NaN token in the run JSONL breaks
+        the one-strict-JSON-object-per-line artifact contract."""
+        log = RunLog(str(tmp_path / "run.jsonl"), driver="t", echo=False)
+        m = get_metrics(log)
+        m.histogram("serve.e2e_s")  # registered, zero observations
+        log.run_end(status="ok")
+        for line in open(log.path):
+            ev = json.loads(line, parse_constant=lambda c: (_ for _ in ())
+                            .throw(ValueError(f"non-strict token {c}")))
+            if ev["kind"] == "metrics":
+                h = ev["histograms"]["serve.e2e_s"]
+                assert h["p50"] is None and h["p99"] is None
+                assert h["count"] == 0
+
+    def test_histogram_quantile_is_conservative_upper_bound(self):
+        """The quantile answers the containing bucket's UPPER bound
+        (over-estimate, never under), clamped to the observed max in
+        the overflow bucket."""
+        bounds = [0.1, 1.0, 10.0]
+        # 10 observations all in the (0.1, 1.0] bucket
+        assert histogram_quantile(bounds, [0, 10, 0, 0], 0.5) == 1.0
+        # overflow bucket: clamp to vmax
+        assert histogram_quantile(bounds, [0, 0, 0, 3], 0.99, vmax=42.0) == 42.0
+        # empty histogram
+        import math
+
+        assert math.isnan(histogram_quantile(bounds, [0, 0, 0, 0], 0.5))
+
+    def test_quantile_never_underestimates_exact_percentile(self):
+        """For any sample set, the histogram quantile >= the exact
+        nearest-rank percentile on the raw values (the conservative
+        contract a tail-latency gate needs)."""
+        import random
+
+        rng = random.Random(7)
+        values = [rng.uniform(1e-4, 5.0) for _ in range(200)]
+        m = MetricsRegistry()
+        h = m.histogram("lat")
+        for v in values:
+            h.observe(v)
+        exact = sorted(values)
+        for q in (0.5, 0.9, 0.99):
+            assert h.quantile(q) >= percentile(exact, q) - 1e-12
+
+
+# ---------------------------------------------------------------------------
+# exactness under concurrency (the service-lock satellite)
+# ---------------------------------------------------------------------------
+
+class TestConcurrencyExactness:
+    def test_concurrent_observers_exact_counts(self):
+        m = MetricsRegistry()
+        h = m.histogram("lat", bounds=exponential_bounds(1e-4, 2.0, 20))
+        c = m.counter("n")
+        n_threads, per_thread = 8, 500
+
+        def work(tid):
+            for i in range(per_thread):
+                h.observe(1e-4 * (1 + (i * (tid + 1)) % 1000))
+                c.inc()
+
+        threads = [threading.Thread(target=work, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = m.snapshot()
+        want = n_threads * per_thread
+        assert snap["counters"]["n"] == want
+        hist = snap["histograms"]["lat"]
+        assert hist["count"] == want, "dropped or double-counted observation"
+        assert sum(hist["counts"]) == want, "bucket counts disagree with count"
+
+
+# ---------------------------------------------------------------------------
+# snapshot / merge / exporters
+# ---------------------------------------------------------------------------
+
+class TestSnapshotAndExport:
+    def _registry(self):
+        m = MetricsRegistry()
+        m.counter("serve.submits").inc(5)
+        m.gauge("serve.queued_tokens").set(128)
+        h = m.histogram("serve.e2e_s", bounds=[0.1, 1.0])
+        for v in (0.05, 0.5, 2.0):
+            h.observe(v)
+        return m
+
+    def test_snapshot_shape_and_quantiles(self):
+        snap = self._registry().snapshot()
+        h = snap["histograms"]["serve.e2e_s"]
+        assert h["count"] == 3 and h["counts"] == [1, 1, 1]
+        assert h["p50"] == 1.0  # middle bucket's upper bound
+        assert h["p99"] == 2.0  # overflow clamped to max
+        assert snap["counters"]["serve.submits"] == 5.0
+
+    def test_merge_adds_counters_and_buckets(self):
+        a, b = self._registry().snapshot(), self._registry().snapshot()
+        merged = merge_snapshots(a, b)
+        assert merged["counters"]["serve.submits"] == 10.0
+        h = merged["histograms"]["serve.e2e_s"]
+        assert h["count"] == 6 and h["counts"] == [2, 2, 2]
+        assert h["max"] == 2.0 and h["p99"] == 2.0
+
+    def test_merge_refuses_mismatched_bounds(self):
+        a = self._registry().snapshot()
+        other = MetricsRegistry()
+        other.histogram("serve.e2e_s", bounds=[0.5]).observe(0.1)
+        with pytest.raises(ValueError, match="mismatched bucket"):
+            merge_snapshots(a, other.snapshot())
+
+    def test_json_line_is_one_line_finite(self):
+        line = to_json_line(self._registry().snapshot())
+        assert "\n" not in line
+        doc = json.loads(line)  # NaN/inf would fail strict JSON
+        assert doc["histograms"]["serve.e2e_s"]["count"] == 3
+
+    def test_prometheus_exposition(self):
+        text = to_prometheus(self._registry().snapshot())
+        lines = text.splitlines()
+        assert "# TYPE gigapath_serve_submits counter" in lines
+        assert "gigapath_serve_submits 5" in lines
+        assert "# TYPE gigapath_serve_e2e_s histogram" in lines
+        # cumulative buckets, +Inf equals the total count
+        assert 'gigapath_serve_e2e_s_bucket{le="0.1"} 1' in lines
+        assert 'gigapath_serve_e2e_s_bucket{le="1"} 2' in lines
+        assert 'gigapath_serve_e2e_s_bucket{le="+Inf"} 3' in lines
+        assert "gigapath_serve_e2e_s_count 3" in lines
+
+    def test_shared_percentile_is_the_obs_report_one(self):
+        import obs_report
+
+        assert obs_report.percentile is percentile
+
+
+# ---------------------------------------------------------------------------
+# env-gated construction + flushing
+# ---------------------------------------------------------------------------
+
+class TestGetMetrics:
+    def test_null_runlog_yields_null_registry(self):
+        m = get_metrics(NullRunLog())
+        assert isinstance(m, NullMetricsRegistry)
+        assert not isinstance(m, MetricsRegistry)
+        # the null instruments absorb everything
+        m.counter("x").inc()
+        m.histogram("h").observe(1.0)
+        assert m.snapshot()["counters"] == {}
+
+    def test_metrics_flag_off_yields_null_registry(self, tmp_path,
+                                                   monkeypatch):
+        monkeypatch.setenv("GIGAPATH_METRICS", "0")
+        log = RunLog(str(tmp_path / "run.jsonl"), driver="t", echo=False)
+        try:
+            assert not isinstance(get_metrics(log), MetricsRegistry)
+        finally:
+            log.close()
+
+    def test_attach_once_and_final_flush_inside_run_end(self, tmp_path):
+        path = str(tmp_path / "run.jsonl")
+        log = RunLog(path, driver="t", echo=False)
+        m = get_metrics(log)
+        assert isinstance(m, MetricsRegistry)
+        assert get_metrics(log) is m, "one registry per runlog"
+        m.counter("steps").inc(3)
+        log.run_end(status="ok")
+        events = [json.loads(line) for line in open(path)]
+        finals = [ev for ev in events if ev["kind"] == "metrics"]
+        assert len(finals) == 1 and finals[0]["reason"] == "final"
+        assert finals[0]["counters"]["steps"] == 3.0
+
+    def test_periodic_flush_interval(self, tmp_path):
+        path = str(tmp_path / "run.jsonl")
+        log = RunLog(path, driver="t", echo=False)
+        try:
+            m = MetricsRegistry(runlog=log, interval_s=0.0)
+            assert m.maybe_flush() is None  # interval 0 = periodic off
+            m.interval_s = 1e-9
+            m.counter("x").inc()
+            assert m.maybe_flush() is not None
+        finally:
+            log.close()
+
+    def test_textfile_written_atomically_on_flush(self, tmp_path):
+        log = RunLog(str(tmp_path / "run.jsonl"), driver="t", echo=False)
+        try:
+            textfile = str(tmp_path / "prom" / "gigapath.prom")
+            m = MetricsRegistry(runlog=log, textfile=textfile)
+            m.counter("reqs").inc(2)
+            m.flush(reason="final")
+            text = open(textfile).read()
+            assert "gigapath_reqs 2" in text
+            assert not [p for p in os.listdir(os.path.dirname(textfile))
+                        if ".tmp." in p], "tmp file left behind"
+        finally:
+            log.close()
+
+
+# ---------------------------------------------------------------------------
+# SLO burn tracking
+# ---------------------------------------------------------------------------
+
+def _tracker(log=None, **kw):
+    base = dict(budget=0.25, short_window_s=10.0, long_window_s=20.0,
+                burn_threshold=1.5, min_events=4, runlog=log, name="t")
+    base.update(kw)
+    return SloTracker(0.1, **base)
+
+
+class TestSloTracker:
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            SloTracker(0.0)
+        with pytest.raises(ValueError):
+            SloTracker(1.0, budget=0.0)
+        with pytest.raises(ValueError):
+            SloTracker(1.0, short_window_s=10, long_window_s=5)
+
+    def test_transition_edged_burn_and_recovery(self):
+        slo = _tracker()
+        # 4 fast requests: no burn
+        for i in range(4):
+            assert slo.observe(0.01, now=float(i)) is None
+        assert not slo.burning
+        # a slow regime: all-slow -> burn 1/0.25 = 4x >= 1.5 on both
+        # windows. ONE transition record, not one per request
+        records = [slo.observe(0.5, now=4.0 + 0.1 * i) for i in range(8)]
+        fired = [r for r in records if r is not None]
+        assert len(fired) == 1 and fired[0]["burning"] is True
+        assert slo.burning and slo.burn_entries == 1
+        # recovery: fast requests age the slow ones out of both windows
+        rec = None
+        for i in range(60):
+            r = slo.observe(0.01, now=6.0 + 0.5 * i)
+            rec = r if (r is not None and not r["burning"]) else rec
+        assert rec is not None and slo.burning is False
+
+    def test_min_events_floor_blocks_early_fire(self):
+        slo = _tracker(min_events=16)
+        for i in range(8):  # every one slow, but only 8 events
+            assert slo.observe(9.9, now=float(i) * 0.1) is None
+        assert not slo.burning
+
+    def test_short_blip_does_not_burn_long_window(self):
+        """One slow burst inside an otherwise healthy LONG window must
+        not page: the long-window burn stays under threshold."""
+        slo = _tracker(budget=0.05, short_window_s=1.0, long_window_s=20.0)
+        t = 0.0
+        for _ in range(96):  # 96 good events across the long window
+            slo.observe(0.01, now=t)
+            t += 0.2
+        burned = [slo.observe(0.5, now=t + 0.01 * i) for i in range(3)]
+        # short window is all-slow (burn 20x) but the long window holds
+        assert all(r is None for r in burned) and not slo.burning
+
+    def test_slo_events_land_on_runlog_and_final_status(self, tmp_path):
+        path = str(tmp_path / "run.jsonl")
+        log = RunLog(path, driver="t", echo=False)
+        slo = _tracker(log=log)
+        for i in range(6):
+            slo.observe(0.5, now=float(i) * 0.1)
+        slo.emit_status()
+        log.close()
+        events = [json.loads(line) for line in open(path)]
+        slos = [ev for ev in events if ev["kind"] == "slo"]
+        assert len(slos) == 2
+        assert slos[0]["burning"] is True and "final" not in slos[0]
+        assert slos[1]["final"] is True
+        assert slos[1]["violations"] == 6 and slos[1]["total"] == 6
+
+    def test_failures_burn_the_budget(self):
+        """A failure storm with ZERO successful latencies must still
+        burn: observe_failure records a spent unit of error budget (the
+        deadline-expired / breaker-shed / dispatch-error path)."""
+        slo = _tracker()
+        records = [slo.observe_failure(now=float(i) * 0.1)
+                   for i in range(6)]
+        fired = [r for r in records if r is not None]
+        assert len(fired) == 1 and fired[0]["burning"] is True
+        assert fired[0]["latency_s"] is None  # no latency to report
+        assert slo.violations == 6 and slo.total == 6
+
+    def test_null_tracker_absorbs(self):
+        slo = NullSloTracker()
+        slo.observe(99.0)
+        slo.observe_failure()
+        slo.emit_status()
+        assert slo.status() == {} and not slo.burning

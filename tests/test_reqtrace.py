@@ -1,0 +1,173 @@
+"""End-to-end request tracing (gigapath_tpu/obs/reqtrace.py).
+
+Pinned: stable ``trace_id``/``span_id`` per request, Chrome-trace JSON
+export (``ph: "X"`` complete events, µs clocks, one named track per
+request, spans CONTAINED in their request), bounded memory with a
+COUNTED overflow, export riding the runlog's closers, and the
+zero-overhead-when-off twin (no clocks, no file, no event)."""
+
+import json
+import os
+
+from gigapath_tpu.obs import NullRunLog, RunLog
+from gigapath_tpu.obs.reqtrace import (
+    NULL_REQUEST_TRACE,
+    NullTraceCollector,
+    RequestTrace,
+    TraceCollector,
+    get_tracer,
+)
+
+
+def _log(tmp_path, name="run.jsonl"):
+    return RunLog(str(tmp_path / name), driver="t", echo=False)
+
+
+class TestRequestTrace:
+    def test_trace_ids_stable_and_unique(self, tmp_path):
+        log = _log(tmp_path)
+        try:
+            col = TraceCollector(log)
+            a = col.start("slide_a", now=1.0)
+            b = col.start("slide_b", now=2.0)
+            assert a.trace_id != b.trace_id
+            assert a.trace_id.startswith(log.run_id)
+            a.add_span("submit", 1.0, 1.1)
+            a.add_span("queue", 1.1, 1.5)
+            # every span_id carries the request's trace_id prefix
+            assert [s.args["span_id"] for s in a.spans] == [
+                f"{a.trace_id}.1", f"{a.trace_id}.2"
+            ]
+        finally:
+            log.close()
+
+    def test_t_last_chains_sibling_spans(self):
+        tr = RequestTrace("t-1", 1, "s", t_start=5.0)
+        assert tr.t_last == 5.0
+        tr.add_span("submit", 5.0, 5.2)
+        assert tr.t_last == 5.2
+
+    def test_finish_first_close_wins_and_clamps(self):
+        tr = RequestTrace("t-1", 1, "s", t_start=5.0)
+        tr.finish(now=6.0, status="ok")
+        tr.finish(now=9.0, status="error")  # late duplicate ignored
+        assert tr.t_end == 6.0 and tr.status == "ok"
+        sp = RequestTrace("t-2", 2, "s", 0.0)
+        sp.add_span("x", 2.0, 1.0)  # clock jitter: clamped, not negative
+        assert sp.spans[0].t1 == 2.0
+
+
+class TestTraceCollector:
+    def _traced(self, col):
+        tr = col.start("slide_0", now=10.0, n_tiles=64)
+        tr.add_span("submit", 10.0, 10.1, bucket=64)
+        tr.add_span("queue", 10.1, 10.5, bucket=64)
+        tr.add_span("dispatch", 10.5, 11.0, bucket=64)
+        tr.add_span("forward", 10.6, 10.9, bucket=64)
+        tr.finish(now=11.0)
+        return tr
+
+    def test_chrome_trace_export_shape_and_nesting(self, tmp_path):
+        log = _log(tmp_path)
+        col = TraceCollector(log)
+        tr = self._traced(col)
+        path = col.export()
+        log.close()
+        assert path == os.path.splitext(log.path)[0] + ".trace.json"
+        doc = json.load(open(path))
+        assert doc["displayTimeUnit"] == "ms"
+        events = doc["traceEvents"]
+        metas = [e for e in events if e["ph"] == "M"]
+        assert metas and tr.trace_id in metas[0]["args"]["name"]
+        xs = [e for e in events if e["ph"] == "X"]
+        root = [e for e in xs if e["name"] == "request"][0]
+        assert root["args"]["trace_id"] == tr.trace_id
+        # µs clocks: the request lasted 1.0 s
+        assert root["dur"] == 1e6
+        lo, hi = root["ts"], root["ts"] + root["dur"]
+        for e in xs:
+            assert e["tid"] == tr.tid
+            assert lo <= e["ts"] and e["ts"] + e["dur"] <= hi, (
+                f"span {e['name']} escapes its request"
+            )
+            assert e["args"]["trace_id"] == tr.trace_id
+        assert {e["name"] for e in xs} == {
+            "request", "submit", "queue", "dispatch", "forward"
+        }
+
+    def test_export_event_once_and_rewrite_idempotent(self, tmp_path):
+        log = _log(tmp_path)
+        col = TraceCollector(log)
+        self._traced(col)
+        col.export()
+        self._traced(col)
+        col.export()  # rewrites the file, emits NO second trace event
+        log.close()
+        events = [json.loads(line) for line in open(log.path)]
+        trace_events = [ev for ev in events if ev["kind"] == "trace"]
+        assert len(trace_events) == 1
+        doc = json.load(open(col.path))
+        roots = [e for e in doc["traceEvents"]
+                 if e["ph"] == "X" and e["name"] == "request"]
+        assert len(roots) == 2  # the rewrite carries both requests
+
+    def test_empty_collector_exports_nothing(self, tmp_path):
+        log = _log(tmp_path)
+        col = TraceCollector(log)
+        assert col.export() is None
+        log.close()
+        assert not os.path.exists(col.path)
+        events = [json.loads(line) for line in open(log.path)]
+        assert not [ev for ev in events if ev["kind"] == "trace"]
+
+    def test_max_traces_cap_counts_dropped(self, tmp_path):
+        log = _log(tmp_path)
+        col = TraceCollector(log, max_traces=2)
+        a = col.start("s0")
+        b = col.start("s1")
+        c = col.start("s2")  # past the cap: the shared null trace
+        assert c is NULL_REQUEST_TRACE and a is not b
+        for tr in (a, b):
+            tr.add_span("submit", tr.t_start, tr.t_start + 0.1)
+            tr.finish()
+        col.export()
+        log.close()
+        trace_ev = [json.loads(line) for line in open(log.path)
+                    if '"trace"' in line][-1]
+        assert trace_ev["traces"] == 2 and trace_ev["dropped"] == 1
+
+
+class TestGetTracer:
+    def test_null_runlog_yields_null_collector(self):
+        col = get_tracer(NullRunLog())
+        assert isinstance(col, NullTraceCollector)
+        assert not isinstance(col, TraceCollector)
+        tr = col.start("s")
+        assert tr is NULL_REQUEST_TRACE
+        tr.add_span("x", 0, 1)
+        tr.finish()
+        assert col.export() is None and col.path is None
+
+    def test_attach_once_and_export_rides_run_end(self, tmp_path):
+        log = _log(tmp_path)
+        col = get_tracer(log)
+        assert isinstance(col, TraceCollector)
+        assert get_tracer(log) is col
+        tr = col.start("slide", now=1.0)
+        tr.add_span("submit", 1.0, 1.2)
+        tr.finish(now=1.2)
+        log.run_end(status="ok")  # closers run the export
+        assert os.path.exists(col.path)
+        events = [json.loads(line) for line in open(log.path)]
+        assert [ev for ev in events if ev["kind"] == "trace"]
+
+    def test_max_traces_env_read_once(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("GIGAPATH_TRACE_MAX", "1")
+        log = _log(tmp_path)
+        try:
+            col = get_tracer(log)
+            assert col.max_traces == 1
+            col.start("a")
+            assert col.start("b") is NULL_REQUEST_TRACE
+        finally:
+            log.close()

@@ -281,15 +281,11 @@ class TestEmbeddingCache:
 # ---------------------------------------------------------------------------
 
 @pytest.fixture(scope="module")
-def tiny_model():
-    from gigapath_tpu.models.classification_head import get_model
-
+def tiny_model(serve_tiny_model):
     # f32 (dtype=None), unlike inference.load_model's bf16 default: the
-    # 1e-5 parity bar is a float32 statement (bf16 resolution is ~2^-8)
-    return get_model(
-        input_dim=16, latent_dim=32, feat_layer="1", n_classes=2,
-        model_arch="gigapath_slide_enc_tiny", dtype=None,
-    )
+    # 1e-5 parity bar is a float32 statement (bf16 resolution is ~2^-8).
+    # Built ONCE per session in conftest.py (shared with test_serve_obs)
+    return serve_tiny_model
 
 
 def _forward_fn(model):

@@ -1,0 +1,485 @@
+"""Serving latency observability (ISSUE 9 acceptance): typed metrics,
+end-to-end request traces, and SLO burn-rate gating on the
+queue -> bucket -> AOT -> cache path.
+
+The pinned invariants:
+
+- **exact histogram counts**: N served slides = N ``serve.e2e_s`` and N
+  ``serve.queue_wait_s`` observations — under concurrent submitters too
+  (nothing dropped or double-counted across the service lock);
+- **traces nest**: every dispatched request's Chrome-trace spans
+  (``submit -> queue -> dispatch[forward, cache_store]``) are contained
+  in its ``request`` root on its own track, under ONE stable
+  ``trace_id``;
+- **slo_burn both ways**: a forced-slow-dispatch run (chaos
+  ``slow_dispatch@*``) fires EXACTLY ONE ``slo_burn`` anomaly with the
+  flight-dump + profiler-capture reactions; a clean run fires none;
+- **zero overhead when off**: obs-off twin leaves no metrics/trace
+  files, and the watched executable's HLO is byte-identical ON vs OFF
+  with XLA-layer compile counts pinned equal.
+"""
+
+import glob
+import json
+import logging
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from gigapath_tpu.obs.metrics import MetricsRegistry, NullMetricsRegistry
+from gigapath_tpu.obs.reqtrace import NullTraceCollector, TraceCollector
+from gigapath_tpu.serve import ServeConfig, SlideService
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "scripts"),
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_model(serve_tiny_model):
+    # the session-scoped shared serving model (conftest.py) — paying
+    # the ~10 s flax init once per suite, not once per module
+    return serve_tiny_model
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def _forward_fn(model):
+    def forward(p, embeds, coords, pad_mask):
+        return model.apply({"params": p}, embeds, coords,
+                           pad_mask=pad_mask, deterministic=True)
+
+    return forward
+
+
+def _config(tmp_path, **overrides):
+    base = dict(
+        max_batch=2, max_wait_s=0.01, bucket_min=16, bucket_growth=2.0,
+        bucket_max=32, bucket_align=16, feature_dim=16, artifact_dir=None,
+    )
+    base.update(overrides)
+    return ServeConfig(**base)
+
+
+def _slides(rng, lengths):
+    return [
+        (f"s{i}_n{n}", rng.normal(size=(n, 16)).astype(np.float32),
+         rng.uniform(0, 25000, (n, 2)).astype(np.float32))
+        for i, n in enumerate(lengths)
+    ]
+
+
+def _events(path):
+    with open(path, encoding="utf-8") as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+class _XlaCompileCounter(logging.Handler):
+    def __init__(self):
+        super().__init__()
+        self.count = 0
+
+    def emit(self, record):
+        if "Finished XLA compilation of" in record.getMessage():
+            self.count += 1
+
+
+class _count_xla_compiles:
+    def __enter__(self):
+        self.counter = _XlaCompileCounter()
+        self.logger = logging.getLogger("jax._src.dispatch")
+        self.prev_level = self.logger.level
+        self.logger.addHandler(self.counter)
+        self.logger.setLevel(logging.DEBUG)
+        jax.config.update("jax_log_compiles", True)
+        return self.counter
+
+    def __exit__(self, *exc):
+        jax.config.update("jax_log_compiles", False)
+        self.logger.setLevel(self.prev_level)
+        self.logger.removeHandler(self.counter)
+
+
+# ---------------------------------------------------------------------------
+# exact latency telemetry
+# ---------------------------------------------------------------------------
+
+class TestServiceMetrics:
+    def test_histogram_counts_exact_sync(self, tiny_model, rng, tmp_path):
+        model, params = tiny_model
+        service = SlideService(
+            _forward_fn(model), params, config=_config(tmp_path),
+            out_dir=str(tmp_path), identity="tiny",
+        )
+        slides = _slides(rng, [5, 16, 17, 30])
+        futs = [service.submit(*s) for s in slides]
+        service.drain()
+        for f in futs:
+            f.result(timeout=60)
+        snap = service.metrics.snapshot()
+        hists = snap["histograms"]
+        assert hists["serve.e2e_s"]["count"] == 4
+        assert hists["serve.queue_wait_s"]["count"] == 4
+        assert hists["serve.dispatch_s"]["count"] == service.dispatch_count
+        assert snap["counters"]["serve.submits"] == 4.0
+        assert snap["counters"]["serve.slides"] == 4.0
+        assert snap["counters"]["serve.dispatches"] == service.dispatch_count
+        # every latency is a real positive number
+        assert hists["serve.e2e_s"]["min"] > 0
+        assert hists["serve.e2e_s"]["p99"] >= hists["serve.e2e_s"]["p50"]
+        run_path = service.runlog.path
+        service.close()
+        # final metrics event flushed inside run_end
+        finals = [ev for ev in _events(run_path)
+                  if ev["kind"] == "metrics" and ev["reason"] == "final"]
+        assert len(finals) == 1
+        assert finals[0]["histograms"]["serve.e2e_s"]["count"] == 4
+
+    def test_cache_hits_and_joins_counted_not_double_observed(
+        self, tiny_model, rng, tmp_path
+    ):
+        model, params = tiny_model
+        service = SlideService(
+            _forward_fn(model), params, config=_config(tmp_path),
+            out_dir=str(tmp_path), identity="tiny",
+        )
+        sid, feats, coords = _slides(rng, [16])[0]
+        f1 = service.submit(sid, feats, coords)
+        service.drain()
+        f1.result(timeout=60)
+        f2 = service.submit("repeat_" + sid, feats, coords)  # cache hit
+        assert np.allclose(np.asarray(f2.result(timeout=5)),
+                           np.asarray(f1.result()))
+        snap = service.metrics.snapshot()
+        # the hit resolved without a forward: ONE e2e observation only
+        assert snap["histograms"]["serve.e2e_s"]["count"] == 1
+        assert snap["counters"]["serve.submits"] == 2.0
+        assert snap["counters"]["serve.cache_hits"] == 1.0
+        service.close()
+
+    def test_concurrent_submitters_exact_counts(self, tiny_model, rng,
+                                                tmp_path):
+        """24 distinct slides from 8 threads through the worker: every
+        observation lands exactly once (the service-lock satellite)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        model, params = tiny_model
+        slides = _slides(rng, [5, 9, 16, 17, 20, 30] * 4)
+        with SlideService(
+            _forward_fn(model), params,
+            config=_config(tmp_path, max_batch=3),
+            out_dir=str(tmp_path), identity="tiny",
+        ) as service:
+            with ThreadPoolExecutor(max_workers=8) as pool:
+                futs = list(pool.map(lambda s: service.submit(*s), slides))
+            results = [f.result(timeout=120) for f in futs]
+            assert len(results) == 24
+            snap = service.metrics.snapshot()
+            hist = snap["histograms"]["serve.e2e_s"]
+            assert hist["count"] == 24, "dropped/double-counted e2e"
+            assert sum(hist["counts"]) == 24
+            assert snap["histograms"]["serve.queue_wait_s"]["count"] == 24
+            assert snap["counters"]["serve.submits"] == 24.0
+            assert snap["counters"]["serve.slides"] == 24.0
+
+
+# ---------------------------------------------------------------------------
+# request traces
+# ---------------------------------------------------------------------------
+
+class TestServiceTraces:
+    def test_traces_nest_with_stable_ids_and_cache_store(
+        self, tiny_model, rng, tmp_path
+    ):
+        model, params = tiny_model
+        service = SlideService(
+            _forward_fn(model), params, config=_config(tmp_path),
+            out_dir=str(tmp_path), identity="tiny",
+        )
+        slides = _slides(rng, [5, 16, 17])
+        futs = [service.submit(*s) for s in slides]
+        service.drain()
+        for f in futs:
+            f.result(timeout=60)
+        hit = service.submit("rehit", slides[0][1], slides[0][2])
+        hit.result(timeout=5)
+        run_path = service.runlog.path
+        service.close()  # run_end -> closers -> export
+
+        trace_path = os.path.splitext(run_path)[0] + ".trace.json"
+        assert os.path.exists(trace_path)
+        doc = json.load(open(trace_path))
+        by_tid = {}
+        for ev in doc["traceEvents"]:
+            if ev.get("ph") == "X":
+                by_tid.setdefault(ev["tid"], []).append(ev)
+        assert len(by_tid) == 4  # 3 dispatched + 1 cache-hit request
+        full_chains = 0
+        hit_tracks = 0
+        for tid, evs in by_tid.items():
+            roots = [e for e in evs if e["name"] == "request"]
+            assert len(roots) == 1
+            root = roots[0]
+            lo, hi = root["ts"], root["ts"] + root["dur"]
+            assert {e["args"]["trace_id"] for e in evs} == {
+                root["args"]["trace_id"]
+            }, "span escaped its trace_id"
+            names = {e["name"] for e in evs}
+            if {"submit", "queue", "dispatch", "forward",
+                    "cache_store"} <= names:
+                full_chains += 1
+                for e in evs:
+                    assert lo - 0.5 <= e["ts"]
+                    assert e["ts"] + e["dur"] <= hi + 0.5, (
+                        f"{e['name']} escapes its request"
+                    )
+                # chronological chain: submit ends before queue ends
+                # before dispatch ends
+                end = {e["name"]: e["ts"] + e["dur"] for e in evs}
+                assert end["submit"] <= end["queue"] <= end["dispatch"]
+            elif root["args"]["status"] == "cache_hit":
+                hit_tracks += 1
+        assert full_chains == 3 and hit_tracks == 1
+        # the trace event landed on the run log
+        trace_events = [ev for ev in _events(run_path)
+                        if ev["kind"] == "trace"]
+        assert len(trace_events) == 1
+        assert trace_events[0]["traces"] == 4
+
+
+# ---------------------------------------------------------------------------
+# SLO burn: the closed loop, both ways
+# ---------------------------------------------------------------------------
+
+def _slo_config(tmp_path, target_s):
+    return _config(
+        tmp_path, bucket_max=16, slo_target_s=target_s, slo_budget=0.25,
+        slo_burn_threshold=1.5, slo_short_window_s=30.0,
+        slo_long_window_s=60.0, slo_min_events=4,
+    )
+
+
+class TestSloBurn:
+    def test_forced_slow_dispatch_fires_exactly_one_slo_burn(
+        self, tiny_model, rng, tmp_path, monkeypatch
+    ):
+        monkeypatch.delenv("GIGAPATH_OBS", raising=False)
+        monkeypatch.delenv("GIGAPATH_ANOMALY", raising=False)
+        monkeypatch.setenv("GIGAPATH_CHAOS", "slow_dispatch@*:0.05")
+        model, params = tiny_model
+        service = SlideService(
+            _forward_fn(model), params,
+            config=_slo_config(tmp_path, target_s=0.01),
+            out_dir=str(tmp_path), identity="tiny",
+        )
+        slides = _slides(rng, [5, 7, 9, 11])  # one bucket, 4 requests
+        futs = [service.submit(*s) for s in slides]
+        service.drain()
+        for f in futs:
+            f.result(timeout=60)
+        run_path = service.runlog.path
+        service.close()
+        events = _events(run_path)
+        burns = [ev for ev in events if ev.get("kind") == "anomaly"
+                 and ev.get("detector") == "slo_burn"]
+        assert len(burns) == 1, (
+            f"want exactly one slo_burn, got {len(burns)}"
+        )
+        # the reactions: flight dump written, profiler capture armed
+        assert burns[0]["flight"] and os.path.exists(burns[0]["flight"])
+        assert burns[0]["trace_dir"] and os.path.isdir(burns[0]["trace_dir"])
+        # the transition slo event that fed the detector
+        slos = [ev for ev in events if ev.get("kind") == "slo"]
+        assert any(ev.get("burning") and not ev.get("final") for ev in slos)
+
+    def test_deadline_failures_burn_the_slo(self, tiny_model, rng,
+                                            tmp_path, monkeypatch):
+        """A deadline storm produces zero successful latencies — the
+        failures themselves must reach the tracker as violations."""
+        import time as _time
+
+        monkeypatch.delenv("GIGAPATH_OBS", raising=False)
+        monkeypatch.delenv("GIGAPATH_CHAOS", raising=False)
+        model, params = tiny_model
+        config = _slo_config(tmp_path, target_s=0.01)
+        config = ServeConfig(**{**config.__dict__, "deadline_s": 0.001})
+        service = SlideService(
+            _forward_fn(model), params, config=config,
+            out_dir=str(tmp_path), identity="tiny",
+        )
+        futs = [service.submit(*s) for s in _slides(rng, [5, 7, 9, 11])]
+        _time.sleep(0.05)  # every request is now past its deadline
+        service.drain()
+        for f in futs:
+            with pytest.raises(Exception):
+                f.result(timeout=10)
+        assert service.slo.violations == 4 and service.slo.total == 4
+        service.close()
+
+    def test_clean_run_fires_none_and_final_status_lands(
+        self, tiny_model, rng, tmp_path, monkeypatch
+    ):
+        monkeypatch.delenv("GIGAPATH_OBS", raising=False)
+        monkeypatch.delenv("GIGAPATH_ANOMALY", raising=False)
+        monkeypatch.delenv("GIGAPATH_CHAOS", raising=False)
+        model, params = tiny_model
+        service = SlideService(
+            _forward_fn(model), params,
+            config=_slo_config(tmp_path, target_s=300.0),
+            out_dir=str(tmp_path), identity="tiny",
+        )
+        slides = _slides(rng, [5, 7, 9, 11, 13, 15])
+        futs = [service.submit(*s) for s in slides]
+        service.drain()
+        for f in futs:
+            f.result(timeout=60)
+        run_path = service.runlog.path
+        service.close()
+        events = _events(run_path)
+        assert not [ev for ev in events if ev.get("kind") == "anomaly"
+                    and ev.get("detector") == "slo_burn"]
+        finals = [ev for ev in events if ev.get("kind") == "slo"
+                  and ev.get("final")]
+        assert len(finals) == 1 and finals[0]["burning"] is False
+        assert finals[0]["violations"] == 0 and finals[0]["total"] == 6
+
+
+# ---------------------------------------------------------------------------
+# overhead invariants: metrics+tracing ON vs OFF
+# ---------------------------------------------------------------------------
+
+class TestOverheadInvariants:
+    def test_obs_off_twin_no_metrics_no_traces_no_slo(
+        self, tiny_model, rng, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("GIGAPATH_OBS", "0")
+        model, params = tiny_model
+        service = SlideService(
+            _forward_fn(model), params,
+            config=_slo_config(tmp_path, target_s=0.01),
+            out_dir=str(tmp_path), identity="tiny",
+        )
+        assert isinstance(service.metrics, NullMetricsRegistry)
+        assert not isinstance(service.metrics, MetricsRegistry)
+        assert isinstance(service.tracer, NullTraceCollector)
+        assert not isinstance(service.tracer, TraceCollector)
+        fut = service.submit("s", rng.normal(size=(5, 16)).astype(np.float32))
+        service.drain()
+        assert np.isfinite(np.asarray(fut.result(timeout=60))).all()
+        service.close()
+        assert not os.path.exists(tmp_path / "obs")
+        assert not glob.glob(str(tmp_path / "**" / "*.trace.json"),
+                             recursive=True)
+        assert not glob.glob(str(tmp_path / "**" / "*.prom"),
+                             recursive=True)
+
+    def test_watched_hlo_byte_identical_and_compile_counts_pinned(
+        self, tiny_model, rng, tmp_path, monkeypatch
+    ):
+        """The instrumented service's compiled executable is the SAME
+        program as the obs-off twin's (HLO text byte-equal), and both
+        pay exactly one XLA compile for one bucket."""
+        model, params = tiny_model
+        feats = rng.normal(size=(5, 16)).astype(np.float32)
+
+        def serve_one(obs_on, out_dir):
+            if obs_on:
+                monkeypatch.delenv("GIGAPATH_OBS", raising=False)
+            else:
+                monkeypatch.setenv("GIGAPATH_OBS", "0")
+            service = SlideService(
+                _forward_fn(model), params,
+                config=_config(tmp_path, bucket_max=16),
+                out_dir=out_dir, identity="tiny",
+            )
+            with _count_xla_compiles() as counter:
+                fut = service.submit("s", feats)
+                service.drain()
+                fut.result(timeout=60)
+            key = (service.capacity_for(16), 16)
+            hlo = service.aot._executables[key].as_text()
+            service.close()
+            return hlo, counter.count
+
+        hlo_on, compiles_on = serve_one(True, str(tmp_path / "on"))
+        hlo_off, compiles_off = serve_one(False, str(tmp_path / "off"))
+        assert hlo_on == hlo_off, "obs instrumentation changed the program"
+        assert compiles_on == compiles_off == 1
+
+
+# ---------------------------------------------------------------------------
+# the smoke script's PR-9 surface (in-process, small scale)
+# ---------------------------------------------------------------------------
+
+class TestServeSmokeLatencySurface:
+    def _run(self, tmp_path, extra):
+        import serve_smoke
+
+        json_path = str(tmp_path / "SERVE_SMOKE.json")
+        prev_chaos = os.environ.get("GIGAPATH_CHAOS")
+        try:
+            rc = serve_smoke.main([
+                "--out-dir", str(tmp_path / "out"), "--json", json_path,
+                "--slides", "6", "--distinct-lengths", "3", "--repeats", "3",
+                "--threads", "3", "--max-batch", "2", "--bucket-max", "32",
+            ] + extra)
+        finally:
+            # in-process main(): the forced-slow path appends to
+            # GIGAPATH_CHAOS — restore so later tests see a clean env
+            if prev_chaos is None:
+                os.environ.pop("GIGAPATH_CHAOS", None)
+            else:
+                os.environ["GIGAPATH_CHAOS"] = prev_chaos
+        with open(json_path) as fh:
+            return rc, json.load(fh)
+
+    def test_clean_smoke_emits_metrics_trace_and_no_burn(self, tmp_path,
+                                                         monkeypatch):
+        monkeypatch.delenv("GIGAPATH_OBS", raising=False)
+        monkeypatch.delenv("GIGAPATH_ANOMALY", raising=False)
+        rc, payload = self._run(tmp_path, ["--slo-target-s", "300"])
+        assert rc == 0, payload
+        hists = payload["metrics"]["histograms"]
+        for name in ("serve.queue_wait_s", "serve.dispatch_s",
+                     "serve.e2e_s"):
+            assert hists[name]["count"] > 0
+            for q in ("p50", "p90", "p99"):
+                assert hists[name][q] is not None
+        for key in ("e2e_p50_s", "e2e_p90_s", "e2e_p99_s",
+                    "dispatch_p50_s", "dispatch_p99_s", "queue_wait_p99_s"):
+            assert isinstance(payload[key], float)
+        assert payload["slo_burn_anomalies"] == 0
+        assert os.path.exists(payload["trace_json"])
+        assert payload["trace_nested_requests"] == 6
+
+    def test_forced_slow_smoke_fires_exactly_one_burn(self, tmp_path,
+                                                      monkeypatch):
+        monkeypatch.delenv("GIGAPATH_OBS", raising=False)
+        monkeypatch.delenv("GIGAPATH_ANOMALY", raising=False)
+        rc, payload = self._run(tmp_path, [
+            "--slo-target-s", "0.05", "--slow-dispatch-s", "0.2",
+            "--no-warm-restart",
+        ])
+        assert rc == 0, payload
+        assert payload["slo_burn_anomalies"] == 1
+        assert os.path.exists(payload["slo_burn_flight"])
+        assert os.path.isdir(payload["slo_burn_trace_dir"])
+
+    def test_obs_off_smoke_twin_leaves_no_latency_surface(self, tmp_path,
+                                                          monkeypatch):
+        monkeypatch.setenv("GIGAPATH_OBS", "0")
+        rc, payload = self._run(tmp_path, [])
+        assert rc == 0, payload
+        assert "metrics" not in payload
+        assert "trace_json" not in payload
+        assert payload["obs"] is None
+        assert not glob.glob(str(tmp_path / "out" / "**" / "*.trace.json"),
+                             recursive=True)
+        assert not os.path.exists(tmp_path / "out" / "obs")

@@ -488,6 +488,143 @@ def check_timing_hygiene(project: Project) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# GL012 — ad-hoc latency aggregation
+# ---------------------------------------------------------------------------
+
+# The pattern: wall-clock deltas appended to a bare list, then
+# sorted/indexed for a percentile by hand. Three copies of that had
+# grown by PR 9 (obs_report, serve_smoke, and the serving stats) with
+# three subtly different nearest-rank conventions — and a list of every
+# request's latency is unbounded memory on a serving path. Library code
+# must aggregate through gigapath_tpu/obs/metrics.py (Histogram /
+# percentile): one bounded, thread-exact, snapshot-able implementation.
+_GL012_EXEMPT_SEGMENTS = frozenset({"scripts", "tests", "demo"})
+# the sanctioned aggregation layer itself, matched by path segment so
+# fixture trees can carry their own obs/ twin as a negative control
+_GL012_SANCTIONED_SEGMENT = "obs"
+
+
+def _gl012_scan_function(mod, fn) -> Optional[Finding]:
+    """One GL012 verdict per function: a time-derived value appended to
+    a list that the SAME function then sorts (``sorted(x)`` /
+    ``x.sort()``) is a hand-rolled latency aggregation."""
+
+    def resolved(callee: str) -> str:
+        return _gl008_resolved_callee(mod, callee)
+
+    def is_time_call(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        name = dotted_name(node.func)
+        return bool(name) and resolved(name) in _GL008_TIME_CALLS
+
+    def time_derived(node: ast.AST) -> bool:
+        """Expression mentions a timer/delta name or calls the clock."""
+        for sub in ast.walk(node):
+            if is_time_call(sub):
+                return True
+            if isinstance(sub, ast.Name) and sub.id in tainted:
+                return True
+        return False
+
+    tainted: Set[str] = set()      # timer values and deltas of them
+    latency_lists: Set[str] = set()  # lists holding time-derived appends
+    append_lineno: Dict[str, int] = {}
+
+    # pass 1: taint timer names and their deltas (two sweeps so a delta
+    # assigned above its timer's textual position still taints)
+    for _ in range(2):
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Assign):
+                value_tainted = is_time_call(node.value) or (
+                    isinstance(node.value, ast.BinOp)
+                    and isinstance(node.value.op, ast.Sub)
+                    and time_derived(node.value)
+                )
+                if value_tainted:
+                    for tgt in node.targets:
+                        for n in names_in(tgt):
+                            tainted.add(n.id)
+
+    if not tainted:
+        return None
+
+    # pass 2: appends of time-derived values, and sorts of those lists
+    for node in ast.walk(fn.node):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_name(node.func)
+        if not callee:
+            continue
+        if callee.endswith(".append") and node.args and time_derived(
+            node.args[0]
+        ):
+            owner = callee.rsplit(".", 1)[0]
+            latency_lists.add(owner)
+            append_lineno.setdefault(owner, node.lineno)
+    if not latency_lists:
+        return None
+    for node in ast.walk(fn.node):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_name(node.func)
+        if not callee:
+            continue
+        # the append pass tracks dotted owners ('self._walls'), so the
+        # sorted() arm must resolve dotted names too — not just bare
+        # ast.Name (sorted(self._walls) is the same aggregation)
+        sorted_owner = (
+            dotted_name(node.args[0])
+            if callee == "sorted" and node.args else None
+        )
+        sorted_arg = sorted_owner is not None and \
+            sorted_owner in latency_lists
+        sort_method = (
+            callee.endswith(".sort")
+            and callee.rsplit(".", 1)[0] in latency_lists
+        )
+        if sorted_arg or sort_method:
+            which = (
+                sorted_owner if sorted_arg
+                else callee.rsplit(".", 1)[0]
+            )
+            return Finding(
+                "GL012", mod.path, node.lineno, fn.qualname,
+                f"hand-rolled latency aggregation: wall-clock deltas "
+                f"appended to '{which}' (line {append_lineno.get(which)}) "
+                "and then sorted for percentiles. Library code must "
+                "aggregate through gigapath_tpu.obs.metrics — a "
+                "Histogram (bounded memory, exact concurrent counts, "
+                "atomic snapshots) or the one shared percentile()",
+            )
+    return None
+
+
+@register(
+    "GL012",
+    "ad-hoc latency aggregation in library code: wall-clock deltas "
+    "appended to a list and sorted for percentiles by hand — use the typed "
+    "metrics registry (gigapath_tpu.obs.metrics Histogram / the shared "
+    "percentile) instead; scripts, tests, demos and obs/ itself exempt",
+)
+def check_latency_aggregation(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.modules.values():
+        segments = mod.path.split("/")[:-1]
+        if mod.is_test_file or any(
+            s in _GL012_EXEMPT_SEGMENTS for s in segments
+        ):
+            continue
+        if _GL012_SANCTIONED_SEGMENT in segments:
+            continue  # the aggregation layer may aggregate
+        for fn in mod.functions.values():
+            finding = _gl012_scan_function(mod, fn)
+            if finding is not None:
+                findings.append(finding)
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # GL010 — profiler trace hygiene
 # ---------------------------------------------------------------------------
 

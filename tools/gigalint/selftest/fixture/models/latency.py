@@ -1,0 +1,68 @@
+"""Seeded GL012 violations: hand-rolled latency aggregation in
+library-looking code (walls appended to a bare list, then sorted for a
+by-hand percentile), plus negative controls the rule must NOT flag."""
+
+import time
+
+
+def aggregate_latency_by_hand(step_fn):
+    """SEEDED GL012: perf_counter deltas -> list.append -> sort ->
+    manual nearest-rank index — the exact pattern obs/metrics.py
+    replaces."""
+    walls = []
+    for _ in range(8):
+        t0 = time.perf_counter()
+        step_fn()
+        walls.append(time.perf_counter() - t0)
+    walls.sort()
+    return walls[len(walls) // 2]
+
+
+def aggregate_latency_sorted_copy(step_fn):
+    """SEEDED GL012: same pattern through sorted() on a delta name."""
+    samples = []
+    t0 = time.perf_counter()
+    step_fn()
+    dur = time.perf_counter() - t0
+    samples.append(dur)
+    ordered = sorted(samples)
+    return ordered[-1]
+
+
+class LatencyStat:
+    """SEEDED GL012 (attribute-owned list): the serving-stats shape —
+    walls accumulated on self, percentiled via sorted(self...)."""
+
+    def __init__(self):
+        self._walls = []
+
+    def aggregate(self, step_fn):
+        t0 = time.perf_counter()
+        step_fn()
+        dur = time.perf_counter() - t0
+        self._walls.append(dur)
+        ordered = sorted(self._walls)
+        return ordered[int(0.99 * (len(ordered) - 1))]
+
+
+def negative_control_histogram_path(step_fn, histogram):
+    """Time-derived observation, but routed through the metrics
+    registry — no list, no sort, no finding."""
+    t0 = time.perf_counter()
+    step_fn()
+    histogram.observe(time.perf_counter() - t0)
+
+
+def negative_control_sort_without_timing(values):
+    """Sorting a non-latency list is just sorting."""
+    ordered = sorted(values)
+    return ordered[0]
+
+
+def negative_control_timing_without_sort(step_fn, sink):
+    """Appending walls somewhere without by-hand percentile math (e.g.
+    handing the raw series to an event sink) is not aggregation."""
+    t0 = time.perf_counter()
+    step_fn()
+    sink.append(time.perf_counter() - t0)
+    return sink

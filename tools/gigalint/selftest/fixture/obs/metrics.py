@@ -1,0 +1,17 @@
+"""GL012 negative control: the fixture tree's own obs/metrics.py twin.
+
+The sanctioned aggregation layer is exactly where sorted wall-clock
+lists are legitimate (the shared percentile implementation lives on
+one) — modules under an ``obs/`` segment are exempt by path."""
+
+import time
+
+
+def negative_control_sanctioned_aggregation(step_fn):
+    walls = []
+    for _ in range(4):
+        t0 = time.perf_counter()
+        step_fn()
+        walls.append(time.perf_counter() - t0)
+    walls.sort()
+    return walls[len(walls) // 2]
