@@ -1,0 +1,55 @@
+"""Disaggregated two-stage pipeline: the fault-tolerant cross-stage
+boundary (ROADMAP item 4's multichip-dryrun milestone).
+
+The production shape is two fleets at wildly different scales — a
+1.13B-param ViT-G tile encoder fanning out over 10^5-10^6 tiles per
+slide, streaming embeddings into a LongNet slide encoder (PAPER.md §0)
+— joined not by one monolithic program but by a *boundary* that must
+survive the failure modes a single pjit never sees: a dead tile worker,
+a straggler, a dropped or duplicated chunk, a consumer that falls
+behind.
+
+- :mod:`gigapath_tpu.dist.boundary` — the bounded, credit-based
+  embedding channel between the stages: per-chunk sequence numbers +
+  content checksums, producer blocks (and emits a schema'd
+  ``backpressure`` event) when consumer credits run out, consumer acks
+  chunks so unacked chunks are requeued on failure, duplicates and
+  out-of-order arrivals are deduped by seq;
+- :mod:`gigapath_tpu.dist.membership` — lease-based worker liveness
+  (heartbeat renew + expiry -> ``worker_lost`` anomaly) and elastic
+  degradation: a lost tile worker's unacked tile range is re-assigned
+  across survivors via the same deterministic chunk plan, so the slide
+  completes with bit-parity to the clean run;
+- :mod:`gigapath_tpu.dist.stagemesh` — per-stage mesh construction over
+  ``parallel/mesh.py``'s axes plus a declarative sharding-rule registry
+  (the ``match_partition_rules`` pattern) keyed per stage, consumed by
+  both fleets;
+- :mod:`gigapath_tpu.dist.worker` / :mod:`gigapath_tpu.dist.pipeline` —
+  the runnable dryrun harness: real tile-worker *processes* and the
+  slide-stage consumer, provable on one machine (two process groups on
+  CPU), chaos-injectable via the ``GIGAPATH_CHAOS`` ``kill_worker`` /
+  ``slow_worker`` / ``drop_chunk`` / ``dup_chunk`` injectors.
+
+Everything protocol-level (boundary, membership, the chunk plan) is
+numpy + stdlib only — no jax import — so a tile worker process starts
+in milliseconds and the transport can never retrace anything.
+``scripts/dist_smoke.py`` is the one-command two-process recovery
+checklist.
+"""
+
+from gigapath_tpu.dist.boundary import (  # noqa: F401
+    BoundaryConfig,
+    DirChannelConsumer,
+    DirChannelProducer,
+    EmbeddingChunk,
+    MemoryChannel,
+    SlideAssembler,
+    assign_chunks,
+    chunk_checksum,
+    plan_chunks,
+)
+from gigapath_tpu.dist.membership import (  # noqa: F401
+    Membership,
+    WorkerLease,
+    write_reassignment,
+)

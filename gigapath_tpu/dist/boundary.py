@@ -1,0 +1,590 @@
+"""The cross-stage embedding channel: bounded, credit-based, checksummed.
+
+One slide's tile embeddings flow from the tile-encoder stage to the
+slide-encoder stage as *chunks* — contiguous tile ranges cut by the
+deterministic :func:`plan_chunks` plan. The channel gives that flow the
+four properties a cross-host boundary needs and a monolithic pjit gets
+for free:
+
+- **bounded**: the producer holds at most ``capacity`` unacked chunks in
+  flight (credit-based flow control). When credits hit zero the producer
+  BLOCKS — and emits one schema'd ``backpressure`` event per blocking
+  episode (queue depth, credits, capacity) so a consumer falling behind
+  is visible on the obs bus, not an OOM an hour later;
+- **checksummed**: every chunk carries a sha256 over its header and
+  payload bytes; a corrupt arrival is counted and discarded (the
+  producer-side retransmit timer heals it), never assembled;
+- **acked**: the consumer acks each delivered seq; producer credits are
+  acked-based, so unacked chunks are exactly the set a recovery has to
+  requeue (:mod:`gigapath_tpu.dist.membership` re-assigns a lost
+  worker's unacked range across survivors);
+- **deduped**: sequence numbers are the chunk ids of the deterministic
+  plan — stable across retransmits AND across re-assignment — so a
+  duplicate (a ``dup_chunk`` injection, a retransmit racing its ack, a
+  survivor re-producing a chunk the dead worker's last write also
+  landed) is dropped by seq and the assembled slide is bit-identical to
+  the clean run's.
+
+Two transports, one protocol: :class:`MemoryChannel` (in-process,
+``threading.Condition`` — the serving/inference prefetch path and the
+unit tests) and the :class:`DirChannelProducer`/:class:`DirChannelConsumer`
+pair (a shared directory with atomic tmp+rename writes — the two-process
+dryrun harness; DCN/RPC transports slot in behind the same surface).
+numpy + stdlib only; nothing here can touch a traced program, so the
+channel can add no retraces (pinned by tests/test_dist.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import hashlib
+import os
+import threading
+import time
+import zipfile
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from gigapath_tpu.obs.runlog import env_number
+
+
+@dataclasses.dataclass(frozen=True)
+class BoundaryConfig:
+    """Channel knobs, snapshotted host-side at construction.
+
+    ``from_env`` reads the ``GIGAPATH_DIST_*`` flags ONCE (the
+    ``get_run_log`` discipline — never at trace time; README flag
+    table)."""
+
+    capacity: int = 8          # credits: max unacked chunks in flight
+    chunk_tiles: int = 512     # tiles per chunk in the deterministic plan
+    poll_s: float = 0.02       # producer block / consumer scan cadence
+    retransmit_s: float = 2.0  # unacked-for-longer gets re-sent
+
+    @classmethod
+    def from_env(cls, **overrides) -> "BoundaryConfig":
+        fields = dict(
+            capacity=int(env_number("GIGAPATH_DIST_CREDITS", cls.capacity)),
+            chunk_tiles=int(env_number("GIGAPATH_DIST_CHUNK_TILES",
+                                       cls.chunk_tiles)),
+            poll_s=env_number("GIGAPATH_DIST_POLL_S", cls.poll_s),
+            retransmit_s=env_number("GIGAPATH_DIST_RETRANSMIT_S",
+                                    cls.retransmit_s),
+        )
+        fields.update({k: v for k, v in overrides.items() if v is not None})
+        if fields["capacity"] < 1:
+            raise ValueError(f"capacity must be >= 1, got {fields['capacity']}")
+        return cls(**fields)
+
+
+# ---------------------------------------------------------------------------
+# the deterministic chunk plan
+# ---------------------------------------------------------------------------
+
+def plan_chunks(n_tiles: int, chunk_tiles: int) -> List[Tuple[int, int, int]]:
+    """``[(chunk_id, start, stop), ...]`` covering ``[0, n_tiles)`` in
+    order. Chunk ids double as the channel's sequence numbers: they are
+    a pure function of the slide geometry, so a survivor re-producing a
+    lost worker's chunk emits the SAME seq the original would have —
+    dedup and bit-parity both hang off this determinism."""
+    if n_tiles < 1 or chunk_tiles < 1:
+        raise ValueError(f"need n_tiles/chunk_tiles >= 1, got "
+                         f"{n_tiles}/{chunk_tiles}")
+    return [
+        (cid, start, min(start + chunk_tiles, n_tiles))
+        for cid, start in enumerate(range(0, n_tiles, chunk_tiles))
+    ]
+
+
+def assign_chunks(chunk_ids: Sequence[int],
+                  workers: Sequence[str]) -> Dict[str, List[int]]:
+    """Deterministic round-robin of chunk ids over SORTED worker ids —
+    the one assignment function, used both for the initial shard and for
+    re-assigning a lost worker's unacked chunks across survivors (same
+    inputs -> same plan on every host, no coordination round needed)."""
+    if not workers:
+        raise ValueError("assign_chunks: no workers")
+    ordered = sorted(workers)
+    out: Dict[str, List[int]] = {w: [] for w in ordered}
+    for i, cid in enumerate(sorted(chunk_ids)):
+        out[ordered[i % len(ordered)]].append(cid)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# chunks
+# ---------------------------------------------------------------------------
+
+def chunk_checksum(slide_id: str, chunk_id: int, start: int, stop: int,
+                   payload: np.ndarray,
+                   coords: Optional[np.ndarray]) -> str:
+    """sha256 over the header and the exact payload bytes. The header is
+    inside the digest so a chunk whose payload survived but whose tile
+    range was mangled still fails verification."""
+    h = hashlib.sha256()
+    h.update(f"{slide_id}|{chunk_id}|{start}|{stop}|".encode())
+    h.update(np.ascontiguousarray(payload).tobytes())
+    if coords is not None:
+        h.update(np.ascontiguousarray(coords).tobytes())
+    return h.hexdigest()
+
+
+@dataclasses.dataclass
+class EmbeddingChunk:
+    """One contiguous tile range of one slide's embeddings in flight.
+
+    ``seq == chunk_id`` (see :func:`plan_chunks`); ``producer`` is
+    provenance for the report, never protocol state."""
+
+    slide_id: str
+    chunk_id: int
+    start: int
+    stop: int
+    payload: np.ndarray                    # [stop-start, D] float32
+    coords: Optional[np.ndarray] = None    # [stop-start, 2] float32
+    producer: str = ""
+    checksum: str = ""
+
+    @property
+    def seq(self) -> int:
+        return self.chunk_id
+
+    @classmethod
+    def build(cls, slide_id: str, chunk_id: int, start: int, stop: int,
+              payload: np.ndarray, coords: Optional[np.ndarray] = None,
+              producer: str = "", digest: bool = True) -> "EmbeddingChunk":
+        """``digest=False`` skips the sha256 (checksum stays ``""``) —
+        ONLY for intra-process channels, where the handoff is a memory
+        reference that cannot corrupt and hashing hundreds of MB per
+        slide would tax the hot path for nothing. Cross-process
+        transports must digest: the directory consumer rejects an
+        empty checksum outright."""
+        payload = np.asarray(payload, np.float32)
+        if coords is not None:
+            coords = np.asarray(coords, np.float32)
+        if payload.shape[0] != stop - start:
+            raise ValueError(
+                f"chunk {chunk_id}: payload rows {payload.shape[0]} != "
+                f"tile range [{start}, {stop})"
+            )
+        return cls(
+            slide_id=slide_id, chunk_id=int(chunk_id), start=int(start),
+            stop=int(stop), payload=payload, coords=coords,
+            producer=producer,
+            checksum=chunk_checksum(slide_id, chunk_id, start, stop,
+                                    payload, coords) if digest else "",
+        )
+
+    def verify(self) -> bool:
+        return self.checksum == chunk_checksum(
+            self.slide_id, self.chunk_id, self.start, self.stop,
+            self.payload, self.coords,
+        )
+
+
+@dataclasses.dataclass
+class ChannelStats:
+    """Host-side protocol counters, rendered by ``obs_report.py``'s
+    ``== dist ==`` section and asserted by the smoke/tests."""
+
+    sent: int = 0
+    delivered: int = 0
+    acked: int = 0
+    duplicates: int = 0      # arrivals dropped by seq dedup
+    corrupt: int = 0         # arrivals failing checksum verification
+    retransmits: int = 0     # unacked chunks re-sent after the timer
+    dropped: int = 0         # sends swallowed by chaos injection
+    backpressure_events: int = 0
+    blocked_s: float = 0.0   # total producer wall spent credit-blocked
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _emit_backpressure(runlog, *, channel: str, seq: int, queue_depth: int,
+                       capacity: int) -> None:
+    """One schema'd ``backpressure`` event per producer blocking episode
+    (runlog optional — bare-channel users stay silent)."""
+    if runlog is not None:
+        runlog.event(
+            "backpressure", channel=channel, seq=seq, credits=0,
+            queue_depth=queue_depth, capacity=capacity,
+        )
+
+
+# ---------------------------------------------------------------------------
+# in-process transport (threads)
+# ---------------------------------------------------------------------------
+
+class MemoryChannel:
+    """Intra-process producer/consumer pair over one bounded buffer.
+
+    The transport behind the inference prefetch wiring and the
+    backpressure unit tests: ``send`` blocks while ``capacity`` chunks
+    are unacked, ``recv`` dedups by seq, ``ack`` returns the credit.
+    """
+
+    def __init__(self, config: Optional[BoundaryConfig] = None, *,
+                 runlog=None, name: str = "memory"):
+        self.cfg = config or BoundaryConfig()
+        self.name = name
+        self._runlog = runlog
+        self.stats = ChannelStats()
+        self._cond = threading.Condition()
+        self._queue: List[EmbeddingChunk] = []
+        self._unacked: Dict[int, EmbeddingChunk] = {}
+        self._delivered: set = set()
+        self._closed = False
+        self._episode_seq: Optional[int] = None  # backpressure dedup
+
+    # -- producer side ----------------------------------------------------
+    def send(self, chunk: EmbeddingChunk,
+             timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            blocked_at = None
+            while len(self._unacked) >= self.cfg.capacity and not self._closed:
+                if blocked_at is None:
+                    blocked_at = time.monotonic()
+                    if self._episode_seq != chunk.seq:
+                        # one event per blocking EPISODE: a caller
+                        # retrying a timed-out send of the same seq is
+                        # the same episode, not a new one
+                        self._episode_seq = chunk.seq
+                        self.stats.backpressure_events += 1
+                        _emit_backpressure(
+                            self._runlog, channel=self.name, seq=chunk.seq,
+                            queue_depth=len(self._unacked),
+                            capacity=self.cfg.capacity,
+                        )
+                wait = self.cfg.poll_s
+                if deadline is not None:
+                    wait = min(wait, deadline - time.monotonic())
+                    if wait <= 0:
+                        self.stats.blocked_s += time.monotonic() - blocked_at
+                        raise TimeoutError(
+                            f"{self.name}: no credit within {timeout}s "
+                            f"(seq {chunk.seq})"
+                        )
+                self._cond.wait(timeout=wait)
+            if blocked_at is not None:
+                self.stats.blocked_s += time.monotonic() - blocked_at
+            if self._closed:
+                raise RuntimeError(f"{self.name}: channel closed")
+            self._unacked[chunk.seq] = chunk
+            self._queue.append(chunk)
+            self.stats.sent += 1
+            self._cond.notify_all()
+
+    def unacked_seqs(self) -> List[int]:
+        with self._cond:
+            return sorted(self._unacked)
+
+    # -- consumer side ----------------------------------------------------
+    def recv(self, timeout: Optional[float] = None) -> Optional[EmbeddingChunk]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                while self._queue:
+                    chunk = self._queue.pop(0)
+                    if chunk.seq in self._delivered:
+                        self.stats.duplicates += 1
+                        continue
+                    # digest-less chunks (build(digest=False)) are the
+                    # sanctioned intra-process fast path: the handoff
+                    # is a memory reference, there is nothing to verify
+                    if chunk.checksum and not chunk.verify():
+                        self.stats.corrupt += 1
+                        continue
+                    self._delivered.add(chunk.seq)
+                    self.stats.delivered += 1
+                    return chunk
+                if self._closed:
+                    return None
+                wait = self.cfg.poll_s
+                if deadline is not None:
+                    wait = min(wait, deadline - time.monotonic())
+                    if wait <= 0:
+                        return None
+                self._cond.wait(timeout=wait)
+
+    def ack(self, seq: int) -> None:
+        with self._cond:
+            if self._unacked.pop(seq, None) is not None:
+                self.stats.acked += 1
+                self._cond.notify_all()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+
+# ---------------------------------------------------------------------------
+# cross-process transport (shared directory)
+# ---------------------------------------------------------------------------
+#
+# Layout under <root>/channel/:
+#   chunk-<seq:06d>-<nonce>.npz   one send (atomic tmp+rename; the nonce
+#                                 keeps retransmits/dups from colliding)
+#   ack-<seq:06d>                 consumer ack marker (empty file)
+#
+# The producer's credit view is acked-based (a chunk file it wrote whose
+# ack marker exists frees its credit); the consumer's dedup view is an
+# in-memory seq set. Atomic renames mean a reader never sees a partial
+# chunk; SIGKILL mid-write leaves only a tmp file nobody scans.
+
+def _atomic_write_npz(path: str, **arrays) -> None:
+    tmp = f"{path}.tmp-{os.getpid()}-{threading.get_ident()}"
+    with open(tmp, "wb") as fh:
+        np.savez(fh, **arrays)
+    os.replace(tmp, path)
+
+
+def atomic_touch(path: str) -> str:
+    """Atomically materialize an empty marker file (ack markers, the
+    pipeline's DONE flag): tmp + rename, so a scanner never races a
+    half-created entry."""
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8"):
+        pass
+    os.replace(tmp, path)
+    return path
+
+
+class DirChannelProducer:
+    """One tile worker's sending half of the directory channel."""
+
+    def __init__(self, root: str, config: Optional[BoundaryConfig] = None, *,
+                 producer: str = "", runlog=None, chaos=None,
+                 name: str = "dir"):
+        self.cfg = config or BoundaryConfig()
+        self.dir = os.path.join(root, "channel")
+        os.makedirs(self.dir, exist_ok=True)
+        self.producer = producer
+        self.name = name
+        self._runlog = runlog
+        self._chaos = chaos
+        self.stats = ChannelStats()
+        self._sent_at: Dict[int, float] = {}      # seq -> last send time
+        self._chunks: Dict[int, EmbeddingChunk] = {}  # unacked payloads
+        self._nonce = 0
+        self._episode_seq: Optional[int] = None   # backpressure dedup
+
+    # -- protocol ---------------------------------------------------------
+    def _ack_path(self, seq: int) -> str:
+        return os.path.join(self.dir, f"ack-{seq:06d}")
+
+    def _refresh_acks(self) -> None:
+        for seq in list(self._sent_at):
+            if os.path.exists(self._ack_path(seq)):
+                self._sent_at.pop(seq, None)
+                self._chunks.pop(seq, None)
+                self.stats.acked += 1
+
+    def _write(self, chunk: EmbeddingChunk) -> None:
+        self._nonce += 1
+        path = os.path.join(
+            self.dir,
+            f"chunk-{chunk.seq:06d}-{self.producer or 'p'}-{self._nonce}.npz",
+        )
+        arrays = dict(
+            slide_id=np.array(chunk.slide_id),
+            chunk_id=np.array(chunk.chunk_id, np.int64),
+            start=np.array(chunk.start, np.int64),
+            stop=np.array(chunk.stop, np.int64),
+            payload=chunk.payload,
+            producer=np.array(chunk.producer or self.producer),
+            checksum=np.array(chunk.checksum),
+        )
+        if chunk.coords is not None:
+            arrays["coords"] = chunk.coords
+        _atomic_write_npz(path, **arrays)
+
+    def credits(self) -> int:
+        self._refresh_acks()
+        return max(self.cfg.capacity - len(self._sent_at), 0)
+
+    def unacked_seqs(self) -> List[int]:
+        self._refresh_acks()
+        return sorted(self._sent_at)
+
+    def send(self, chunk: EmbeddingChunk,
+             timeout: Optional[float] = None) -> None:
+        """Blocks (polling) while every credit is in flight; the chaos
+        injectors hook here — a ``drop_chunk`` swallows THIS write but
+        still registers the seq as sent-unacked (the retransmit timer
+        heals it, exactly like a lost network write), a ``dup_chunk``
+        writes twice (the consumer's dedup absorbs the twin)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        blocked_at = None
+        while self.credits() <= 0:
+            if blocked_at is None:
+                blocked_at = time.monotonic()
+                if self._episode_seq != chunk.seq:
+                    # one event per blocking episode, even when the
+                    # caller retries a timed-out send of the same seq
+                    # (the worker's lease-renewing retry loop does)
+                    self._episode_seq = chunk.seq
+                    self.stats.backpressure_events += 1
+                    _emit_backpressure(
+                        self._runlog, channel=self.name, seq=chunk.seq,
+                        queue_depth=len(self._sent_at),
+                        capacity=self.cfg.capacity,
+                    )
+            if deadline is not None and time.monotonic() >= deadline:
+                self.stats.blocked_s += time.monotonic() - blocked_at
+                raise TimeoutError(
+                    f"{self.name}: no credit within {timeout}s "
+                    f"(seq {chunk.seq})"
+                )
+            time.sleep(self.cfg.poll_s)
+        if blocked_at is not None:
+            self.stats.blocked_s += time.monotonic() - blocked_at
+        self._sent_at[chunk.seq] = time.monotonic()
+        self._chunks[chunk.seq] = chunk
+        self.stats.sent += 1
+        if self._chaos is not None and self._chaos.drops_chunk(chunk.seq):
+            self.stats.dropped += 1
+            return
+        self._write(chunk)
+        if self._chaos is not None and self._chaos.dups_chunk(chunk.seq):
+            self._write(chunk)
+
+    def pump_retransmits(self, now: Optional[float] = None) -> int:
+        """Re-send every chunk unacked for longer than ``retransmit_s``.
+        Returns the number re-sent. Safe against duplicates: seqs dedup
+        at the consumer."""
+        now = time.monotonic() if now is None else now
+        self._refresh_acks()
+        n = 0
+        for seq, sent_at in list(self._sent_at.items()):
+            if now - sent_at >= self.cfg.retransmit_s:
+                chunk = self._chunks.get(seq)
+                if chunk is None:
+                    continue
+                self._write(chunk)
+                self._sent_at[seq] = now
+                self.stats.retransmits += 1
+                n += 1
+        return n
+
+
+class DirChannelConsumer:
+    """The slide stage's receiving half of the directory channel (one
+    consumer drains every producer's chunks — the fan-in point)."""
+
+    def __init__(self, root: str, config: Optional[BoundaryConfig] = None, *,
+                 runlog=None, name: str = "dir"):
+        self.cfg = config or BoundaryConfig()
+        self.dir = os.path.join(root, "channel")
+        os.makedirs(self.dir, exist_ok=True)
+        self.name = name
+        self._runlog = runlog
+        self.stats = ChannelStats()
+        self._delivered: set = set()
+
+    def _load(self, path: str) -> Optional[EmbeddingChunk]:
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                coords = z["coords"] if "coords" in z.files else None
+                return EmbeddingChunk(
+                    slide_id=str(z["slide_id"]),
+                    chunk_id=int(z["chunk_id"]), start=int(z["start"]),
+                    stop=int(z["stop"]), payload=np.asarray(z["payload"]),
+                    coords=None if coords is None else np.asarray(coords),
+                    producer=str(z["producer"]),
+                    checksum=str(z["checksum"]),
+                )
+        except (OSError, ValueError, KeyError, zipfile.BadZipFile):
+            # a torn archive can only be a racing writer's tmp that
+            # slipped in; re-scan next poll, never delete blind
+            return None
+
+    def recv(self, timeout: Optional[float] = None) -> Optional[EmbeddingChunk]:
+        """Next new, verified chunk (any producer), or None on timeout.
+        Processed files are deleted; duplicate seqs and corrupt payloads
+        are counted and dropped."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            for path in sorted(glob.glob(os.path.join(self.dir, "chunk-*.npz"))):
+                name = os.path.basename(path)
+                try:
+                    seq = int(name.split("-")[1])
+                except (IndexError, ValueError):
+                    continue
+                if seq in self._delivered:
+                    self.stats.duplicates += 1
+                    _unlink_quiet(path)
+                    continue
+                chunk = self._load(path)
+                if chunk is None:
+                    continue
+                _unlink_quiet(path)
+                if not chunk.verify():
+                    self.stats.corrupt += 1
+                    continue
+                self._delivered.add(seq)
+                self.stats.delivered += 1
+                return chunk
+            if deadline is not None and time.monotonic() >= deadline:
+                return None
+            time.sleep(self.cfg.poll_s)
+
+    def ack(self, seq: int) -> None:
+        atomic_touch(os.path.join(self.dir, f"ack-{seq:06d}"))
+        self.stats.acked += 1
+
+
+def _unlink_quiet(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# assembly
+# ---------------------------------------------------------------------------
+
+class SlideAssembler:
+    """Chunks -> the dense ``[n_tiles, D]`` tile-embedding sequence.
+
+    Placement is by the chunk's tile range, so arrival order and the
+    identity of the producing worker are irrelevant to the assembled
+    bytes — the bit-parity half of the recovery contract."""
+
+    def __init__(self, n_tiles: int, dim: int, *, coords_dim: int = 2):
+        self.n_tiles = int(n_tiles)
+        self.embeds = np.zeros((n_tiles, dim), np.float32)
+        self.coords = np.zeros((n_tiles, coords_dim), np.float32)
+        self._have: set = set()
+        self._expected: Optional[set] = None
+
+    def expect(self, chunk_ids: Sequence[int]) -> None:
+        self._expected = set(int(c) for c in chunk_ids)
+
+    def add(self, chunk: EmbeddingChunk) -> bool:
+        """Place one chunk; returns False for a chunk id already placed
+        (belt under the channel's dedup suspenders)."""
+        if chunk.chunk_id in self._have:
+            return False
+        self.embeds[chunk.start:chunk.stop] = chunk.payload
+        if chunk.coords is not None:
+            self.coords[chunk.start:chunk.stop] = chunk.coords
+        self._have.add(chunk.chunk_id)
+        return True
+
+    @property
+    def received(self) -> set:
+        return set(self._have)
+
+    def missing(self) -> List[int]:
+        if self._expected is None:
+            return []
+        return sorted(self._expected - self._have)
+
+    def complete(self) -> bool:
+        return self._expected is not None and not self.missing()

@@ -1,0 +1,285 @@
+"""The slide-stage consumer + the two-process-group dryrun orchestrator.
+
+:func:`run_slide_consumer` is the receiving fleet's loop: drain the
+boundary channel, ack + assemble each chunk, poll worker leases, and on
+a loss re-assign the dead worker's unacked chunk ids across survivors
+(the elastic-degradation half of the recovery contract). When the plan's
+every chunk is assembled it runs the slide-encoder forward over the
+dense ``[n_tiles, D]`` sequence — jitted once, watched for retraces —
+and publishes DONE so the workers drain out.
+
+:func:`run_disaggregated` is the one-call dryrun: write the plan, spawn
+one OS process per tile worker (``python -m gigapath_tpu.dist.worker``,
+optionally with per-worker ``GIGAPATH_CHAOS`` — that is how the
+acceptance kills exactly one), run the consumer in the calling process,
+join the fleet. All processes share a ``GIGAPATH_OBS_RUN_ID`` so their
+per-process JSONL files merge in ``scripts/obs_report.py`` (worker span
+ranks feed the per-rank straggler table).
+
+Bit-parity invariant (the acceptance): the assembled sequence is a pure
+function of the plan — chunk ids, tile ranges and the deterministic
+encoder never depend on which worker produced what — so a run that
+loses a worker mid-slide yields the clean run's slide embedding
+BIT-exact, with the recovery visible as ``worker_lost`` +
+``recovery action="reassign"`` events rather than as different numbers.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from gigapath_tpu.dist.boundary import (
+    BoundaryConfig,
+    DirChannelConsumer,
+    SlideAssembler,
+    assign_chunks,
+    atomic_touch,
+    plan_chunks,
+)
+from gigapath_tpu.dist.membership import Membership, write_reassignment
+from gigapath_tpu.dist.worker import DONE_MARKER, load_plan, write_plan
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def default_plan(*, slide_id: str = "slide0", n_tiles: int = 64,
+                 dim_in: int = 16, dim_out: int = 8, chunk_tiles: int = 8,
+                 workers: Optional[List[str]] = None, tile_seed: int = 0,
+                 encoder_seed: int = 7, lease_s: float = 1.0,
+                 credits: int = 4, retransmit_s: float = 0.5,
+                 poll_s: float = 0.02) -> dict:
+    """The dryrun's plan document (written to ``<root>/plan.json``,
+    read by every process — the shared deterministic truth)."""
+    return dict(
+        slide_id=slide_id, n_tiles=int(n_tiles), dim_in=int(dim_in),
+        dim_out=int(dim_out), chunk_tiles=int(chunk_tiles),
+        workers=sorted(workers or ["w0", "w1"]), tile_seed=int(tile_seed),
+        encoder_seed=int(encoder_seed), lease_s=float(lease_s),
+        credits=int(credits), retransmit_s=float(retransmit_s),
+        poll_s=float(poll_s),
+    )
+
+
+def _default_forward():
+    """The dryrun slide stage: the tiny slide encoder + classifier head
+    (the same arch the chaos/serve smokes pin), jitted once per shape,
+    with params placed through the ``slide_encoder`` entry of the
+    stage-sharding registry (a 1-device stage mesh here, so every rule
+    degrades to replicated — the dryrun consumes the same declarative
+    path a sharded fleet does, without changing a single byte)."""
+    import jax
+
+    from gigapath_tpu.dist.stagemesh import stage_mesh, stage_param_shardings
+    from gigapath_tpu.models.classification_head import get_model
+
+    def build(dim_in: int):
+        model, params = get_model(
+            input_dim=dim_in, latent_dim=32, feat_layer="1", n_classes=2,
+            model_arch="gigapath_slide_enc_tiny", dtype=None,
+        )
+        mesh = stage_mesh("slide_encoder", devices=jax.devices()[:1])
+        params = jax.device_put(
+            params, stage_param_shardings("slide_encoder", params, mesh)
+        )
+
+        def forward(p, embeds, coords):
+            return model.apply({"params": p}, embeds, coords,
+                               deterministic=True)
+
+        return jax.jit(forward), params
+
+    return build
+
+
+def run_slide_consumer(root: str, *, runlog=None,
+                       forward_builder: Optional[Callable] = None,
+                       deadline_s: float = 120.0,
+                       worker_probe: Optional[Callable] = None) -> dict:
+    """Assemble one slide from the channel, recovering from worker loss.
+
+    ``worker_probe`` (optional): zero-arg callable returning
+    ``{worker_id: exit_code_or_None}`` for workers whose OS processes
+    this host can see — direct evidence of death that beats waiting out
+    the lease, and the ONLY detection for a worker that died before its
+    first lease registration (no lease file ever existed for the expiry
+    path to notice). Cross-host consumers pass nothing and rely on
+    leases alone.
+
+    Returns ``{"embedding", "assembled", "coords", "stats", "lost",
+    "reassignments"}``; raises TimeoutError when the slide cannot
+    complete within ``deadline_s`` (no silent partial slides)."""
+    from gigapath_tpu.obs.runlog import get_run_log
+    from gigapath_tpu.obs.watchdog import CompileWatchdog
+
+    plan = load_plan(root)
+    cfg = BoundaryConfig.from_env(
+        capacity=plan.get("credits"), chunk_tiles=plan.get("chunk_tiles"),
+        retransmit_s=plan.get("retransmit_s"), poll_s=plan.get("poll_s"),
+    )
+    own_log = runlog is None
+    if own_log:
+        runlog = get_run_log(
+            "dist-consumer", out_dir=root,
+            config={"slide": plan["slide_id"], "n_tiles": plan["n_tiles"],
+                    "workers": plan["workers"],
+                    "chunk_tiles": cfg.chunk_tiles},
+        )
+    consumer = DirChannelConsumer(root, cfg, runlog=runlog)
+    membership = Membership(root, runlog=runlog)
+    chunks = plan_chunks(int(plan["n_tiles"]), cfg.chunk_tiles)
+    assembler = SlideAssembler(int(plan["n_tiles"]), int(plan["dim_out"]))
+    assembler.expect([c[0] for c in chunks])
+
+    # who currently owns which chunk (updated by reassignments): the
+    # coordinator's view of the SAME deterministic assignment the
+    # workers computed for themselves
+    owners: Dict[str, set] = {
+        w: set(cids)
+        for w, cids in assign_chunks([c[0] for c in chunks],
+                                     plan["workers"]).items()
+    }
+    reassignments = 0
+    deadline = time.monotonic() + deadline_s
+    status = "ok"
+    try:
+        while not assembler.complete():
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"slide '{plan['slide_id']}' incomplete after "
+                    f"{deadline_s}s: missing chunks {assembler.missing()}"
+                )
+            newly_lost = membership.poll_lost()
+            if worker_probe is not None:
+                for w, rc in worker_probe().items():
+                    if rc is None or rc == 0:
+                        continue  # still running / clean exit
+                    if membership.report_lost(
+                        w, reason="process_exit", stage="tile",
+                        exit_code=rc,
+                    ):
+                        newly_lost.append(w)
+            for lost in newly_lost:
+                pending = sorted(
+                    owners.get(lost, set()) - assembler.received
+                )
+                owners.pop(lost, None)
+                survivors = [w for w in plan["workers"]
+                             if w not in membership.lost()]
+                if not pending:
+                    continue
+                if not survivors:
+                    raise RuntimeError(
+                        f"worker {lost} died holding chunks {pending} "
+                        "and no survivors remain"
+                    )
+                new_owners = assign_chunks(pending, survivors)
+                for w, cids in new_owners.items():
+                    owners.setdefault(w, set()).update(cids)
+                write_reassignment(root, lost_worker=lost,
+                                   assignments=new_owners, runlog=runlog)
+                reassignments += 1
+            chunk = consumer.recv(timeout=cfg.poll_s * 5)
+            if chunk is None:
+                continue
+            consumer.ack(chunk.seq)
+            assembler.add(chunk)
+
+        # the slide forward: jitted once, retraces watched — recovery
+        # must never show up as a recompile
+        build = forward_builder or _default_forward()
+        forward, params = build(int(plan["dim_out"]))
+        watchdog = CompileWatchdog("dist.slide_forward", runlog)
+        instrumented = watchdog.wrap(forward)
+        embedding = np.asarray(
+            instrumented(params, assembler.embeds[None],
+                         assembler.coords[None]),
+            np.float32,
+        )[0]
+    except BaseException:
+        status = "error"
+        raise
+    finally:
+        # DONE even on failure: stranded workers must drain, not spin
+        # out their whole deadline
+        atomic_touch(os.path.join(root, DONE_MARKER))
+        if own_log:
+            runlog.run_end(
+                status=status, slide=plan["slide_id"],
+                lost=membership.lost(), reassignments=reassignments,
+                **consumer.stats.as_dict(),
+            )
+    return {
+        "embedding": embedding,
+        "assembled": assembler.embeds,
+        "coords": assembler.coords,
+        "stats": consumer.stats.as_dict(),
+        "lost": membership.lost(),
+        "reassignments": reassignments,
+    }
+
+
+def spawn_worker(root: str, worker_id: str, *,
+                 chaos: Optional[str] = None, run_id: Optional[str] = None,
+                 deadline_s: float = 120.0) -> subprocess.Popen:
+    """One tile-worker OS process. ``chaos`` lands in THAT worker's
+    ``GIGAPATH_CHAOS`` only — how the acceptance kills/slows exactly
+    one member of the fleet."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("GIGAPATH_CHAOS", None)
+    if chaos:
+        env["GIGAPATH_CHAOS"] = chaos
+    if run_id:
+        env["GIGAPATH_OBS_RUN_ID"] = run_id
+    return subprocess.Popen(
+        [sys.executable, "-m", "gigapath_tpu.dist.worker",
+         "--root", root, "--worker", worker_id,
+         "--deadline-s", str(deadline_s)],
+        env=env, cwd=REPO_ROOT,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def run_disaggregated(root: str, *, plan: Optional[dict] = None,
+                      worker_chaos: Optional[Dict[str, str]] = None,
+                      runlog=None, deadline_s: float = 120.0,
+                      run_id: Optional[str] = None) -> dict:
+    """The dryrun: plan -> worker fleet (real processes) -> consumer.
+
+    ``worker_chaos`` maps worker id -> ``GIGAPATH_CHAOS`` spec for that
+    worker's process. Returns the consumer result plus worker exit
+    codes."""
+    plan = plan or default_plan()
+    write_plan(root, plan)
+    worker_chaos = worker_chaos or {}
+    procs = {
+        w: spawn_worker(root, w, chaos=worker_chaos.get(w), run_id=run_id,
+                        deadline_s=deadline_s)
+        for w in plan["workers"]
+    }
+    try:
+        result = run_slide_consumer(
+            root, runlog=runlog, deadline_s=deadline_s,
+            # the orchestrator holds the process handles: report a
+            # nonzero exit the moment it happens instead of waiting out
+            # the lease (and catch workers that died before their first
+            # lease registration)
+            worker_probe=lambda: {w: p.poll() for w, p in procs.items()},
+        )
+    finally:
+        exit_codes: Dict[str, Optional[int]] = {}
+        for w, proc in procs.items():
+            try:
+                exit_codes[w] = proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                exit_codes[w] = proc.wait()
+    result["worker_exit_codes"] = exit_codes
+    return result

@@ -1,0 +1,250 @@
+"""The tile-encoder worker process of the disaggregated dryrun.
+
+One worker = one OS process (``python -m gigapath_tpu.dist.worker``)
+holding a lease, producing its assigned chunks of one slide's tile
+embeddings through the directory boundary channel, and polling for
+ranges re-assigned to it when a peer dies. The loop per iteration:
+
+1. renew the lease (a dead worker is one that stops doing this);
+2. produce the next pending chunk: load the chunk's tiles (the dryrun's
+   deterministic synthetic loader — any worker can load any tile range,
+   exactly like the production feature store), encode, ``send`` (which
+   blocks on credits — backpressure propagates into this loop, never
+   into unbounded memory);
+3. pump retransmits for unacked chunks older than the timer;
+4. pick up chunks re-assigned to this worker by the coordinator;
+5. exit when the consumer publishes DONE (or the deadline passes).
+
+Chaos (``GIGAPATH_CHAOS``, parsed ONCE host-side at worker start like
+every injector): ``kill_worker@K`` hard-kills THIS worker (SIGKILL — no
+goodbye, the lease just stops renewing) after K produced chunks;
+``slow_worker@K[:S]`` sleeps S seconds before producing chunk K
+(``K='*'`` = every chunk — the straggler whose skew the per-rank span
+table must surface); ``drop_chunk@K`` / ``dup_chunk@K`` act inside the
+channel's send.
+
+The dryrun encoder is numpy (a fixed seeded projection + tanh): bitwise
+deterministic across processes, imports in milliseconds, and keeps the
+protocol layer provably free of traced code. The real ViT-G tile
+encoder drops in behind the same ``encode(feats) -> embeds`` surface
+(quantized per ROADMAP item 3), sharded per the ``tile_encoder`` entry
+of :mod:`gigapath_tpu.dist.stagemesh`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from gigapath_tpu.dist.boundary import (
+    BoundaryConfig,
+    DirChannelProducer,
+    EmbeddingChunk,
+    assign_chunks,
+    plan_chunks,
+)
+from gigapath_tpu.dist.membership import (
+    WorkerLease,
+    atomic_write_json,
+    reassignments_for,
+)
+from gigapath_tpu.resilience.chaos import get_chaos
+
+DONE_MARKER = "DONE"
+
+
+def load_plan(root: str) -> dict:
+    with open(os.path.join(root, "plan.json"), encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def write_plan(root: str, plan: dict) -> str:
+    os.makedirs(root, exist_ok=True)
+    return atomic_write_json(os.path.join(root, "plan.json"), plan,
+                             indent=1, sort_keys=True)
+
+
+# ---------------------------------------------------------------------------
+# the dryrun's deterministic tile loader + encoder
+# ---------------------------------------------------------------------------
+
+def chunk_tiles(plan: dict, start: int, stop: int):
+    """Synthetic tile features + coords for one tile range, a pure
+    function of (tile_seed, tile index) — the dryrun twin of a feature
+    store any worker can read any range from."""
+    rng = np.random.default_rng([int(plan["tile_seed"]), int(start)])
+    n = stop - start
+    feats = rng.standard_normal((n, int(plan["dim_in"])),
+                                dtype=np.float32)
+    coords = rng.uniform(0, 25000, (n, 2)).astype(np.float32)
+    return feats, coords
+
+
+def encoder_weights(plan: dict) -> np.ndarray:
+    rng = np.random.default_rng(int(plan["encoder_seed"]))
+    w = rng.standard_normal((int(plan["dim_in"]), int(plan["dim_out"])),
+                            dtype=np.float32)
+    return w / np.sqrt(np.float32(plan["dim_in"]))
+
+
+def encode_chunk(plan: dict, weights: np.ndarray, start: int, stop: int):
+    """feats [n, Din] -> embeds [n, Dout], bitwise-deterministic given
+    the plan (same numpy, same machine — the dryrun's parity anchor)."""
+    feats, coords = chunk_tiles(plan, start, stop)
+    return np.tanh(feats @ weights, dtype=np.float32), coords
+
+
+# ---------------------------------------------------------------------------
+# the worker loop
+# ---------------------------------------------------------------------------
+
+def run_tile_worker(root: str, worker_id: str, *,
+                    deadline_s: float = 120.0, runlog=None) -> dict:
+    """Produce this worker's share (initial assignment + anything
+    re-assigned to it) until the consumer publishes DONE. Returns the
+    channel stats (also folded into the worker's ``run_end``)."""
+    plan = load_plan(root)
+    chaos = get_chaos()
+    cfg = BoundaryConfig.from_env(
+        capacity=plan.get("credits"), chunk_tiles=plan.get("chunk_tiles"),
+        retransmit_s=plan.get("retransmit_s"), poll_s=plan.get("poll_s"),
+    )
+    own_log = runlog is None
+    if own_log:
+        from gigapath_tpu.obs.runlog import get_run_log
+
+        # run_start=False: the manifest would import jax for its version
+        # probe — a tile worker is numpy-only and must start in
+        # milliseconds, so it emits its own minimal manifest instead
+        runlog = get_run_log(f"dist-{worker_id}", out_dir=root,
+                             echo=False, run_start=False)
+        runlog.event("run_start", driver=f"dist-{worker_id}",
+                     pid=os.getpid(), worker=worker_id,
+                     slide=plan.get("slide_id"))
+    workers = sorted(plan["workers"])
+    rank = workers.index(worker_id) if worker_id in workers else -1
+    chunks = plan_chunks(int(plan["n_tiles"]), cfg.chunk_tiles)
+    by_id = {cid: (start, stop) for cid, start, stop in chunks}
+    mine: List[int] = assign_chunks(
+        [c[0] for c in chunks], workers,
+    ).get(worker_id, [])
+
+    lease = WorkerLease(root, worker_id, stage="tile",
+                        lease_s=plan.get("lease_s"))
+    lease.register()
+    weights = encoder_weights(plan)
+    producer = DirChannelProducer(root, cfg, producer=worker_id,
+                                  runlog=runlog, chaos=chaos)
+    from gigapath_tpu.obs.spans import span
+
+    pending: List[int] = list(mine)
+    seen_reassign: set = set()
+    produced = 0
+    done_path = os.path.join(root, DONE_MARKER)
+    t_deadline = time.monotonic() + deadline_s
+    status = "ok"
+    try:
+        while time.monotonic() < t_deadline:
+            lease.renew()
+            if pending:
+                cid = pending.pop(0)
+                start, stop = by_id[cid]
+                sent = False
+                # the per-chunk span carries the WORKER index as its
+                # rank (two process groups on one host share jax
+                # process index 0): obs_report's per-rank straggler
+                # table keys on exactly this tag
+                with span("dist.chunk", runlog, rank=rank, chunk=cid,
+                          tiles=stop - start, worker=worker_id):
+                    if chaos:
+                        # inside the span: injected slowness models slow
+                        # COMPUTE, and the straggler table must see it
+                        slow = chaos.slow_worker(cid)
+                        if slow:
+                            time.sleep(slow)
+                    embeds, coords = encode_chunk(plan, weights, start, stop)
+                    chunk = EmbeddingChunk.build(
+                        plan["slide_id"], cid, start, stop, embeds,
+                        coords=coords, producer=worker_id,
+                    )
+                    # a credit-blocked send must not starve the lease:
+                    # bound each wait well under the lease window and
+                    # renew between attempts — backpressure is healthy,
+                    # being declared dead because of it is not. Pump
+                    # retransmits between attempts too: at low credit a
+                    # DROPPED earlier write can be the very thing
+                    # holding every credit, and only a re-send frees it
+                    while True:
+                        lease.renew()
+                        try:
+                            producer.send(chunk,
+                                          timeout=lease.lease_s / 4.0)
+                            sent = True
+                            break
+                        except TimeoutError:
+                            if os.path.exists(done_path):
+                                # the run is over (consumer finished or
+                                # failed): nobody will ack this credit
+                                # back — drain out instead of spinning
+                                # to our own deadline
+                                break
+                            if time.monotonic() >= t_deadline:
+                                raise
+                            producer.pump_retransmits()
+                if not sent:
+                    break  # DONE appeared while credit-blocked
+                produced += 1
+                if chaos:
+                    chaos.maybe_kill_worker(produced)
+                continue
+            if os.path.exists(done_path):
+                break
+            producer.pump_retransmits()
+            for cid in reassignments_for(root, worker_id, seen_reassign):
+                if cid in by_id and cid not in pending:
+                    pending.append(cid)
+            time.sleep(cfg.poll_s)
+        else:
+            status = "deadline"
+    except BaseException:
+        status = "error"
+        raise
+    finally:
+        # retire ONLY on a clean exit: a worker dying on an exception
+        # (or its deadline) must leave its lease to EXPIRE, so the
+        # coordinator counts it lost and reassigns its chunks — deleting
+        # the lease here would dress every crash up as an orderly
+        # shutdown and strand the slide
+        if status == "ok":
+            lease.retire()
+        if own_log:
+            runlog.event("run_end", status=status, worker=worker_id,
+                         produced=produced, **producer.stats.as_dict())
+            runlog.close()
+    return {**producer.stats.as_dict(), "status": status}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="dist dryrun tile worker (module docstring)"
+    )
+    ap.add_argument("--root", required=True, help="shared pipeline workdir")
+    ap.add_argument("--worker", required=True, help="worker id (e.g. w0)")
+    ap.add_argument("--deadline-s", type=float, default=120.0)
+    args = ap.parse_args(argv)
+    stats = run_tile_worker(args.root, args.worker,
+                            deadline_s=args.deadline_s)
+    # a deadlined worker did NOT complete its share: exit nonzero so the
+    # orchestrator's process-exit probe (and any supervisor) sees a
+    # failure, not a clean drain
+    return 0 if stats.get("status") == "ok" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -94,6 +94,75 @@ def _load_features(path: str):
     return np.asarray(state), None
 
 
+def _feature_stream(feature_files, prefetch: int, runlog):
+    """Yield ``(idx, path, feats, coords)`` for every feature file.
+
+    ``prefetch == 0``: plain synchronous loads (the historical driver).
+    ``prefetch > 0``: a loader thread runs ahead through the dist
+    boundary's bounded :class:`~gigapath_tpu.dist.boundary.MemoryChannel`
+    — at most ``prefetch`` slides in flight (credit-based, so a slow
+    device backpressures the loader onto the obs bus instead of into
+    unbounded host memory), IO overlapped with dispatch either way.
+    """
+    if prefetch <= 0:
+        for idx, path in enumerate(feature_files):
+            feats, coords = _load_features(path)
+            yield idx, path, feats, coords
+        return
+
+    import threading
+
+    from gigapath_tpu.dist.boundary import (
+        BoundaryConfig,
+        EmbeddingChunk,
+        MemoryChannel,
+    )
+
+    channel = MemoryChannel(BoundaryConfig(capacity=int(prefetch)),
+                            runlog=runlog, name="inference.prefetch")
+    failure: list = []
+
+    def load():
+        try:
+            for idx, path in enumerate(feature_files):
+                feats, coords = _load_features(path)
+                feats = np.asarray(feats, np.float32)
+                # digest=False: an intra-process handoff cannot corrupt,
+                # and sha256 over a 10^5-tile slide would tax the hot
+                # path the prefetch exists to speed up
+                channel.send(EmbeddingChunk.build(
+                    os.path.basename(path), idx, 0, feats.shape[0], feats,
+                    coords=None if coords is None
+                    else np.asarray(coords, np.float32),
+                    producer="loader", digest=False,
+                ))
+        except BaseException as e:  # surfaced on the consuming thread
+            failure.append(e)
+        finally:
+            channel.close()
+
+    loader = threading.Thread(target=load, name="inference-prefetch",
+                              daemon=True)
+    loader.start()
+    served = 0
+    try:
+        while served < len(feature_files):
+            chunk = channel.recv(timeout=1.0)
+            if chunk is None:
+                if failure:
+                    raise failure[0]
+                continue
+            yield (chunk.chunk_id, feature_files[chunk.chunk_id],
+                   chunk.payload, chunk.coords)
+            channel.ack(chunk.seq)
+            served += 1
+        if failure:
+            raise failure[0]
+    finally:
+        channel.close()
+        loader.join(timeout=10)
+
+
 def _results_df(results, output_file, runlog, **run_end_fields):
     """Shared CSV + summary tail of both inference paths. A write
     failure (disk full, permissions) is contained like any other run
@@ -125,7 +194,7 @@ def _results_df(results, output_file, runlog, **run_end_fields):
 
 
 def _run_inference_bucketed(model, params, feature_files, output_file,
-                            runlog, batch_size: int):
+                            runlog, batch_size: int, prefetch: int = 0):
     """Bucketed path: the serving stack's ladder + coalescer + AOT
     executables + content-hash cache, driven synchronously.
 
@@ -163,8 +232,9 @@ def _run_inference_bucketed(model, params, feature_files, output_file,
     try:
         with Heartbeat(runlog, name="inference") as heartbeat:
             futures = []
-            for idx, path in enumerate(feature_files):
-                feats, coords = _load_features(path)
+            for idx, path, feats, coords in _feature_stream(
+                feature_files, prefetch, runlog
+            ):
                 if coords is None and not warned:
                     runlog.echo(
                         "Warning: feature files carry no coords; using zeros "
@@ -254,11 +324,14 @@ def run_inference(
     *,
     use_buckets: bool = True,
     batch_size: int = 16,
+    prefetch: int = 0,
 ):
     """Classify every ``*_features.pt`` in ``feature_dir``
     (reference ``run_inference:37-79``). ``use_buckets`` routes through
     the serving stack (module docstring); False is the exact-shape
-    oracle path."""
+    oracle path. ``prefetch > 0`` overlaps feature IO with dispatch
+    through the dist boundary's bounded channel (at most that many
+    slides in flight — backpressure instead of unbounded run-ahead)."""
     feature_files = sorted(glob.glob(os.path.join(feature_dir, "*_features.pt")))
     if not feature_files:
         console(f"No feature files found in {feature_dir}")
@@ -268,11 +341,12 @@ def run_inference(
         "inference", out_dir=os.path.dirname(os.path.abspath(output_file)),
         config={"feature_dir": feature_dir, "output_file": output_file,
                 "n_slides": len(feature_files), "buckets": bool(use_buckets),
-                "batch_size": int(batch_size)},
+                "batch_size": int(batch_size), "prefetch": int(prefetch)},
     )
     if use_buckets:
         return _run_inference_bucketed(
-            model, params, feature_files, output_file, runlog, batch_size
+            model, params, feature_files, output_file, runlog, batch_size,
+            prefetch=prefetch,
         )
 
     @jax.jit
@@ -360,6 +434,13 @@ def main(argv=None):
         help="Exact-shape fallback/oracle path: one jit compile per "
         "distinct tile count, no batching, no padding",
     )
+    parser.add_argument(
+        "--prefetch", type=int, default=0,
+        help="Overlap feature-file IO with dispatch: a loader thread "
+        "runs at most this many slides ahead through the dist "
+        "boundary's bounded channel (0 = synchronous loads; bucketed "
+        "path only)",
+    )
     parser.add_argument("--num_classes", type=int, default=2)
     parser.add_argument("--model_arch", type=str, default="gigapath_slide_enc12l768d")
     args = parser.parse_args(argv)
@@ -369,6 +450,7 @@ def main(argv=None):
     return run_inference(
         model, params, args.feature_dir, args.output_file,
         use_buckets=not args.no_buckets, batch_size=args.batch_size,
+        prefetch=args.prefetch,
     )
 
 

@@ -46,6 +46,13 @@ the traced programs are untouched, so the engine can add no retraces):
   by the in-graph non-finite guard
   (:mod:`gigapath_tpu.resilience.guard`): the optimizer update was a
   zero-update skip because loss or the grad norm went non-finite;
+- ``worker_lost``        — a ``worker_lost`` event from the dist
+  membership layer (:mod:`gigapath_tpu.dist.membership`): a fleet
+  member's lease expired (no re-detection — membership owns the expiry
+  math and reports each loss once; the reassignment that follows is a
+  ``recovery`` event, not an anomaly). The flight dump is the
+  post-mortem context for WHY the fleet shrank — the last heartbeats,
+  backpressure episodes and chunk spans before the silence;
 - ``slo_burn``           — an ``slo`` event with ``burning: true`` from
   the :class:`~gigapath_tpu.obs.metrics.SloTracker` (the serving
   stack's latency SLO spent its error budget past the burn threshold on
@@ -80,7 +87,7 @@ from gigapath_tpu.obs.flight import FlightRecorder, register_signal_dump
 
 DETECTORS = (
     "step_time_spike", "throughput_dip", "stall", "unexpected_retrace",
-    "memory_watermark", "nonfinite_step", "slo_burn",
+    "memory_watermark", "nonfinite_step", "slo_burn", "worker_lost",
 )
 
 
@@ -339,6 +346,18 @@ class AnomalyEngine(NullAnomalyEngine):
                     budget=record.get("budget"),
                     burn_long=record.get("burn_long"),
                     latency_s=record.get("latency_s"),
+                )
+            elif kind == "worker_lost":
+                # membership's verdict (one event per lost worker); the
+                # per-detector cooldown is keyed on step events, so a
+                # multi-worker cascade still dumps flight context for
+                # the FIRST loss — every loss keeps its own
+                # ``worker_lost`` event regardless
+                self._fire(
+                    "worker_lost",
+                    worker=record.get("worker"),
+                    stage=record.get("stage"),
+                    value=record.get("expired_by_s"),
                 )
             elif kind == "error":
                 # context dump only — the error event is its own record

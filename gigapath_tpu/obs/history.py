@@ -48,6 +48,8 @@ _DIRECTION_RULES: Tuple[Tuple[str, str], ...] = (
     ("cache_hit_rate", "up"),
     ("queue_wait_p50_s", "down"),
     ("queue_wait_p90_s", "down"),
+    ("chunks_per_sec", "up"),
+    ("recover_extra_s", "down"),   # kill-recover wall over the clean run's
     # latency-histogram quantiles (the serve|latency entry and any
     # future *_pNN_s metric): tail latency down-is-good
     ("_p50_s", "down"),
@@ -204,8 +206,9 @@ _SERVE_METRICS = (
 def _fold_serve_snapshot(doc: dict, snapshot: dict, label: str, *,
                          key: str, metric_keys: Tuple[str, ...],
                          source: Optional[str], force: bool) -> dict:
-    """The ONE serve-smoke staleness policy (shared by the throughput
-    and latency entries so the two verdicts can never diverge): a
+    """The ONE smoke-snapshot staleness policy (shared by the serve
+    throughput/latency entries and the dist boundary entry so the
+    verdicts can never diverge): a
     failed run (rc != 0 / error) or a NON-CHIP backend lands STALE —
     CPU smoke numbers carry the metric KEYS for future on-chip rounds
     without ever moving the trend; a laptop's percentiles are not a
@@ -264,6 +267,26 @@ def fold_serve_latency(doc: dict, snapshot: dict, label: str,
     return _fold_serve_snapshot(
         doc, snapshot, label, key="serve|latency",
         metric_keys=_SERVE_LATENCY_METRICS, source=source, force=force,
+    )
+
+
+# dist_smoke payload fields worth trending (scripts/dist_smoke.py's
+# JSON line): boundary throughput and the cost of losing a worker
+_DIST_METRICS = (
+    "chunks_per_sec", "clean_wall_s", "recover_extra_s",
+    "workers", "chunks",
+)
+
+
+def fold_dist(doc: dict, snapshot: dict, label: str,
+              source: Optional[str] = None, force: bool = False) -> dict:
+    """One dist_smoke JSON -> one point under ``dist|smoke`` (the
+    cross-stage boundary's trend entry — same shared staleness policy
+    as the serve entries: a CPU dryrun carries the metric keys but
+    never moves the trend)."""
+    return _fold_serve_snapshot(
+        doc, snapshot, label, key="dist|smoke",
+        metric_keys=_DIST_METRICS, source=source, force=force,
     )
 
 

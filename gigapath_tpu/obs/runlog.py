@@ -48,6 +48,15 @@ Event kinds (schema v1, one JSON object per line, every record carries
 - ``trace``      — the per-run request-trace export
   (:mod:`gigapath_tpu.obs.reqtrace`): path of the Perfetto-loadable
   Chrome-trace JSON plus trace/span/dropped totals;
+- ``backpressure`` — the cross-stage boundary channel's producer ran
+  out of consumer credits and BLOCKED (:mod:`gigapath_tpu.dist.boundary`):
+  channel, seq, ``credits`` (0 at emission), queue depth, capacity —
+  one event per blocking episode, the "consumer is falling behind"
+  signal;
+- ``worker_lost`` — a fleet member's lease expired
+  (:mod:`gigapath_tpu.dist.membership`): worker, stage, seconds past
+  expiry, last renewal — fires the anomaly engine's ``worker_lost``
+  detector and precedes the ``recovery action="reassign"`` event;
 - ``error``      — exception surfaced by a driver;
 - ``run_end``    — terminal status + summary payload.
 
@@ -72,7 +81,8 @@ SCHEMA_VERSION = 1
 EVENT_KINDS = (
     "run_start", "step", "compile", "compile_profile", "span", "eval",
     "heartbeat", "stall", "anomaly", "recovery", "serve_dispatch",
-    "cache_hit", "metrics", "slo", "trace", "error", "run_end",
+    "cache_hit", "metrics", "slo", "trace", "backpressure", "worker_lost",
+    "error", "run_end",
 )
 
 
@@ -321,7 +331,7 @@ class RunLog(NullRunLog):
         (:mod:`gigapath_tpu.resilience` / the serving self-healing):
         skip_step, rollback, rollback_unavailable, resume,
         emergency_checkpoint, data_retry, shed, deadline, bisect,
-        poisoned_request, breaker_*, drain —
+        poisoned_request, breaker_*, drain, reassign —
         rendered by ``scripts/obs_report.py``'s ``== recovery ==``."""
         return self.event("recovery", action=action, **fields)
 

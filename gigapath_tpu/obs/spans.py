@@ -196,7 +196,7 @@ def _is_recording(runlog) -> bool:
 
 @contextlib.contextmanager
 def span(name: str, runlog=None, *, fence: Any = None, annotate: bool = False,
-         **fields):
+         rank: Optional[int] = None, **fields):
     """Nestable timed region emitting one ``span`` event at exit.
 
     ``fence``: falsy -> no sync (dur_s is host dispatch time, marked
@@ -204,6 +204,13 @@ def span(name: str, runlog=None, *, fence: Any = None, annotate: bool = False,
     ``Span.fence``; any other value -> block on it (plus registered
     values). ``annotate=True`` additionally wraps the region in a
     ``jax.profiler.TraceAnnotation`` so it shows up in captured traces.
+
+    ``rank`` overrides the event's rank tag (default:
+    ``jax.process_index()``). The dist dryrun's worker processes use it
+    — two process groups on ONE machine all answer jax process index 0,
+    but the per-rank straggler table needs the WORKER index; an explicit
+    rank also keeps a numpy-only worker from importing jax just to be
+    told ``0``.
 
     Against a ``NullRunLog`` (``GIGAPATH_OBS=0``) the whole thing is a
     no-op: the yielded span absorbs ``fence``/``note`` calls and nothing
@@ -275,7 +282,9 @@ def span(name: str, runlog=None, *, fence: Any = None, annotate: bool = False,
             # would introduce a new failure site the bare driver lacks
             runlog.event(
                 "span", name=name, path=path, depth=depth, dur_s=sp.dur_s,
-                fenced=sp.fenced, rank=process_index(), status=status,
+                fenced=sp.fenced,
+                rank=process_index() if rank is None else int(rank),
+                status=status,
                 **merged,
             )
         finally:

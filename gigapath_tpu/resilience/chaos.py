@@ -26,6 +26,18 @@ trace time — GL001-clean because no injector is trace-reachable):
 - ``slow_dispatch@K:S`` — serving: dispatch ``K`` sleeps S seconds
   host-side inside the dispatch span (``K = *`` slows EVERY dispatch —
   the forced-slow run that proves the SLO burn detector fires);
+- ``kill_worker@K``   — dist: this tile worker SIGKILLs itself after
+  producing K chunks (the hard-death case: no goodbye, the lease just
+  stops renewing — drives lease expiry -> ``worker_lost`` ->
+  reassignment in :mod:`gigapath_tpu.dist`);
+- ``slow_worker@K:S`` — dist: sleep S seconds before producing chunk
+  ``K`` (``K = *`` slows EVERY chunk — the straggler whose skew the
+  per-rank span table must surface);
+- ``drop_chunk@K``    — dist: the boundary channel swallows the FIRST
+  send of chunk seq ``K`` (the lost-write case; the producer's
+  retransmit timer heals it);
+- ``dup_chunk@K``     — dist: chunk seq ``K`` is sent twice (the
+  consumer's seq dedup absorbs the twin);
 - ``seed=N``          — seed for the deterministic corruption bytes.
 
 All injection is host-side (batches are poisoned *before* they reach the
@@ -77,6 +89,18 @@ class NullChaos:
     def slow_dispatch(self, dispatch_index: int) -> float:
         return 0.0
 
+    def maybe_kill_worker(self, produced: int) -> bool:
+        return False
+
+    def slow_worker(self, chunk_index: int) -> float:
+        return 0.0
+
+    def drops_chunk(self, seq: int) -> bool:
+        return False
+
+    def dups_chunk(self, seq: int) -> bool:
+        return False
+
 
 class ChaosInjector(NullChaos):
     """Parsed ``GIGAPATH_CHAOS`` spec. One instance per driver run."""
@@ -93,6 +117,10 @@ class ChaosInjector(NullChaos):
         self._ckpt_corrupted = False
         self._poison_ids: List[str] = []
         self._slow_dispatch: Dict[str, float] = {}  # index (or "*") -> s
+        self._kill_worker_after: Optional[int] = None
+        self._slow_worker: Dict[str, float] = {}  # chunk (or "*") -> s
+        self._drop_chunks: set = set()
+        self._dup_chunks: set = set()
         for token in spec.split(","):
             token = token.strip()
             if not token:
@@ -126,12 +154,23 @@ class ChaosInjector(NullChaos):
         elif kind == "slow_dispatch":
             idx, _, secs = arg.partition(":")
             self._slow_dispatch[idx or "*"] = float(secs) if secs else 1.0
+        elif kind == "kill_worker":
+            self._kill_worker_after = int(arg)
+        elif kind == "slow_worker":
+            idx, _, secs = arg.partition(":")
+            self._slow_worker[idx or "*"] = float(secs) if secs else 1.0
+        elif kind == "drop_chunk":
+            self._drop_chunks.add(int(arg))
+        elif kind == "dup_chunk":
+            self._dup_chunks.add(int(arg))
         else:
             raise ValueError(
                 f"GIGAPATH_CHAOS: unknown injector {token!r} (known: "
                 "nan_loss@K, corrupt_batch@K, sigterm@K, fail_loader@I[xN], "
                 "slow_loader@I[:S], corrupt_ckpt, poison@ID, "
-                "slow_dispatch@K[:S] (K='*' = all), seed=N)"
+                "slow_dispatch@K[:S] (K='*' = all), kill_worker@K, "
+                "slow_worker@K[:S] (K='*' = all), drop_chunk@K, "
+                "dup_chunk@K, seed=N)"
             )
 
     # -- batch faults (consulted by train loops, host-side) ---------------
@@ -201,6 +240,39 @@ class ChaosInjector(NullChaos):
         return self._slow_dispatch.get(
             str(dispatch_index), self._slow_dispatch.get("*", 0.0)
         )
+
+    # -- dist: cross-stage boundary faults (gigapath_tpu.dist) ------------
+    def maybe_kill_worker(self, produced: int) -> bool:
+        """SIGKILL THIS process once ``produced`` chunks have landed —
+        the tile worker consults this after each send. SIGKILL, not
+        SIGTERM: the hard-preemption case where no handler runs and the
+        only signal the fleet gets is a lease that stops renewing."""
+        if self._kill_worker_after is None or produced < self._kill_worker_after:
+            return False
+        self._kill_worker_after = None  # one death per spec entry
+        os.kill(os.getpid(), signal.SIGKILL)
+        return True  # unreachable after SIGKILL; keeps the surface honest
+
+    def slow_worker(self, chunk_index: int) -> float:
+        """Seconds to sleep before producing chunk ``chunk_index``
+        (``'*'`` = every chunk — the deterministic straggler)."""
+        return self._slow_worker.get(
+            str(chunk_index), self._slow_worker.get("*", 0.0)
+        )
+
+    def drops_chunk(self, seq: int) -> bool:
+        """True exactly ONCE per configured seq: the first send is
+        swallowed by the transport, the retransmit goes through."""
+        if seq in self._drop_chunks:
+            self._drop_chunks.discard(seq)
+            return True
+        return False
+
+    def dups_chunk(self, seq: int) -> bool:
+        if seq in self._dup_chunks:
+            self._dup_chunks.discard(seq)
+            return True
+        return False
 
 
 def corrupt_checkpoint_dir(path: str, seed: int = 0) -> Optional[str]:

@@ -1,0 +1,308 @@
+#!/usr/bin/env python
+"""One-command two-process recovery checklist for the disaggregated
+cross-stage boundary (ISSUE 11's acceptance driver).
+
+    python scripts/dist_smoke.py
+    python scripts/dist_smoke.py --json DIST_SMOKE.json
+
+Four checks, each a hard assertion (exit 1 + structured JSON on
+violation, bench.py-style; progress rides stderr). Every check runs a
+REAL fleet: tile-worker OS processes + the slide-stage consumer in this
+process, joined by the directory boundary channel
+(``gigapath_tpu/dist/``):
+
+1. **clean_parity**: two workers, no chaos — the assembled tile
+   sequence and the slide forward match a single-process oracle
+   BIT-exact, with zero duplicates/retransmits/losses.
+2. **kill_recover**: ``kill_worker@1`` SIGKILLs worker w0 after its
+   first chunk; the consumer's lease poll emits ``worker_lost``, the
+   unacked range is re-assigned to the survivor
+   (``recovery action="reassign"``), and the final slide embedding is
+   BIT-exact vs the clean run — with zero unexpected retraces (recovery
+   must never show up as a recompile).
+3. **slow_worker_skew**: ``slow_worker@*:S`` makes w1 a deterministic
+   straggler; the merged per-rank obs files must show rank 1 as the
+   straggler in ``obs_report.py``'s per-rank span table.
+4. **drop_dup_dedup**: ``drop_chunk@K`` swallows one send (the
+   retransmit timer heals it — retransmits >= 1) and ``dup_chunk@K``
+   sends one chunk twice (consumer dedup absorbs it — duplicates >= 1);
+   the result is still bit-exact.
+
+The JSON line carries the ``dist|smoke`` trend keys
+(``chunks_per_sec``, ``clean_wall_s``, ``recover_extra_s``);
+``perf_history.py ingest --dist`` folds them (CPU runs land stale —
+provenance, not a perf baseline). Pure-CPU, tiny shapes, no chip.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import io
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+T0 = time.monotonic()
+
+
+def echo(msg: str) -> None:
+    print(f"[dist_smoke +{time.monotonic() - T0:.1f}s] {msg}",
+          file=sys.stderr)
+
+
+def run_events(root: str):
+    events = []
+    for path in glob.glob(os.path.join(root, "obs", "*.jsonl")):
+        if os.path.basename(path).startswith("flight-"):
+            continue
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    # a SIGKILLed worker can die mid-line; the torn
+                    # tail is expected, not a smoke failure
+                    continue
+    events.sort(key=lambda ev: ev.get("t", 0.0))
+    return events
+
+
+def events_of(events, kind, **match):
+    out = [ev for ev in events if ev.get("kind") == kind]
+    for k, v in match.items():
+        out = [ev for ev in out if ev.get(k) == v]
+    return out
+
+
+def oracle(plan: dict):
+    """Single-process truth: assemble + forward without any channel."""
+    from gigapath_tpu.dist.boundary import plan_chunks
+    from gigapath_tpu.dist.pipeline import _default_forward
+    from gigapath_tpu.dist.worker import encode_chunk, encoder_weights
+
+    weights = encoder_weights(plan)
+    embeds = np.zeros((plan["n_tiles"], plan["dim_out"]), np.float32)
+    coords = np.zeros((plan["n_tiles"], 2), np.float32)
+    for _, start, stop in plan_chunks(plan["n_tiles"], plan["chunk_tiles"]):
+        e, c = encode_chunk(plan, weights, start, stop)
+        embeds[start:stop] = e
+        coords[start:stop] = c
+    forward, params = _default_forward()(plan["dim_out"])
+    out = np.asarray(forward(params, embeds[None], coords[None]), np.float32)[0]
+    return embeds, out
+
+
+def check_clean_parity(root: str, plan: dict) -> dict:
+    from gigapath_tpu.dist.pipeline import run_disaggregated
+
+    echo("1/4 clean_parity: two workers, no chaos")
+    t0 = time.monotonic()
+    result = run_disaggregated(os.path.join(root, "clean"), plan=plan,
+                               deadline_s=90)
+    wall = time.monotonic() - t0
+    embeds, out = oracle(plan)
+    assert np.array_equal(result["assembled"], embeds), (
+        "assembled tile sequence differs from the single-process oracle"
+    )
+    assert np.array_equal(result["embedding"], out), (
+        "slide embedding differs from the single-process oracle"
+    )
+    stats = result["stats"]
+    assert stats["duplicates"] == 0 and stats["corrupt"] == 0, stats
+    assert result["lost"] == [] and result["reassignments"] == 0
+    assert all(rc == 0 for rc in result["worker_exit_codes"].values()), (
+        result["worker_exit_codes"]
+    )
+    echo(f"1/4 ok: bit-exact vs oracle, {stats['delivered']} chunks in "
+         f"{wall:.1f}s")
+    return {"wall_s": round(wall, 3), "chunks": stats["delivered"],
+            "embedding": result["embedding"]}
+
+
+def check_kill_recover(root: str, plan: dict, clean_embedding) -> dict:
+    from gigapath_tpu.dist.pipeline import run_disaggregated
+
+    echo("2/4 kill_recover: SIGKILL w0 after 1 chunk, mid-slide")
+    t0 = time.monotonic()
+    result = run_disaggregated(
+        os.path.join(root, "kill"), plan=plan,
+        worker_chaos={"w0": "kill_worker@1"}, deadline_s=90,
+    )
+    wall = time.monotonic() - t0
+    assert result["worker_exit_codes"]["w0"] == -9, (
+        f"w0 was not SIGKILLed: {result['worker_exit_codes']}"
+    )
+    assert np.array_equal(result["embedding"], clean_embedding), (
+        "post-recovery slide embedding is NOT bit-exact vs the clean run"
+    )
+    events = run_events(os.path.join(root, "kill"))
+    lost = events_of(events, "worker_lost", worker="w0")
+    assert lost, "no worker_lost event for the killed worker"
+    reassigns = events_of(events, "recovery", action="reassign")
+    assert reassigns and reassigns[0].get("worker") == "w0", (
+        "no reassign recovery event for w0's unacked range"
+    )
+    anomalies = events_of(events, "anomaly", detector="worker_lost")
+    assert anomalies, "the anomaly engine did not react to worker_lost"
+    unexpected = [ev for ev in events_of(events, "compile")
+                  if ev.get("unexpected")]
+    assert not unexpected, f"recovery paid unexpected retraces: {unexpected}"
+    echo(f"2/4 ok: lost w0, reassigned "
+         f"{reassigns[0].get('chunks')} chunk(s), bit-exact in {wall:.1f}s")
+    return {"wall_s": round(wall, 3),
+            "reassigned_chunks": reassigns[0].get("chunks")}
+
+
+def check_slow_worker_skew(root: str, plan: dict, slow_s: float) -> dict:
+    from gigapath_tpu.dist.pipeline import run_disaggregated
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import obs_report
+
+    echo(f"3/4 slow_worker_skew: w1 sleeps {slow_s}s per chunk")
+    run_id = "dist-smoke-slow"
+    out = os.path.join(root, "slow")
+    result = run_disaggregated(
+        out, plan=plan, worker_chaos={"w1": f"slow_worker@*:{slow_s}"},
+        deadline_s=90, run_id=run_id,
+    )
+    assert result["lost"] == [], "the straggler must survive, not be lost"
+    events = run_events(out)
+    spans = [ev for ev in events_of(events, "span")
+             if ev.get("name") == "dist.chunk"]
+    by_rank = {}
+    for ev in spans:
+        by_rank.setdefault(int(ev.get("rank", -1)), []).append(
+            float(ev["dur_s"]))
+    assert set(by_rank) >= {0, 1}, f"span ranks missing: {sorted(by_rank)}"
+    med = {r: sorted(d)[len(d) // 2] for r, d in by_rank.items()}
+    assert med[1] > med[0] + slow_s * 0.5, (
+        f"straggler skew invisible: per-rank medians {med}"
+    )
+    # ... and the per-rank table of the REPORT must call rank 1 out
+    buf = io.StringIO()
+    obs_report.render(events, out=buf)
+    text = buf.getvalue()
+    assert "per-rank skew (span 'dist.chunk')" in text, text
+    assert "straggler: rank 1" in text, text
+    echo(f"3/4 ok: straggler rank 1 visible (medians {med})")
+    return {"median_rank0_s": round(med[0], 4),
+            "median_rank1_s": round(med[1], 4)}
+
+
+def check_drop_dup_dedup(root: str, plan: dict, clean_embedding) -> dict:
+    from gigapath_tpu.dist.pipeline import run_disaggregated
+
+    echo("4/4 drop_dup_dedup: drop chunk 0's first send, dup chunk 2")
+    result = run_disaggregated(
+        os.path.join(root, "dropdup"), plan=plan,
+        worker_chaos={"w0": "drop_chunk@0,dup_chunk@2"}, deadline_s=90,
+    )
+    assert np.array_equal(result["embedding"], clean_embedding), (
+        "drop/dup run is NOT bit-exact vs the clean run"
+    )
+    stats = result["stats"]
+    assert stats["duplicates"] >= 1, (
+        f"the duplicated chunk was not deduped: {stats}"
+    )
+    events = run_events(os.path.join(root, "dropdup"))
+    worker_ends = [ev for ev in events_of(events, "run_end")
+                   if str(ev.get("run", "")).startswith("dist-w0")
+                   or ev.get("worker") == "w0"]
+    assert worker_ends and worker_ends[0].get("retransmits", 0) >= 1, (
+        f"the dropped chunk was not retransmitted: {worker_ends}"
+    )
+    assert worker_ends[0].get("dropped", 0) >= 1, worker_ends
+    echo(f"4/4 ok: {stats['duplicates']} dup(s) deduped, "
+         f"{worker_ends[0]['retransmits']} retransmit(s) healed the drop")
+    return {"duplicates": stats["duplicates"],
+            "retransmits": worker_ends[0]["retransmits"]}
+
+
+def run(args) -> dict:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from gigapath_tpu.dist.pipeline import default_plan
+
+    root = args.out_dir or tempfile.mkdtemp(prefix="dist-smoke-")
+    plan = default_plan(
+        n_tiles=args.n_tiles, chunk_tiles=args.chunk_tiles,
+        dim_in=16, dim_out=8, lease_s=args.lease_s,
+        credits=4, retransmit_s=0.5,
+    )
+    checks = {}
+    clean = check_clean_parity(root, plan)
+    clean_embedding = clean.pop("embedding")
+    checks["clean_parity"] = clean
+    checks["kill_recover"] = check_kill_recover(root, plan, clean_embedding)
+    checks["slow_worker_skew"] = check_slow_worker_skew(
+        root, plan, args.slow_s)
+    checks["drop_dup_dedup"] = check_drop_dup_dedup(
+        root, plan, clean_embedding)
+    clean_wall = checks["clean_parity"]["wall_s"]
+    return {
+        "metric": "dist_smoke",
+        "checks": checks,
+        "checks_passed": len(checks),
+        "workers": len(plan["workers"]),
+        "chunks": checks["clean_parity"]["chunks"],
+        "chunks_per_sec": round(
+            checks["clean_parity"]["chunks"] / max(clean_wall, 1e-9), 3),
+        "clean_wall_s": clean_wall,
+        "recover_extra_s": round(
+            max(checks["kill_recover"]["wall_s"] - clean_wall, 0.0), 3),
+        "wall_s": round(time.monotonic() - T0, 3),
+        "backend": jax.default_backend(),
+        "out_dir": root,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="one-command two-process dist recovery checklist "
+        "(module docstring)"
+    )
+    ap.add_argument("--n-tiles", type=int, default=48)
+    ap.add_argument("--chunk-tiles", type=int, default=8)
+    ap.add_argument("--lease-s", type=float, default=1.5,
+                    help="worker lease window (renewals every third of "
+                    "it; also bounds kill-recover detection latency)")
+    ap.add_argument("--slow-s", type=float, default=0.15,
+                    help="per-chunk straggler sleep for check 3")
+    ap.add_argument("--out-dir", default=None,
+                    help="work dir (default: fresh temp dir)")
+    ap.add_argument("--json", default=None, help="also write the payload here")
+    args = ap.parse_args(argv)
+
+    try:
+        payload = run(args)
+        payload["rc"] = 0
+    except Exception as e:
+        payload = {
+            "metric": "dist_smoke", "rc": 1,
+            "error": f"{type(e).__name__}: {e}",
+        }
+    line = json.dumps(payload, sort_keys=True)
+    print(line)
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as fh:
+            fh.write(line + "\n")
+    return payload["rc"]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,7 +22,10 @@
 #   - the gigalint GL012 selftest: the seeded ad-hoc-latency-aggregation
 #     fixture must fire (hand-rolled perf_counter list-append-then-sort
 #     outside obs/ — the pattern obs/metrics.py's Histogram/percentile
-#     replace).
+#     replace);
+#   - the gigalint GL013 selftest: the seeded unbounded-channel fixture
+#     must fire (queue.Queue()/bare deque() as an inter-thread channel
+#     outside the sanctioned serve/queue.py + dist/boundary.py paths).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 python scripts/obs_report.py --selftest 1>&2
@@ -54,5 +57,18 @@ if [ "$gl012_rc" -ne 1 ]; then
     exit 1
 fi
 echo "gigalint GL012 selftest OK" 1>&2
+
+# GL013 selftest: the seeded unbounded-channel fixture MUST be found
+# (exit 1 = findings; 0 or 2 mean the rule went blind or crashed)
+set +e
+python -m tools.gigalint --no-waivers --select GL013 \
+    tools/gigalint/selftest/fixture/models/channels.py 1>&2
+gl013_rc=$?
+set -e
+if [ "$gl013_rc" -ne 1 ]; then
+    echo "GL013 selftest FAILED: expected findings (rc=1), got rc=$gl013_rc" 1>&2
+    exit 1
+fi
+echo "gigalint GL013 selftest OK" 1>&2
 
 exec python -m tools.gigalint gigapath_tpu scripts tests "$@"

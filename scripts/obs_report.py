@@ -15,7 +15,9 @@ max/median step span per rank, worst rank called out), anomalies (per
 detector, with the reactions taken — flight-dump path, profiler trace
 dir), recovery (the fault-tolerance layer's actions — skips,
 rollbacks, resumes, data retries, sheds, deadline failures, breaker
-trips, drains — per action with its context), latency (the typed
+trips, drains, reassignments — per action with its context), dist (the
+cross-stage boundary: backpressure episodes per channel with queue
+depth/capacity, lost workers with lease-expiry context), latency (the typed
 metrics registry's last ``metrics`` snapshot: per-histogram
 p50/p90/p99/max plus counters and gauges), slo (burn-rate transitions
 and the terminal error-budget status from the ``SloTracker``), traces
@@ -283,6 +285,12 @@ def render(events: List[dict], out=None) -> int:
                 bits.append(f"{ev['consecutive']} consecutive")
             if ev.get("slide_id") is not None:
                 bits.append(f"slide {ev['slide_id']}")
+            if ev.get("worker") is not None:
+                bits.append(f"worker {ev['worker']}")
+            if ev.get("chunks") is not None:
+                bits.append(f"{ev['chunks']} chunk(s)")
+            if ev.get("survivors"):
+                bits.append(f"-> {','.join(str(s) for s in ev['survivors'])}")
             if ev.get("index") is not None:
                 bits.append(f"sample {ev['index']}")
             if ev.get("attempts") is not None:
@@ -369,6 +377,42 @@ def render(events: List[dict], out=None) -> int:
                     f"slide(s), occupancy {mean_occ:.2f} "
                     f"[{','.join(sources)}]\n"
                 )
+        w("\n")
+
+    # -- dist (gigapath_tpu.dist: cross-stage boundary + membership) ------
+    backpressures = by_kind.get("backpressure", [])
+    lost_workers = by_kind.get("worker_lost", [])
+    if backpressures or lost_workers:
+        w("== dist ==\n")
+        if backpressures:
+            by_channel: Dict[str, List[dict]] = {}
+            for ev in backpressures:
+                by_channel.setdefault(str(ev.get("channel", "?")), []).append(ev)
+            w(f"backpressure episodes: {len(backpressures)}\n")
+            for channel in sorted(by_channel):
+                evs = by_channel[channel]
+                depths = [int(ev["queue_depth"]) for ev in evs
+                          if ev.get("queue_depth") is not None]
+                cap = next((ev.get("capacity") for ev in evs
+                            if ev.get("capacity") is not None), "?")
+                w(
+                    f"  channel '{channel}': {len(evs)} episode(s), "
+                    f"capacity {cap}"
+                    + (f", max queue depth {max(depths)}" if depths else "")
+                    + " (producer blocked at 0 credits)\n"
+                )
+        for ev in lost_workers:
+            how = (
+                f"lease expired {ev['expired_by_s']}s before detection"
+                if ev.get("expired_by_s") is not None
+                else f"reason={ev.get('reason', '?')}"
+                + (f", exit code {ev['exit_code']}"
+                   if ev.get("exit_code") is not None else "")
+            )
+            w(
+                f"  WORKER_LOST at +{ev.get('t', 0.0) - t0:.1f}s: "
+                f"{ev.get('worker')} (stage {ev.get('stage')}, {how})\n"
+            )
         w("\n")
 
     # -- latency (obs/metrics.py: metrics-event snapshots) -----------------
@@ -562,6 +606,16 @@ def selftest() -> int:
         log.recovery(action="shed", slide_id="s9", bucket=256,
                      queued_tokens=4096, budget=4096)
         log.recovery(action="breaker_open", bucket=512, cooldown_s=30.0)
+        # dist telemetry (gigapath_tpu.dist): a backpressured boundary
+        # channel, a lost worker, and the reassignment that healed it
+        log.event("backpressure", channel="dir", seq=5, credits=0,
+                  queue_depth=4, capacity=4)
+        log.event("backpressure", channel="dir", seq=6, credits=0,
+                  queue_depth=3, capacity=4)
+        log.event("worker_lost", worker="w0", stage="tile",
+                  expired_by_s=0.41, last_renew=100.0, pid=4242)
+        log.recovery(action="reassign", worker="w0", chunks=3,
+                     survivors=["w1", "w2"])
 
         # -- a REAL traced smoke: submit -> dispatch -> resolve through
         # the serving RequestQueue, with request traces, latency
@@ -685,7 +739,12 @@ def selftest() -> int:
                 "ROLLBACK at", "step 9, -> step 5",
                 "RESUME at", "past 1 corrupt checkpoint(s)",
                 "DATA_RETRY at", "sample 3, after 3 attempt(s)",
-                "SHED at", "4096 queued tokens vs budget 4096")
+                "SHED at", "4096 queued tokens vs budget 4096",
+                "== dist ==", "backpressure episodes: 2",
+                "channel 'dir': 2 episode(s), capacity 4, "
+                "max queue depth 4",
+                "WORKER_LOST at", "w0 (stage tile",
+                "REASSIGN at", "worker w0, 3 chunk(s), -> w1,w2")
     missing = [s for s in required if s not in text]
     required_fl = ("== flight dumps ==", "reason=step_time_spike")
     missing_fl = [s for s in required_fl if s not in text_fl]

@@ -116,6 +116,10 @@ def cmd_ingest(args) -> int:
                 doc, serve_snapshot, args.label,
                 source=os.path.basename(args.serve), force=args.force,
             )
+        if args.dist:
+            history.fold_dist(doc, _load_json(args.dist), args.label,
+                              source=os.path.basename(args.dist),
+                              force=args.force)
         for path in args.ledger or []:
             history.fold_ledger(doc, _load_json(path), args.label,
                                 source=os.path.basename(path),
@@ -305,6 +309,36 @@ def selftest() -> int:
               "counted as a regression", file=sys.stderr)
         return 1
 
+    # dist_smoke folding: same shared staleness policy (CPU dryrun =
+    # stale with keys), and a boundary-throughput dip flips the gate
+    history.fold_dist(
+        serve_doc,
+        {"rc": 0, "parsed": {"backend": "cpu", "chunks_per_sec": 4.0,
+                             "recover_extra_s": 1.5}}, "r01")
+    dist_points = serve_doc["entries"]["dist|smoke"]["points"]
+    if not dist_points[0].get("stale") or "chunks_per_sec" not in \
+            dist_points[0]["metrics"]:
+        print("perf_history selftest FAILED: CPU dist point must be "
+              "stale WITH metric keys", file=sys.stderr)
+        return 1
+    history.fold_dist(
+        serve_doc,
+        {"rc": 0, "parsed": {"backend": "tpu", "chunks_per_sec": 200.0,
+                             "recover_extra_s": 1.0}}, "r02")
+    history.fold_dist(
+        serve_doc,
+        {"rc": 0, "parsed": {"backend": "tpu", "chunks_per_sec": 90.0,
+                             "recover_extra_s": 1.0}}, "r03")
+    dv = history.trend_verdict(serve_doc)
+    if dv["decision"]["ok"] or not any(
+        "dist|smoke: chunks_per_sec 200.0" in line
+        for line in dv["decision"]["regressed"]
+    ):
+        print("perf_history selftest FAILED: dist boundary-throughput "
+              "dip undetected", file=sys.stderr)
+        render(dv, out=sys.stderr)
+        return 1
+
     # append-only: reusing a label without force must refuse
     try:
         history.fold_bench(
@@ -374,6 +408,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_ing.add_argument("--serve", default=None,
                        help="serve_smoke snapshot JSON "
                        "(scripts/serve_smoke.py --json output)")
+    p_ing.add_argument("--dist", default=None,
+                       help="dist_smoke snapshot JSON "
+                       "(scripts/dist_smoke.py --json output) -> the "
+                       "dist|smoke boundary trend entry")
     p_ing.add_argument("--ledger", action="append", default=None,
                        help="per-run ledger JSON (repeatable)")
     p_ing.add_argument("--force", action="store_true",

@@ -65,5 +65,15 @@ timeout 2400 python scripts/serve_smoke.py \
   --bucket-min 1024 --bucket-align 128 --bucket-max 131072 \
   --json SERVE_SMOKE.json > /tmp/r7_serve.log 2>&1
 tail -3 /tmp/r7_serve.log
+
+# 8. the disaggregated cross-stage boundary (ROADMAP item 4's dryrun):
+#    two tile-worker processes + the slide consumer over the credit-
+#    based channel — clean parity, kill-recover bit-exactness, straggler
+#    skew, drop/dup dedup, all hard-asserted. The ingest below folds the
+#    dist|smoke entry next to the serve ones (the label lands once, with
+#    every snapshot measured this round).
+timeout 1200 python scripts/dist_smoke.py --json DIST_SMOKE.json \
+  > /tmp/r7_dist.log 2>&1
+tail -3 /tmp/r7_dist.log
 python scripts/perf_history.py ingest --label r07 --serve SERVE_SMOKE.json \
-  || true
+  --dist DIST_SMOKE.json || true

@@ -50,7 +50,8 @@ def test_fixture_tree_fires_every_rule_class():
     assert result.exit_code != 0
     fired = {f.rule for f in result.findings}
     expected = {"GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-                "GL007", "GL008", "GL009", "GL010", "GL011", "GL012"}
+                "GL007", "GL008", "GL009", "GL010", "GL011", "GL012",
+                "GL013"}
     assert fired >= expected, (
         f"missing rule classes: {sorted(expected - fired)}"
     )
@@ -107,6 +108,13 @@ def test_fixture_specific_findings():
         # attribute-owned list (sorted(self._walls)) — the serving-stats
         # shape must not slip past a bare-Name-only sorted() check
         ("GL012", "latency.py", "LatencyStat.aggregate"),
+        # unbounded hand-rolled inter-thread channels (the fixture's
+        # own dist/boundary.py + serve/queue.py twins are the sanctioned
+        # negative controls, rolling.py the no-threading deque control)
+        ("GL013", "channels.py", "unbounded_queue_channel"),
+        ("GL013", "channels.py", "unbounded_deque_channel"),
+        # maxsize=-1 is Python's explicitly-INFINITE queue, not a bound
+        ("GL013", "channels.py", "unbounded_queue_negative_maxsize"),
     }
     assert expected <= got, f"missing: {sorted(expected - got)}"
 

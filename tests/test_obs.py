@@ -307,11 +307,26 @@ class TestSpans:
 
     def test_caller_fields_cannot_shadow_span_schema(self, tmp_path):
         log = RunLog(str(tmp_path / "run.jsonl"), driver="t", echo=False)
-        with span("eval", log, status="pending", rank=99):
+        with span("eval", log, status="pending", depth=42):
             pass
         (ev,) = read_events(log.path)
-        assert ev["status"] == "ok" and ev["rank"] == 0  # schema wins
-        assert ev["field_status"] == "pending" and ev["field_rank"] == 99
+        assert ev["status"] == "ok" and ev["depth"] == 1  # schema wins
+        assert ev["field_status"] == "pending" and ev["field_depth"] == 42
+        log.close()
+
+    def test_rank_is_an_explicit_override_not_a_field(self, tmp_path):
+        """``rank`` graduated from shadowable free-form field to a named
+        span parameter (the dist worker processes tag spans with their
+        WORKER index — jax process index is 0 for every group on one
+        machine). Default stays the process index."""
+        log = RunLog(str(tmp_path / "run.jsonl"), driver="t", echo=False)
+        with span("chunk", log, rank=3):
+            pass
+        with span("chunk", log):
+            pass
+        first, second = read_events(log.path)
+        assert first["rank"] == 3 and "field_rank" not in first
+        assert second["rank"] == 0
         log.close()
 
     def test_null_runlog_is_true_noop(self):

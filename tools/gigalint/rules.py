@@ -625,6 +625,143 @@ def check_latency_aggregation(project: Project) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# GL013 — unbounded hand-rolled queues
+# ---------------------------------------------------------------------------
+
+# An unbounded queue.Queue() (or deque used as an inter-thread buffer)
+# between a producer and a consumer is backpressure deferred to the OOM
+# killer: when the consumer falls behind, the channel grows without
+# limit and nothing upstream ever learns. The serving queue
+# (serve/queue.py: token-budgeted lanes + load shedding) and the
+# cross-stage boundary (dist/boundary.py: credit-based flow control +
+# schema'd ``backpressure`` events) are the two sanctioned channel
+# implementations — everything else in library code must either bound
+# its buffer (Queue(maxsize=...), deque(maxlen=...)) or go through
+# them.
+_GL013_QUEUE_CLASSES = frozenset({
+    "queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+    "queue.SimpleQueue",  # SimpleQueue has no maxsize at all
+})
+_GL013_DEQUE = "collections.deque"
+# sanctioned channel modules, matched by path suffix so fixture trees
+# can carry their own twins as negative controls (the GL010/011 pattern)
+_GL013_SANCTIONED_SUFFIXES = ("dist/boundary.py", "serve/queue.py")
+_GL013_EXEMPT_SEGMENTS = frozenset({"scripts", "tests", "demo"})
+
+
+def _gl013_positive_bound(node: ast.Call, *, kwarg: str,
+                          positional_index: int) -> bool:
+    """True when the construction carries a bound: a POSITIVE constant,
+    or ANY non-constant expression (a computed bound is a bound the
+    author thought about). ``maxsize=-1`` is Python's idiomatic
+    *explicitly infinite* queue — the exact pattern this rule exists to
+    catch — so non-positive constants (None/0/negatives) never count."""
+    candidates = [kw.value for kw in node.keywords if kw.arg == kwarg]
+    if len(node.args) > positional_index:
+        candidates.append(node.args[positional_index])
+    for value in candidates:
+        if isinstance(value, ast.Constant):
+            if isinstance(value.value, (int, float)) and not isinstance(
+                value.value, bool
+            ) and value.value > 0:
+                return True
+        elif isinstance(value, ast.UnaryOp) and isinstance(
+            value.op, ast.USub
+        ) and isinstance(value.operand, ast.Constant):
+            continue  # -N parses as USub(Constant): explicitly unbounded
+        else:
+            return True  # computed bound
+    return False
+
+
+def _gl013_module_threads(mod) -> bool:
+    """Does the module deal in threads (import threading/queue)? The
+    inter-thread signal that turns a bare deque() from a scratch list
+    into a channel candidate."""
+    return any(
+        target == "threading" or target.startswith("threading.")
+        for target in mod.imports.values()
+    )
+
+
+@register(
+    "GL013",
+    "unbounded hand-rolled queue in library code: queue.Queue()/deque() used "
+    "as an inter-thread channel without a maxsize/maxlen bound — bound it, or "
+    "route through the sanctioned channels (serve/queue.py's token-budgeted "
+    "lanes, dist/boundary.py's credit-based boundary)",
+)
+def check_unbounded_queues(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.modules.values():
+        segments = mod.path.split("/")[:-1]
+        if mod.is_test_file or any(
+            s in _GL013_EXEMPT_SEGMENTS for s in segments
+        ):
+            continue
+        if any(
+            mod.path == s or mod.path == s.split("/")[-1]
+            or mod.path.endswith("/" + s)
+            for s in _GL013_SANCTIONED_SUFFIXES
+        ):
+            continue
+        module_threaded = _gl013_module_threads(mod)
+        spans = sorted(
+            (
+                (fn.lineno, getattr(fn.node, "end_lineno", fn.lineno), fn)
+                for fn in mod.functions.values()
+            ),
+            key=lambda t: t[1] - t[0],
+        )
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if not name:
+                continue
+            head, sep, rest = name.partition(".")
+            target = mod.imports.get(head)
+            resolved = (f"{target}.{rest}" if sep else target) if target else name
+            if resolved in _GL013_QUEUE_CLASSES:
+                if resolved != "queue.SimpleQueue" and _gl013_positive_bound(
+                    node, kwarg="maxsize", positional_index=0
+                ):
+                    continue
+                what = (
+                    f"{resolved}() has no size bound at all"
+                    if resolved == "queue.SimpleQueue"
+                    else f"unbounded {resolved}() (no positive maxsize)"
+                )
+            elif resolved == _GL013_DEQUE and module_threaded:
+                # deque(maxlen=...) is bounded; deque(iterable, maxlen)
+                # passes it positionally
+                if _gl013_positive_bound(node, kwarg="maxlen",
+                                         positional_index=1):
+                    continue
+                what = (
+                    "unbounded deque() in a threading module (an "
+                    "inter-thread buffer without a maxlen)"
+                )
+            else:
+                continue
+            symbol = "<module>"
+            for lo, hi, fn in spans:
+                if lo <= node.lineno <= hi:
+                    symbol = fn.qualname
+                    break
+            findings.append(Finding(
+                "GL013", mod.path, node.lineno, symbol,
+                f"{what}: a producer that outruns its consumer grows this "
+                "buffer until the OOM killer is the backpressure. Bound it "
+                "(maxsize/maxlen), or route the flow through the sanctioned "
+                "channels — serve/queue.py (token-budgeted lanes + load "
+                "shedding) or dist/boundary.py (credit-based flow control "
+                "with backpressure events)",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # GL010 — profiler trace hygiene
 # ---------------------------------------------------------------------------
 

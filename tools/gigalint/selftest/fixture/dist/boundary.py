@@ -1,0 +1,14 @@
+"""GL013 negative control (never imported — parsed only).
+
+Same unbounded ``queue.Queue()`` as ``../models/channels.py``, but this
+module's path ends in ``dist/boundary.py`` — the sanctioned credit-based
+cross-stage channel — so no finding may fire here."""
+
+import queue
+import threading
+
+
+def negative_control_sanctioned_channel(producer):
+    channel = queue.Queue()
+    threading.Thread(target=producer, args=(channel,)).start()
+    return channel.get()

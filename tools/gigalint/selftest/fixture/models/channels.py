@@ -1,0 +1,53 @@
+"""Seeded GL013 violations: unbounded hand-rolled inter-thread channels
+(queue.Queue() with no maxsize, bare deque() in a threading module),
+plus the bounded negative controls the rule must NOT flag."""
+
+import queue
+import threading
+from collections import deque
+
+
+def unbounded_queue_channel(producer):
+    """SEEDED GL013: queue.Queue() with no maxsize — the consumer
+    falling behind grows this without limit."""
+    channel = queue.Queue()
+    threading.Thread(target=producer, args=(channel,)).start()
+    return channel.get()
+
+
+def unbounded_deque_channel(items):
+    """SEEDED GL013: bare deque() as the buffer between threads."""
+    buf = deque()
+    for item in items:
+        buf.append(item)
+    return buf
+
+
+def unbounded_queue_negative_maxsize(producer):
+    """SEEDED GL013: maxsize=-1 is Python's EXPLICITLY infinite queue —
+    a negative constant is not a bound."""
+    channel = queue.Queue(maxsize=-1)
+    threading.Thread(target=producer, args=(channel,)).start()
+    return channel.get()
+
+
+def negative_control_bounded_queue(producer):
+    """maxsize bounds the channel: the producer blocks, no finding."""
+    channel = queue.Queue(maxsize=8)
+    threading.Thread(target=producer, args=(channel,)).start()
+    return channel.get()
+
+
+def negative_control_bounded_deque(items):
+    """deque(maxlen=...) is a ring, not an unbounded channel."""
+    buf = deque(maxlen=64)
+    for item in items:
+        buf.append(item)
+    return buf
+
+
+def negative_control_computed_bound(producer, depth):
+    """A computed bound is a bound the author thought about."""
+    channel = queue.Queue(maxsize=depth)
+    threading.Thread(target=producer, args=(channel,)).start()
+    return channel.get()

@@ -1,0 +1,12 @@
+"""GL013 negative control: a bare deque() in a module with NO threading
+import is a scratch collection, not an inter-thread channel — no
+finding may fire here."""
+
+from collections import deque
+
+
+def negative_control_deque_without_threads(items):
+    window = deque()
+    for item in items:
+        window.append(item)
+    return list(window)

@@ -1,0 +1,14 @@
+"""GL013 negative control (never imported — parsed only).
+
+The fixture twin of the OTHER sanctioned channel path: this module's
+path ends in ``serve/queue.py`` (the token-budgeted serving lanes), so
+its unbounded buffer draws no finding."""
+
+import threading
+from collections import deque
+
+
+def negative_control_sanctioned_lane():
+    lane = deque()
+    lock = threading.Lock()
+    return lane, lock
