@@ -549,17 +549,14 @@ def _unlink_quiet(path: str) -> None:
 # assembly
 # ---------------------------------------------------------------------------
 
-class SlideAssembler:
-    """Chunks -> the dense ``[n_tiles, D]`` tile-embedding sequence.
+class ChunkTracker:
+    """Delivery-set bookkeeping (expect / dedup-add / received /
+    missing / complete) — the recovery-critical half every consumer
+    needs regardless of what it does with the payloads. The streaming
+    (chunked-prefill) consumer uses it bare: the session, not a dense
+    array, holds the slide."""
 
-    Placement is by the chunk's tile range, so arrival order and the
-    identity of the producing worker are irrelevant to the assembled
-    bytes — the bit-parity half of the recovery contract."""
-
-    def __init__(self, n_tiles: int, dim: int, *, coords_dim: int = 2):
-        self.n_tiles = int(n_tiles)
-        self.embeds = np.zeros((n_tiles, dim), np.float32)
-        self.coords = np.zeros((n_tiles, coords_dim), np.float32)
+    def __init__(self):
         self._have: set = set()
         self._expected: Optional[set] = None
 
@@ -567,13 +564,10 @@ class SlideAssembler:
         self._expected = set(int(c) for c in chunk_ids)
 
     def add(self, chunk: EmbeddingChunk) -> bool:
-        """Place one chunk; returns False for a chunk id already placed
-        (belt under the channel's dedup suspenders)."""
+        """Record one delivery; returns False for a chunk id already
+        seen (belt under the channel's dedup suspenders)."""
         if chunk.chunk_id in self._have:
             return False
-        self.embeds[chunk.start:chunk.stop] = chunk.payload
-        if chunk.coords is not None:
-            self.coords[chunk.start:chunk.stop] = chunk.coords
         self._have.add(chunk.chunk_id)
         return True
 
@@ -588,3 +582,26 @@ class SlideAssembler:
 
     def complete(self) -> bool:
         return self._expected is not None and not self.missing()
+
+
+class SlideAssembler(ChunkTracker):
+    """Chunks -> the dense ``[n_tiles, D]`` tile-embedding sequence.
+
+    Placement is by the chunk's tile range, so arrival order and the
+    identity of the producing worker are irrelevant to the assembled
+    bytes — the bit-parity half of the recovery contract."""
+
+    def __init__(self, n_tiles: int, dim: int, *, coords_dim: int = 2):
+        super().__init__()
+        self.n_tiles = int(n_tiles)
+        self.embeds = np.zeros((n_tiles, dim), np.float32)
+        self.coords = np.zeros((n_tiles, coords_dim), np.float32)
+
+    def add(self, chunk: EmbeddingChunk) -> bool:
+        """Place one chunk (tracker dedup first)."""
+        if not super().add(chunk):
+            return False
+        self.embeds[chunk.start:chunk.stop] = chunk.payload
+        if chunk.coords is not None:
+            self.coords[chunk.start:chunk.stop] = chunk.coords
+        return True

@@ -36,6 +36,7 @@ import numpy as np
 
 from gigapath_tpu.dist.boundary import (
     BoundaryConfig,
+    ChunkTracker,
     DirChannelConsumer,
     SlideAssembler,
     assign_chunks,
@@ -54,17 +55,74 @@ def default_plan(*, slide_id: str = "slide0", n_tiles: int = 64,
                  workers: Optional[List[str]] = None, tile_seed: int = 0,
                  encoder_seed: int = 7, lease_s: float = 1.0,
                  credits: int = 4, retransmit_s: float = 0.5,
-                 poll_s: float = 0.02) -> dict:
+                 poll_s: float = 0.02,
+                 chunked_prefill: bool = False) -> dict:
     """The dryrun's plan document (written to ``<root>/plan.json``,
-    read by every process — the shared deterministic truth)."""
+    read by every process — the shared deterministic truth).
+    ``chunked_prefill`` puts the consumer in streaming mode: chunks fold
+    into the slide encoder on arrival instead of assembling the dense
+    sequence (the plan carries the mode so every process agrees)."""
     return dict(
         slide_id=slide_id, n_tiles=int(n_tiles), dim_in=int(dim_in),
         dim_out=int(dim_out), chunk_tiles=int(chunk_tiles),
         workers=sorted(workers or ["w0", "w1"]), tile_seed=int(tile_seed),
         encoder_seed=int(encoder_seed), lease_s=float(lease_s),
         credits=int(credits), retransmit_s=float(retransmit_s),
-        poll_s=float(poll_s),
+        poll_s=float(poll_s), chunked_prefill=bool(chunked_prefill),
     )
+
+
+def _default_streaming_forward():
+    """The dryrun slide stage in CHUNKED-PREFILL form: the same tiny
+    encoder + classifier params as :func:`_default_forward` (same stage
+    mesh placement), but consumed through a
+    :class:`~gigapath_tpu.models.streaming_encoder.StreamingEncoderSession`
+    so the consumer folds ``EmbeddingChunk``s on arrival instead of
+    assembling the dense ``[n_tiles, D]`` sequence first. Returns
+    ``build(dim_in) -> (open_session(n_tiles, chunk_tiles), head_fn)``;
+    ``head_fn`` maps the session's per-layer embeds to the same logits
+    the dense forward emits (the parity/bit-exactness surface)."""
+    import jax
+
+    from gigapath_tpu.dist.stagemesh import stage_mesh, stage_param_shardings
+    from gigapath_tpu.models.classification_head import get_model
+    from gigapath_tpu.models.streaming_encoder import StreamingEncoderSession
+    from gigapath_tpu.serve.streaming import streaming_head_logits
+    from gigapath_tpu.utils.registry import create_model_from_registry
+
+    def build(dim_in: int):
+        model, params = get_model(
+            input_dim=dim_in, latent_dim=32, feat_layer="1", n_classes=2,
+            model_arch="gigapath_slide_enc_tiny", dtype=None,
+        )
+        mesh = stage_mesh("slide_encoder", devices=jax.devices()[:1])
+        params = jax.device_put(
+            params, stage_param_shardings("slide_encoder", params, mesh)
+        )
+        inner = create_model_from_registry(
+            "gigapath_slide_enc_tiny", in_chans=dim_in, global_pool=False,
+            dtype=None,
+        )
+
+        def open_session(n_tiles: int, chunk_tiles: int, runlog=None):
+            # runlog -> per-stage CompileWatchdogs inside the session:
+            # streaming recovery must never hide a retrace, same as the
+            # dense consumer's watched forward
+            return StreamingEncoderSession(
+                inner, params["slide_encoder"], n_tiles,
+                chunk_tiles=chunk_tiles, all_layer_embed=True,
+                runlog=runlog,
+            )
+
+        def head(embeds):
+            # the ONE classifier-tail implementation (serve/streaming.py)
+            # keeps the dist parity surface and the serving path in
+            # lockstep
+            return streaming_head_logits(model, params, embeds)[0]
+
+        return open_session, head
+
+    return build
 
 
 def _default_forward():
@@ -100,9 +158,21 @@ def _default_forward():
 
 def run_slide_consumer(root: str, *, runlog=None,
                        forward_builder: Optional[Callable] = None,
+                       streaming: Optional[bool] = None,
+                       streaming_builder: Optional[Callable] = None,
                        deadline_s: float = 120.0,
                        worker_probe: Optional[Callable] = None) -> dict:
     """Assemble one slide from the channel, recovering from worker loss.
+
+    ``streaming`` (default: the plan's ``chunked_prefill`` field, else
+    the ``GIGAPATH_CHUNKED_PREFILL`` snapshot) switches the consumer to
+    chunked prefill: each acked ``EmbeddingChunk`` folds into a
+    :class:`~gigapath_tpu.models.streaming_encoder.StreamingEncoderSession`
+    the moment the fold frontier reaches it — arrival order, retransmits
+    and reassignment all tolerated, with the fold sequence (and so the
+    embedding, BIT-exact) a pure function of the deterministic chunk
+    plan. The dense ``[n_tiles, D]`` sequence is never assembled in this
+    mode (``assembled``/``coords`` come back None).
 
     ``worker_probe`` (optional): zero-arg callable returning
     ``{worker_id: exit_code_or_None}`` for workers whose OS processes
@@ -131,10 +201,35 @@ def run_slide_consumer(root: str, *, runlog=None,
                     "workers": plan["workers"],
                     "chunk_tiles": cfg.chunk_tiles},
         )
+    if streaming is None:
+        # one host-side read, the PipelineFlags convention: the plan
+        # document wins (every process sees the same mode), the env
+        # snapshot is the single-process default
+        if "chunked_prefill" in plan:
+            streaming = bool(plan["chunked_prefill"])
+        else:
+            from gigapath_tpu.ops.pallas_dilated import snapshot_flags
+
+            streaming = snapshot_flags().chunked_prefill
     consumer = DirChannelConsumer(root, cfg, runlog=runlog)
     membership = Membership(root, runlog=runlog)
     chunks = plan_chunks(int(plan["n_tiles"]), cfg.chunk_tiles)
-    assembler = SlideAssembler(int(plan["n_tiles"]), int(plan["dim_out"]))
+    session = None
+    head_fn = None
+    if streaming:
+        build = streaming_builder or _default_streaming_forward()
+        open_session, head_fn = build(int(plan["dim_out"]))
+        session = open_session(int(plan["n_tiles"]), cfg.chunk_tiles,
+                               runlog=runlog)
+        runlog.event("stream_open", slide=plan["slide_id"],
+                     n_chunks=session.n_chunks,
+                     chunk_tiles=cfg.chunk_tiles)
+        # received-chunk bookkeeping only (recovery needs the set of
+        # delivered chunk ids) — the dense buffers are exactly what
+        # streaming mode exists to not allocate
+        assembler = ChunkTracker()
+    else:
+        assembler = SlideAssembler(int(plan["n_tiles"]), int(plan["dim_out"]))
     assembler.expect([c[0] for c in chunks])
 
     # who currently owns which chunk (updated by reassignments): the
@@ -189,19 +284,31 @@ def run_slide_consumer(root: str, *, runlog=None,
             if chunk is None:
                 continue
             consumer.ack(chunk.seq)
-            assembler.add(chunk)
+            if assembler.add(chunk) and session is not None:
+                # fold on arrival: the session frontier-buffers
+                # out-of-order deliveries, so the executed fold order —
+                # and the embedding, bit-exact — is the plan's, not the
+                # network's. This overlaps stage-1 production with
+                # stage-2 folding; by completion only the final layers
+                # remain.
+                session.feed(chunk.chunk_id, chunk.payload, chunk.coords)
 
-        # the slide forward: jitted once, retraces watched — recovery
-        # must never show up as a recompile
-        build = forward_builder or _default_forward()
-        forward, params = build(int(plan["dim_out"]))
-        watchdog = CompileWatchdog("dist.slide_forward", runlog)
-        instrumented = watchdog.wrap(forward)
-        embedding = np.asarray(
-            instrumented(params, assembler.embeds[None],
-                         assembler.coords[None]),
-            np.float32,
-        )[0]
+        if session is not None:
+            embedding = head_fn(session.finalize())
+            runlog.event("stream_finalize", slide=plan["slide_id"],
+                         n_chunks=session.n_chunks)
+        else:
+            # the dense slide forward: jitted once, retraces watched —
+            # recovery must never show up as a recompile
+            build = forward_builder or _default_forward()
+            forward, params = build(int(plan["dim_out"]))
+            watchdog = CompileWatchdog("dist.slide_forward", runlog)
+            instrumented = watchdog.wrap(forward)
+            embedding = np.asarray(
+                instrumented(params, assembler.embeds[None],
+                             assembler.coords[None]),
+                np.float32,
+            )[0]
     except BaseException:
         status = "error"
         raise
@@ -217,11 +324,12 @@ def run_slide_consumer(root: str, *, runlog=None,
             )
     return {
         "embedding": embedding,
-        "assembled": assembler.embeds,
-        "coords": assembler.coords,
+        "assembled": None if session is not None else assembler.embeds,
+        "coords": None if session is not None else assembler.coords,
         "stats": consumer.stats.as_dict(),
         "lost": membership.lost(),
         "reassignments": reassignments,
+        "streaming": session is not None,
     }
 
 

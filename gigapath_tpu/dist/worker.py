@@ -23,6 +23,13 @@ goodbye, the lease just stops renewing) after K produced chunks;
 table must surface); ``drop_chunk@K`` / ``dup_chunk@K`` act inside the
 channel's send.
 
+Chunk production order matters to nobody downstream: the consumer
+either assembles by tile range (dense mode) or folds at the
+deterministic chunk-id frontier (``plan.chunked_prefill`` streaming
+mode, ISSUE 12) — so retransmits, reassignment and interleaved
+production from a multi-worker fleet all yield the identical slide
+embedding, bit-exact.
+
 The dryrun encoder is numpy (a fixed seeded projection + tanh): bitwise
 deterministic across processes, imports in milliseconds, and keeps the
 protocol layer provably free of traced code. The real ViT-G tile

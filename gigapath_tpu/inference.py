@@ -163,6 +163,21 @@ def _feature_stream(feature_files, prefetch: int, runlog):
         loader.join(timeout=10)
 
 
+def _coords_or_zeros(feats, coords, runlog, warned: list):
+    """The ONE coords-defaulting policy for every inference path: None
+    becomes zeros (positional signal collapses to one grid cell), with
+    one warning per run (``warned`` is the shared mutable flag)."""
+    if coords is None:
+        if not warned:
+            runlog.echo(
+                "Warning: feature files carry no coords; using zeros "
+                "(positional signal collapses to one grid cell)"
+            )
+            warned.append(True)
+        coords = np.zeros((feats.shape[0], 2), np.float32)
+    return np.asarray(coords, np.float32)
+
+
 def _results_df(results, output_file, runlog, **run_end_fields):
     """Shared CSV + summary tail of both inference paths. A write
     failure (disk full, permissions) is contained like any other run
@@ -227,7 +242,7 @@ def _run_inference_bucketed(model, params, feature_files, output_file,
     service = SlideService(forward, params, config=config, runlog=runlog,
                            identity=identity, name="serve")
     results = []
-    warned = False
+    warned: list = []
     exact_forward = None  # lazily jitted; only oversized slides pay it
     try:
         with Heartbeat(runlog, name="inference") as heartbeat:
@@ -235,14 +250,9 @@ def _run_inference_bucketed(model, params, feature_files, output_file,
             for idx, path, feats, coords in _feature_stream(
                 feature_files, prefetch, runlog
             ):
-                if coords is None and not warned:
-                    runlog.echo(
-                        "Warning: feature files carry no coords; using zeros "
-                        "(positional signal collapses to one grid cell)"
-                    )
-                    warned = True
                 slide_id = os.path.basename(path).replace("_features.pt", "")
                 feats = np.asarray(feats, np.float32)
+                coords = _coords_or_zeros(feats, coords, runlog, warned)
                 if feats.shape[0] > service.ladder.rungs[-1]:
                     # larger than the ladder's top rung: submit() would
                     # refuse it and abort the run — serve THIS slide on
@@ -263,11 +273,9 @@ def _run_inference_bucketed(model, params, feature_files, output_file,
                                 {"params": p}, e, c, deterministic=True
                             )
                         )
-                    c = (np.zeros((feats.shape[0], 2), np.float32)
-                         if coords is None
-                         else np.asarray(coords, np.float32))
                     logits = np.asarray(exact_forward(
-                        params, jnp.asarray(feats[None]), jnp.asarray(c[None])
+                        params, jnp.asarray(feats[None]),
+                        jnp.asarray(coords[None])
                     ), np.float32)[0]
                     fut: Future = Future()
                     fut.set_result(logits)
@@ -316,6 +324,72 @@ def _run_inference_bucketed(model, params, feature_files, output_file,
     )
 
 
+def _run_inference_streaming(model, params, feature_files, output_file,
+                             runlog, chunk_tiles: int, prefetch: int = 0):
+    """Streaming chunked-prefill path (``--stream``): every slide folds
+    through chunk-shaped stage executables via the serve streaming
+    submitter — slide-encoder attention temporaries stay O(chunk)
+    regardless of tile count, and slides of EVERY length share the same
+    compiled programs (the exact-shape path compiles per distinct N;
+    the bucket path pads to a rung). ``--prefetch`` composes: the
+    loader thread runs ahead through the bounded dist-boundary channel
+    while resident slides fold. The bucketed and exact paths remain the
+    fallbacks and the parity oracles."""
+    from gigapath_tpu.serve.streaming import (
+        head_streaming_submitter,
+        streaming_head_logits,
+    )
+
+    submitter = head_streaming_submitter(
+        model, params, chunk_tiles=chunk_tiles or None, runlog=runlog,
+    )
+    metrics = get_metrics(runlog)
+    slide_walls = metrics.histogram("inference.slide_wall_s")
+    results = []
+    warned: list = []
+    try:
+        with Heartbeat(runlog, name="inference") as heartbeat:
+            for idx, path, feats, coords in _feature_stream(
+                feature_files, prefetch, runlog
+            ):
+                slide_id = os.path.basename(path).replace("_features.pt", "")
+                feats = np.asarray(feats, np.float32)
+                coords = _coords_or_zeros(feats, coords, runlog, warned)
+                with span("slide", runlog, fence=True) as sp:
+                    session = submitter.open(slide_id, feats.shape[0])
+                    for i, (a, b) in enumerate(session.session.tile_bounds):
+                        session.feed(i, feats[a:b], coords[a:b])
+                    logits = sp.fence(streaming_head_logits(
+                        model, params, session.result()
+                    ))
+                probs = np.asarray(jax.nn.softmax(
+                    jnp.asarray(logits), axis=-1))[0]
+                pred = int(probs.argmax())
+                results.append({
+                    "slide_id": slide_id,
+                    "predicted_label": pred,
+                    "confidence": float(probs[pred]),
+                })
+                runlog.step(
+                    idx, wall_s=sp.dur_s, synced=True,
+                    n_tiles=int(feats.shape[0]),
+                    n_chunks=session.session.n_chunks,
+                    predicted_label=pred, confidence=float(probs[pred]),
+                )
+                if sp.dur_s is not None:
+                    slide_walls.observe(sp.dur_s)
+                metrics.maybe_flush()
+                heartbeat.beat(idx)
+    except Exception as e:
+        fail_run(runlog, "inference.run_inference", e)
+        raise
+    return _results_df(
+        results, output_file, runlog,
+        streamed_slides=submitter.served,
+        chunk_tiles=submitter.chunk_tiles,
+    )
+
+
 def run_inference(
     model,
     params,
@@ -325,6 +399,8 @@ def run_inference(
     use_buckets: bool = True,
     batch_size: int = 16,
     prefetch: int = 0,
+    stream: bool = False,
+    stream_chunk: int = 0,
 ):
     """Classify every ``*_features.pt`` in ``feature_dir``
     (reference ``run_inference:37-79``). ``use_buckets`` routes through
@@ -341,8 +417,14 @@ def run_inference(
         "inference", out_dir=os.path.dirname(os.path.abspath(output_file)),
         config={"feature_dir": feature_dir, "output_file": output_file,
                 "n_slides": len(feature_files), "buckets": bool(use_buckets),
-                "batch_size": int(batch_size), "prefetch": int(prefetch)},
+                "batch_size": int(batch_size), "prefetch": int(prefetch),
+                "stream": bool(stream)},
     )
+    if stream:
+        return _run_inference_streaming(
+            model, params, feature_files, output_file, runlog,
+            chunk_tiles=int(stream_chunk), prefetch=prefetch,
+        )
     if use_buckets:
         return _run_inference_bucketed(
             model, params, feature_files, output_file, runlog, batch_size,
@@ -365,7 +447,7 @@ def run_inference(
     slide_walls = metrics.histogram("inference.slide_wall_s")
 
     results = []
-    warned = False
+    warned: list = []
     try:
         with Heartbeat(runlog, name="inference") as heartbeat:
             for idx, path in enumerate(feature_files):
@@ -373,16 +455,9 @@ def run_inference(
                 # device execution for this slide
                 with span("slide", runlog, fence=True) as sp:
                     feats, coords = _load_features(path)
+                    coords = _coords_or_zeros(feats, coords, runlog,
+                                              warned)[None]
                     feats = feats[None]  # [1, N, D]
-                    if coords is None:
-                        if not warned:
-                            runlog.echo(
-                                "Warning: feature files carry no coords; using zeros "
-                                "(positional signal collapses to one grid cell)"
-                            )
-                            warned = True
-                        coords = np.zeros((feats.shape[1], 2), np.float32)
-                    coords = np.asarray(coords, np.float32)[None]
                     logits = np.asarray(
                         sp.fence(instrumented_forward(
                             params, jnp.asarray(feats), jnp.asarray(coords)
@@ -435,6 +510,18 @@ def main(argv=None):
         "distinct tile count, no batching, no padding",
     )
     parser.add_argument(
+        "--stream", action="store_true",
+        help="Streaming chunked prefill: fold each slide through "
+        "chunk-shaped stage executables (O(chunk) attention "
+        "temporaries, one compiled program set for every slide "
+        "length). Defaults ON when GIGAPATH_CHUNKED_PREFILL is set.",
+    )
+    parser.add_argument(
+        "--stream-chunk", type=int, default=0,
+        help="Tiles per streaming-prefill chunk (0 = the "
+        "GIGAPATH_PREFILL_CHUNK host flag, default 2048)",
+    )
+    parser.add_argument(
         "--prefetch", type=int, default=0,
         help="Overlap feature-file IO with dispatch: a loader thread "
         "runs at most this many slides ahead through the dist "
@@ -447,10 +534,16 @@ def main(argv=None):
     model, params = load_model(
         args.model_path, n_classes=args.num_classes, model_arch=args.model_arch
     )
+    # GIGAPATH_CHUNKED_PREFILL makes streaming the default route (one
+    # host-side snapshot, the PipelineFlags convention)
+    from gigapath_tpu.ops.pallas_dilated import snapshot_flags
+
+    stream = bool(args.stream or snapshot_flags().chunked_prefill)
     return run_inference(
         model, params, args.feature_dir, args.output_file,
         use_buckets=not args.no_buckets, batch_size=args.batch_size,
-        prefetch=args.prefetch,
+        prefetch=args.prefetch, stream=stream,
+        stream_chunk=args.stream_chunk,
     )
 
 

@@ -15,7 +15,12 @@ TPU-first deltas:
   same log2-spaced schedule but as ints, and the model is built for a padded
   power-of-two bucket of sequence lengths so jit recompilation is bounded;
 - bf16 activations via ``dtype=jnp.bfloat16`` replace fp16 GradScaler
-  autocast.
+  autocast;
+- a chunk-granular entry (:func:`create_streaming_session`, streaming
+  chunked prefill): tile chunks fold into the encoder as they arrive
+  instead of assembling the dense ``[B, L, D]`` sequence first — the
+  ``__call__`` path below stays the fallback and parity oracle
+  (:mod:`gigapath_tpu.models.streaming_encoder`).
 """
 
 from __future__ import annotations
@@ -232,6 +237,30 @@ def gigapath_slide_enc_tiny(**kwargs):
             dilated_ratio="[1, 2]",
         ),
         kwargs,
+    )
+
+
+def create_streaming_session(
+    model: LongNetViT,
+    params,
+    n_tiles: int,
+    *,
+    chunk_tiles: Optional[int] = None,
+    all_layer_embed: bool = False,
+):
+    """The chunk-granular ``LongNetViT`` entry (streaming chunked
+    prefill): returns a
+    :class:`~gigapath_tpu.models.streaming_encoder.StreamingEncoderSession`
+    whose ``feed(idx, tile_embeds, coords)`` consumes the deterministic
+    chunk plan in any arrival order and whose ``finalize()`` returns the
+    same output list as ``model.apply`` — which remains the dense
+    fallback and parity oracle. ``chunk_tiles`` defaults to the
+    ``GIGAPATH_PREFILL_CHUNK`` host flag."""
+    from gigapath_tpu.models.streaming_encoder import StreamingEncoderSession
+
+    return StreamingEncoderSession(
+        model, params, n_tiles, chunk_tiles=chunk_tiles,
+        all_layer_embed=all_layer_embed,
     )
 
 

@@ -57,6 +57,12 @@ _DIRECTION_RULES: Tuple[Tuple[str, str], ...] = (
     ("_p95_s", "down"),
     ("_p99_s", "down"),
     ("compile_seconds_total", "down"),
+    # streaming-prefill decision-table rows (prefill|stream entry):
+    # executable arg/temp/peak megabytes and stream-vs-dense ratios,
+    # smaller is better
+    ("_mb", "down"),
+    ("temp_ratio", "down"),
+    ("peak_ratio", "down"),
     ("vs_baseline", "up"),
     ("mfu", "up"),
     ("value", "up"),          # bench payload primary metric
@@ -287,6 +293,29 @@ def fold_dist(doc: dict, snapshot: dict, label: str,
     return _fold_serve_snapshot(
         doc, snapshot, label, key="dist|smoke",
         metric_keys=_DIST_METRICS, source=source, force=force,
+    )
+
+
+# long_context_smoke --stream payload fields worth trending: the
+# streaming-vs-dense memory decision table (per-variant XLA
+# memory-analysis MB + walltime) behind the adopt_chunked_prefill row
+_PREFILL_METRICS = (
+    "stream_arg_mb", "stream_temp_mb", "stream_peak_mb",
+    "dense_arg_mb", "dense_temp_mb", "dense_peak_mb",
+    "temp_ratio", "peak_ratio",
+    "stream_wall_s", "dense_wall_s",
+)
+
+
+def fold_prefill(doc: dict, snapshot: dict, label: str,
+                 source: Optional[str] = None, force: bool = False) -> dict:
+    """One ``long_context_smoke --stream`` JSON -> one point under
+    ``prefill|stream`` (same shared staleness policy as the serve/dist
+    entries: a CPU measurement carries the metric keys but never moves
+    the trend)."""
+    return _fold_serve_snapshot(
+        doc, snapshot, label, key="prefill|stream",
+        metric_keys=_PREFILL_METRICS, source=source, force=force,
     )
 
 

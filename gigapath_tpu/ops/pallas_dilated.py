@@ -844,12 +844,17 @@ class PipelineFlags(NamedTuple):
     # (ops/dilated_attention.py): per-shard memory O(local chunk) instead
     # of O(full segment), ppermute overlapped with partial attention
     ring_attn: bool = False
+    # streaming chunked prefill (ops/streaming_prefill.py): drivers that
+    # hold a snapshot route slide forwards through the chunk-fold path
+    # (dist consumer, inference --stream default) instead of
+    # assemble-then-encode; the dense path stays the fallback/oracle
+    chunked_prefill: bool = False
 
 
 def snapshot_flags() -> PipelineFlags:
     """Read GIGAPATH_PIPELINED_ATTN/_BWD, GIGAPATH_PIPE(_BWD)_BLOCK_K,
-    GIGAPATH_PACK_DIRECT, GIGAPATH_STREAM_FUSION and GIGAPATH_RING_ATTN
-    from the environment, once."""
+    GIGAPATH_PACK_DIRECT, GIGAPATH_STREAM_FUSION, GIGAPATH_RING_ATTN and
+    GIGAPATH_CHUNKED_PREFILL from the environment, once."""
     import os
 
     from gigapath_tpu.ops.common import env_flag
@@ -866,6 +871,7 @@ def snapshot_flags() -> PipelineFlags:
         pack_direct=env_flag("GIGAPATH_PACK_DIRECT"),
         stream_fusion=env_flag("GIGAPATH_STREAM_FUSION"),
         ring_attn=env_flag("GIGAPATH_RING_ATTN"),
+        chunked_prefill=env_flag("GIGAPATH_CHUNKED_PREFILL"),
     )
 
 

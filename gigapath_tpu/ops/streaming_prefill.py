@@ -1,0 +1,484 @@
+"""Streaming chunked prefill: fold tile chunks into dilated attention
+without ever materializing the slide sequence.
+
+The slide encoder's dense path wants the whole ``[B, L, D]``
+tile-embedding sequence resident before step one — at 10^5-10^6 tiles
+per slide (PAPER.md §0) that is the last assemble-then-encode memory
+wall. This module extends the stored-LSE online-softmax merge that
+already powers the ring schedule and the stream-fusion epilogue
+(:func:`~gigapath_tpu.ops.flash_attention.partial_attention` +
+:func:`~gigapath_tpu.ops.flash_attention.combine_partials`) to the
+INGEST axis: tile chunks arrive (from the tile encoder, the
+``inference.py`` prefetch loader, or the ``dist/`` boundary), each new
+chunk is attended against every already-resident chunk it shares a
+dilated segment with, and the chunk-normalized partials fold into
+running per-branch ``(out, lse)`` accumulators. Per-layer attention
+TEMPORARIES are O(chunk^2 logits) regardless of slide length; the only
+O(L) state is the accumulator/output itself — the same asymptotics flash
+attention buys within one kernel, here bought across the ingest stream.
+
+Semantics (kept in lockstep with ``ops/dilated_attention.py`` — the
+dense path remains the fallback and the parity oracle):
+
+- a branch ``(segment_length sl, ratio r)`` chops the sequence into
+  segments of ``g = min(sl, L)``; within a segment, head ``h`` of phase
+  ``p = h // ceil(H/r)`` covers exactly the positions with
+  ``(pos % g) % r == p`` — as queries AND as keys. Uncovered query rows
+  carry ``lse ~ NEG_INF`` so the cross-branch fusion gives them zero
+  weight (the ``sparse_to_dense`` contract, expressed as masks instead
+  of slices);
+- partials over disjoint key CHUNKS of one branch merge through
+  ``combine_partials`` (exact: softmax is associative under the stored
+  LSE), so the within-branch math equals one softmax over the union;
+- branches fuse by the same online softmax over the branch axis as
+  ``dilated_attention_fused(streaming_fusion=True)``, with
+  ``stop_gradient`` on the fusion weights (reference ``torch.no_grad``
+  parity), so gradients match the dense oracle too.
+
+Bit-exactness contract: :class:`StreamingPrefillState` folds chunks in
+STRICT index order (``ingest`` asserts it). Floating-point combine is
+not associative, so order-independence cannot come from the math — it
+comes from the schedule: callers receiving chunks out of order (the
+dist boundary under retransmits/reassignment) hold them in a frontier
+buffer and fold at the deterministic frontier. Any arrival permutation
+then executes the identical op sequence, which is what makes the dist
+kill-recover check BIT-exact in streaming mode. The frontier buffer is
+sized by the delivery REORDER WINDOW, not the slide: in-order producers
+keep it at O(1) chunks, and the adversarial worst case (the first chunk
+arrives last) degrades to holding the later chunks — never worse than
+the dense assembler this path replaces, but not a hard bound; a
+transport that wants one must cap its reorder window (e.g. ack-window
+credits), which the directory channel's retransmit-by-seq already
+encourages.
+
+This module is streaming-sanctioned for gigalint GL014: chunk lists
+must never be reassembled into a dense sequence here. The one sanctioned
+exception is :func:`assemble_dense_fallback` (the oracle/fallback path),
+which the rule exempts by name.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from gigapath_tpu.ops.attention import NEG_INF
+from gigapath_tpu.ops.flash_attention import combine_partials
+
+
+# ---------------------------------------------------------------------------
+# the static chunk-fold plan
+# ---------------------------------------------------------------------------
+
+def chunk_bounds(n_tokens: int, chunk_tokens: int) -> Tuple[Tuple[int, int], ...]:
+    """``((start, stop), ...)`` covering ``[0, n_tokens)`` in order, the
+    final chunk ragged. Mirrors ``dist.boundary.plan_chunks`` (chunk ids
+    double as fold indices there) without importing the dist layer into
+    the ops layer."""
+    if n_tokens < 1 or chunk_tokens < 1:
+        raise ValueError(f"need n_tokens/chunk_tokens >= 1, got "
+                         f"{n_tokens}/{chunk_tokens}")
+    return tuple(
+        (start, min(start + chunk_tokens, n_tokens))
+        for start in range(0, n_tokens, chunk_tokens)
+    )
+
+
+def _branch_geometry(
+    total_len: int, segment_lengths: Sequence[int], dilated_ratios: Sequence[int]
+) -> Tuple[Tuple[int, int], ...]:
+    """Per-branch ``(g, r)`` with the dense path's ``g = min(sl, L)``
+    clamp. Multi-segment branches whose segment is not a multiple of the
+    ratio are refused: the dense path zero-pads each segment to a ratio
+    multiple there, a key set this masked formulation cannot express
+    (never the case for LongNet's schedules — checked, not assumed)."""
+    assert len(segment_lengths) == len(dilated_ratios)
+    branches = []
+    for sl, r in zip(segment_lengths, dilated_ratios):
+        g, r = min(int(sl), total_len), int(r)
+        if total_len > g and g % r != 0:
+            raise NotImplementedError(
+                f"streaming prefill: branch (sl={sl}, r={r}) has "
+                f"{g} % {r} != 0 with multiple segments — the dense "
+                "path's zero-pad key slots have no streaming counterpart"
+            )
+        branches.append((g, r))
+    return tuple(branches)
+
+
+def fold_plan(
+    bounds: Sequence[Tuple[int, int]], segment_len: int
+) -> Tuple[Tuple[int, ...], ...]:
+    """For each chunk index ``i``: the sorted chunk indices ``j`` whose
+    token range shares at least one ``segment_len``-segment with chunk
+    ``i`` — exactly the (query-chunk, key-chunk) pairs one branch must
+    fold. Pure trace-time integers; the pair set is a function of the
+    slide geometry alone, so every process derives the same plan."""
+    seg = [(start // segment_len, (stop - 1) // segment_len)
+           for start, stop in bounds]
+    plan = []
+    for lo_i, hi_i in seg:
+        plan.append(tuple(
+            j for j, (lo_j, hi_j) in enumerate(seg)
+            if not (hi_i < lo_j or hi_j < lo_i)
+        ))
+    return tuple(plan)
+
+
+# ---------------------------------------------------------------------------
+# one (query-chunk, key-chunk) partial of one branch
+# ---------------------------------------------------------------------------
+
+def pair_partial_attention(
+    q_blk: jnp.ndarray,
+    k_blk: jnp.ndarray,
+    v_blk: jnp.ndarray,
+    q0,
+    k0,
+    *,
+    segment_len: int,
+    ratio: int,
+    valid_len=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Chunk-normalized ``(out [B,cq,H,D], lse [B,H,cq])`` of one dilated
+    branch restricted to one resident key chunk — the ingest-axis twin of
+    :func:`~gigapath_tpu.ops.flash_attention.partial_attention`.
+
+    ``q0``/``k0`` are the chunks' global token offsets, passed as DYNAMIC
+    scalars so one compiled executable serves every pair of the same
+    block shapes (the position masks are iota comparisons). The segment
+    and dilation structure of ``ops/dilated_attention.py`` is expressed
+    as masks: key ``u`` is visible to query ``t`` of head phase ``p``
+    iff they share a segment and both sit on phase ``p``'s dilated
+    lattice; query rows off their phase's lattice come back fully
+    masked (``lse ~ NEG_INF`` -> zero weight in the branch fusion),
+    mirroring ``sparse_to_dense``'s uncovered-position contract.
+    ``valid_len`` (optional dynamic scalar) masks keys at global
+    positions >= it — the ragged/padded tail.
+    """
+    B, cq, H, Dh = q_blk.shape
+    ck = k_blk.shape[1]
+    scale = Dh ** -0.5
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q_blk, k_blk, preferred_element_type=jnp.float32
+    ).astype(jnp.float32) * scale
+
+    tq = jnp.asarray(q0, jnp.int32) + jnp.arange(cq, dtype=jnp.int32)
+    uk = jnp.asarray(k0, jnp.int32) + jnp.arange(ck, dtype=jnp.int32)
+    heads_per_group = -(-H // ratio)
+    phases = jnp.arange(H, dtype=jnp.int32) // heads_per_group  # [H]
+    same_seg = (tq[:, None] // segment_len) == (uk[None, :] // segment_len)
+    k_ok = ((uk % segment_len) % ratio)[None, :] == phases[:, None]  # [H, ck]
+    q_ok = ((tq % segment_len) % ratio)[None, :] == phases[:, None]  # [H, cq]
+    mask = same_seg[None, :, :] & k_ok[:, None, :] & q_ok[:, :, None]
+    if valid_len is not None:
+        mask = mask & (uk < jnp.asarray(valid_len, jnp.int32))[None, None, :]
+
+    s = jnp.where(mask[None], s, NEG_INF)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)  # [B, H, cq]
+    p = jnp.exp(s - lse[..., None])
+    p = jnp.where(mask[None], p, 0.0)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk,
+        preferred_element_type=jnp.float32,
+    ).astype(q_blk.dtype)
+    return out, lse
+
+
+def fold_pair(
+    acc_out: jnp.ndarray,
+    acc_lse: jnp.ndarray,
+    q_blk: jnp.ndarray,
+    k_blk: jnp.ndarray,
+    v_blk: jnp.ndarray,
+    q0,
+    k0,
+    valid_len,
+    *,
+    segment_len: int,
+    ratio: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One fold step: the pair's partial merged into the running branch
+    accumulator via the stored-LSE combine. ``acc_out`` stays fp32 end
+    to end (``combine_partials`` returns ``out_a``'s dtype). This is the
+    whole per-chunk streaming executable — its arguments and
+    temporaries are all O(chunk), never O(L), which is what the XLA
+    memory-analysis pins and the jaxpr guard assert."""
+    o, l = pair_partial_attention(
+        q_blk, k_blk, v_blk, q0, k0,
+        segment_len=segment_len, ratio=ratio, valid_len=valid_len,
+    )
+    return combine_partials(acc_out, acc_lse, o, l)
+
+
+def fuse_branch_partials(
+    outs: Sequence[jnp.ndarray],
+    lses: Sequence[jnp.ndarray],
+    out_dtype,
+) -> jnp.ndarray:
+    """Fold per-branch ``(out, lse)`` partials of ONE chunk into the
+    fused output block — the same online softmax over the branch axis as
+    ``dilated_attention_fused(streaming_fusion=True)``, weights constant
+    in backward (stop_gradient; reference ``torch.no_grad`` parity)."""
+
+    def bLH1(x):  # [B, H, c] -> broadcastable [B, c, H, 1]
+        return x.transpose(0, 2, 1)[..., None]
+
+    acc = m_run = l_run = None
+    for o, l in zip(outs, lses):
+        l = jax.lax.stop_gradient(l)
+        if acc is None:
+            m_run = l
+            l_run = jnp.ones_like(l)
+            acc = o.astype(jnp.float32)
+        else:
+            m_new = jnp.maximum(m_run, l)
+            a = jnp.exp(m_run - m_new)
+            b_ = jnp.exp(l - m_new)
+            l_run = l_run * a + b_
+            acc = acc * bLH1(a) + o.astype(jnp.float32) * bLH1(b_)
+            m_run = m_new
+    return (acc / bLH1(l_run)).astype(out_dtype)
+
+
+# ---------------------------------------------------------------------------
+# the streaming state
+# ---------------------------------------------------------------------------
+
+class StreamingPrefillState:
+    """Running per-branch ``(out, lse)`` partials over an ingest stream.
+
+    Construction fixes the geometry — chunk bounds, branch schedule,
+    total length — so the fold schedule is a pure function of the slide,
+    independent of which producer delivers which chunk when (the dist
+    boundary's bit-parity contract extended to the fold).
+
+    ``ingest(i, q, k, v)`` consumes chunk ``i``'s projected q/k/v blocks
+    in strict index order and folds every newly-completable pair: chunk
+    ``i``'s queries against each resident key chunk sharing a segment,
+    and each resident query chunk against chunk ``i``'s keys. Blocks are
+    retained only while a future chunk still needs them (branch-local
+    chunks are dropped immediately after their last fold), so retained
+    K/V — not just temporaries — stays bounded by the widest branch's
+    actual reach. ``finalize()`` fuses the branch partials per chunk and
+    returns the per-chunk output blocks — never a concatenated sequence
+    (gigalint GL014).
+    """
+
+    def __init__(
+        self,
+        bounds: Sequence[Tuple[int, int]],
+        segment_lengths: Sequence[int],
+        dilated_ratios: Sequence[int],
+        *,
+        total_len: Optional[int] = None,
+        valid_len=None,
+        jit_pairs: bool = True,
+        fold_fn=None,
+    ):
+        """``fold_fn``: optional override for the per-pair fold callable
+        (signature of :func:`fold_pair`) — how callers instrument the
+        fold executable (e.g. a ``CompileWatchdog.wrap`` so retraces
+        land on the obs bus); default is the plain jitted fold."""
+        self.bounds = tuple((int(a), int(b)) for a, b in bounds)
+        assert self.bounds and all(a < b for a, b in self.bounds)
+        self.total_len = int(total_len or self.bounds[-1][1])
+        self.branches = _branch_geometry(
+            self.total_len, segment_lengths, dilated_ratios
+        )
+        self.plans = tuple(fold_plan(self.bounds, g) for g, _ in self.branches)
+        self._valid = valid_len
+        n = len(self.bounds)
+        # last chunk index that still interacts with chunk j, any branch:
+        # past it, chunk j's q/k/v blocks are dropped
+        self._last_use = [
+            max(max(plan[j]) for plan in self.plans) for j in range(n)
+        ]
+        self._qkv: Dict[int, Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]] = {}
+        # _acc[branch][chunk] = (out fp32, lse) or None until first fold
+        self._acc: List[List[Optional[Tuple[jnp.ndarray, jnp.ndarray]]]] = [
+            [None] * n for _ in self.branches
+        ]
+        self._next = 0
+        if fold_fn is not None:
+            self._fold_fn = fold_fn
+        else:
+            self._fold_fn = (
+                jax.jit(fold_pair, static_argnames=("segment_len", "ratio"))
+                if jit_pairs else fold_pair
+            )
+        self.folds = 0  # fold-count telemetry for the obs/smoke layers
+
+    @property
+    def n_chunks(self) -> int:
+        return len(self.bounds)
+
+    @property
+    def next_index(self) -> int:
+        return self._next
+
+    def resident_blocks(self) -> int:
+        """How many chunks' q/k/v blocks are currently retained — the
+        honest memory signal the smoke reports next to the XLA pins."""
+        return len(self._qkv)
+
+    def _seed(self, i: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        q = self._qkv[i][0]
+        B, c, H, Dh = q.shape
+        out = jnp.zeros((B, c, H, Dh), jnp.float32)
+        lse = jnp.full((B, H, c), NEG_INF, jnp.float32)
+        # match the q/k/v blocks' placement: a seed left on the default
+        # SingleDeviceSharding while mesh-placed params give the blocks
+        # a NamedSharding makes the SECOND fold per shape a fresh jit
+        # cache entry (input shardings are part of the cache key) — one
+        # silent recompile per (shape, branch), caught by the stage
+        # watchdogs
+        sharding = getattr(q, "sharding", None)
+        if sharding is not None:
+            try:
+                out = jax.device_put(out, sharding)
+                lse = jax.device_put(lse, sharding)
+            except (ValueError, TypeError):
+                pass  # rank-specific spec: keep the default placement
+        return out, lse
+
+    def _fold(self, b: int, qi: int, kj: int) -> None:
+        g, r = self.branches[b]
+        acc = self._acc[b][qi]
+        if acc is None:
+            acc = self._seed(qi)
+        q_blk = self._qkv[qi][0]
+        _, k_blk, v_blk = self._qkv[kj]
+        valid = self.total_len if self._valid is None else self._valid
+        self._acc[b][qi] = self._fold_fn(
+            acc[0], acc[1], q_blk, k_blk, v_blk,
+            jnp.int32(self.bounds[qi][0]), jnp.int32(self.bounds[kj][0]),
+            jnp.int32(valid),
+            segment_len=g, ratio=r,
+        )
+        self.folds += 1
+
+    def ingest(self, idx: int, q_blk: jnp.ndarray, k_blk: jnp.ndarray,
+               v_blk: jnp.ndarray) -> None:
+        """Fold chunk ``idx``. STRICT in-order contract: callers seeing
+        out-of-order arrivals frontier-buffer them (see module
+        docstring) so every run executes the identical fold sequence."""
+        if idx != self._next:
+            raise ValueError(
+                f"streaming prefill folds chunks in index order: got "
+                f"chunk {idx}, expected {self._next} (frontier-buffer "
+                "out-of-order arrivals at the caller)"
+            )
+        start, stop = self.bounds[idx]
+        if q_blk.shape[1] != stop - start:
+            raise ValueError(
+                f"chunk {idx}: block rows {q_blk.shape[1]} != token range "
+                f"[{start}, {stop})"
+            )
+        self._qkv[idx] = (q_blk, k_blk, v_blk)
+        for b, plan in enumerate(self.plans):
+            for a in plan[idx]:
+                if a > idx or a not in self._qkv:
+                    continue
+                # resident queries vs the new keys...
+                self._fold(b, a, idx)
+                if a != idx:
+                    # ...and the new queries vs the resident keys
+                    self._fold(b, idx, a)
+        self._next += 1
+        # drop raw q/k/v blocks no future chunk interacts with (the
+        # accumulators persist until finalize; residency tracks the
+        # widest branch's actual reach, not the slide length)
+        for j in [j for j in self._qkv if self._last_use[j] < self._next]:
+            del self._qkv[j]
+
+    def finalize(self) -> List[jnp.ndarray]:
+        """-> per-chunk fused output blocks ``[B, c, H, D]`` in chunk
+        order. Exact parity target: the dense oracle's per-position
+        rows, sliced at the same bounds (fwd 1e-5 / grads 1e-4)."""
+        if self._next != self.n_chunks:
+            raise RuntimeError(
+                f"finalize before the stream completed: folded "
+                f"{self._next}/{self.n_chunks} chunks"
+            )
+        blocks: List[jnp.ndarray] = []
+        for i in range(self.n_chunks):
+            outs, lses = [], []
+            for b in range(len(self.branches)):
+                acc = self._acc[b][i]
+                assert acc is not None  # (i, i) always folds
+                outs.append(acc[0])
+                lses.append(acc[1])
+            blocks.append(fuse_branch_partials(outs, lses, jnp.float32))
+        return blocks
+
+
+def streaming_dilated_attention(
+    q_blocks: Sequence[jnp.ndarray],
+    k_blocks: Sequence[jnp.ndarray],
+    v_blocks: Sequence[jnp.ndarray],
+    bounds: Sequence[Tuple[int, int]],
+    segment_lengths: Sequence[int],
+    dilated_ratios: Sequence[int],
+    *,
+    total_len: Optional[int] = None,
+    valid_len=None,
+    jit_pairs: bool = True,
+) -> List[jnp.ndarray]:
+    """Drive a :class:`StreamingPrefillState` over in-memory blocks —
+    the pure-function surface the parity tests and the smoke A/B use
+    (the dense ``dilated_attention`` is the oracle). Returns fp32 fused
+    output blocks in chunk order."""
+    state = StreamingPrefillState(
+        bounds, segment_lengths, dilated_ratios,
+        total_len=total_len, valid_len=valid_len, jit_pairs=jit_pairs,
+    )
+    for i, (q, k, v) in enumerate(zip(q_blocks, k_blocks, v_blocks)):
+        state.ingest(i, q, k, v)
+    return state.finalize()
+
+
+# ---------------------------------------------------------------------------
+# guards: the machine-checkable "never materializes the sequence" claim
+# ---------------------------------------------------------------------------
+
+def full_length_avals(fn, *args, full_len: int) -> List[str]:
+    """Trace ``fn(*args)`` and list every jaxpr variable whose shape
+    carries a ``full_len`` axis — empty for a genuinely chunked program.
+    The streaming acceptance pins ``full_length_avals(fold, ...) == []``
+    while the dense oracle (negative control) must be non-empty; choose
+    ``full_len`` distinct from every chunk/head/feature dim."""
+    closed = jax.make_jaxpr(fn)(*args)
+    offending: List[str] = []
+
+    def scan(jaxpr, depth: int) -> None:
+        for eqn in jaxpr.eqns:
+            for var in list(eqn.invars) + list(eqn.outvars):
+                aval = getattr(var, "aval", None)
+                shape = getattr(aval, "shape", ()) or ()
+                if full_len in tuple(shape):
+                    offending.append(
+                        f"{eqn.primitive.name}: {tuple(shape)}"
+                    )
+            for sub in eqn.params.values():
+                sub = getattr(sub, "jaxpr", None)
+                if sub is not None:
+                    scan(getattr(sub, "jaxpr", sub), depth + 1)
+
+    scan(closed.jaxpr, 0)
+    for var in closed.jaxpr.invars + closed.jaxpr.outvars:
+        shape = getattr(getattr(var, "aval", None), "shape", ()) or ()
+        if full_len in tuple(shape):
+            offending.append(f"io: {tuple(shape)}")
+    return offending
+
+
+def assemble_dense_fallback(blocks: Sequence[jnp.ndarray],
+                            axis: int = 1) -> jnp.ndarray:
+    """The ONE sanctioned chunk-axis reassembly (gigalint GL014 exempts
+    ``*dense_fallback*`` by name): concatenate blocks back into the
+    dense sequence for the oracle/fallback path only. Anything on the
+    streaming hot path calling this has defeated the feature."""
+    return jnp.concatenate(list(blocks), axis=axis)

@@ -3,7 +3,10 @@
 Parity with reference ``gigapath/pipeline.py``: the same five entry points —
 ``tile_one_slide`` (L55), ``load_tile_encoder_transforms`` (L106),
 ``load_tile_slide_encoder`` (L118), ``run_inference_with_tile_encoder``
-(L140), ``run_inference_with_slide_encoder`` (L165) — with the same
+(L140), ``run_inference_with_slide_encoder`` (L165) — plus the
+streaming twin ``run_inference_with_slide_encoder_streaming`` (chunked
+prefill: a chunk iterator/channel instead of the dense array; README
+"Streaming prefill") — with the same
 invariants (dataset.csv non-empty, failed_tiles.csv empty after tiling;
 batch-128 bf16 tile encoding; all-layer slide embeddings keyed
 ``layer_{i}_embed`` + ``last_layer_embed``).
@@ -144,6 +147,53 @@ def run_inference_with_tile_encoder(
         "tile_embeds": np.concatenate(embeds),
         "coords": np.concatenate(coords).astype(np.float32),
     }
+
+
+def run_inference_with_slide_encoder_streaming(
+    chunks,
+    n_tiles: int,
+    slide_encoder_model=None,
+    slide_params=None,
+    *,
+    chunk_tiles: Optional[int] = None,
+) -> dict:
+    """Streaming twin of :func:`run_inference_with_slide_encoder`: the
+    chunk-granular ``LongNetViT`` entry. ``chunks`` is any iterable of
+    ``(chunk_idx, tile_embeds [c, D], coords [c, 2])`` triples or
+    :class:`~gigapath_tpu.dist.boundary.EmbeddingChunk` objects (arrival
+    order free — the session frontier-buffers), cut by the deterministic
+    ``chunk_bounds(n_tiles, chunk_tiles)`` plan. Each chunk folds into
+    the encoder as it arrives (overlapping the producer with stage-2
+    folding); the dense tile-embedding sequence is never materialized.
+    Returns the same ``layer_{i}_embed`` / ``last_layer_embed`` dict as
+    the dense entry, which stays the fallback and parity oracle."""
+    from gigapath_tpu.models.streaming_encoder import (
+        StreamingEncoderSession,
+        embeds_to_outputs,
+    )
+
+    if slide_params is None:
+        slide_encoder_model, slide_params = slide_encoder_model
+    session = StreamingEncoderSession(
+        slide_encoder_model, slide_params, int(n_tiles),
+        chunk_tiles=chunk_tiles, all_layer_embed=True,
+    )
+
+    def quantize(embeds):
+        # the dense entry casts activations to bf16 before apply
+        # (pipeline.py TPU shape); mirror that quantization per chunk so
+        # the two entries see identical inputs
+        return np.asarray(
+            jnp.asarray(embeds, jnp.bfloat16).astype(jnp.float32)
+        )
+
+    for item in chunks:
+        if hasattr(item, "chunk_id"):  # EmbeddingChunk duck type
+            session.feed(item.chunk_id, quantize(item.payload), item.coords)
+        else:
+            idx, embeds, coords = item
+            session.feed(idx, quantize(embeds), coords)
+    return embeds_to_outputs(session.finalize())
 
 
 def run_inference_with_slide_encoder(

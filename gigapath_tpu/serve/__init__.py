@@ -12,6 +12,10 @@
 - :mod:`gigapath_tpu.serve.health` — self-healing policies (PR-8):
   token-budget load shedding, per-request deadlines, per-bucket circuit
   breakers with half-open probes;
+- :mod:`gigapath_tpu.serve.streaming` — streaming chunked prefill
+  submit path (ISSUE 12): per-slide sessions fold `EmbeddingChunk`s on
+  arrival through chunk-shaped stage executables shared by every slide
+  length; the bucketed dense service below stays the fallback/oracle;
 - :mod:`gigapath_tpu.serve.service` — the orchestration loop, wired
   through the obs bus (runlog, watchdog, heartbeat, ledger, anomaly
   engine; ``serve_dispatch`` / ``cache_hit`` / ``recovery`` events),
@@ -34,6 +38,10 @@ from gigapath_tpu.serve.health import (
 )
 from gigapath_tpu.serve.queue import RequestQueue, SlideRequest
 from gigapath_tpu.serve.service import ServeConfig, SlideService
+from gigapath_tpu.serve.streaming import (
+    StreamingSlideSession,
+    StreamingSubmitter,
+)
 
 __all__ = [
     "AotExecutableCache",
@@ -47,6 +55,8 @@ __all__ = [
     "ServeConfig",
     "SlideRequest",
     "SlideService",
+    "StreamingSlideSession",
+    "StreamingSubmitter",
     "assemble_batch",
     "content_key",
     "pad_slide",

@@ -1,0 +1,159 @@
+"""Serving-side streaming chunked prefill: the submit path that folds.
+
+The bucketed :class:`~gigapath_tpu.serve.service.SlideService` pads each
+slide to a ladder rung and runs one dense AOT forward — correct, but the
+whole tile-embedding sequence must exist before dispatch, and every new
+slide length rides a slide-sized executable. The streaming submitter is
+the other operating point: a slide opens a
+:class:`~gigapath_tpu.models.streaming_encoder.StreamingEncoderSession`,
+tile-embedding chunks (``EmbeddingChunk``s from the dist boundary, a
+prefetch loader, or the tile-encoder fleet) fold into the encoder AS
+THEY ARRIVE — stage-1 production overlapped with stage-2 folding end to
+end — and the only compiled programs are CHUNK-shaped stage executables,
+shared by every slide regardless of length. The dense service remains
+the fallback and the parity oracle.
+
+Obs wiring: one ``stream_open`` / ``stream_result`` event pair per
+slide (chunk counts, fold counts, wall), so ``obs_report.py`` sees
+streaming serves next to bucketed ones. Out-of-order and duplicate
+chunk delivery are absorbed by the session's deterministic fold
+frontier (bit-parity per the dist boundary's contract).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from gigapath_tpu.models.streaming_encoder import (
+    StreamingEncoderSession,
+    embeds_to_outputs,
+    prefill_chunk_tiles,
+)
+
+
+class StreamingSlideSession:
+    """One slide's streaming serve: feed chunks, then ``result()``.
+
+    ``feed`` accepts ``EmbeddingChunk``-shaped objects (``chunk_id`` /
+    ``payload`` / ``coords``) or explicit ``(idx, embeds, coords)``;
+    ``result()`` returns the ``layer_{i}_embed`` / ``last_layer_embed``
+    dict of ``pipeline.run_inference_with_slide_encoder`` (the oracle
+    surface the parity tests pin)."""
+
+    def __init__(self, submitter: "StreamingSubmitter", slide_id: str,
+                 n_tiles: int):
+        self.submitter = submitter
+        self.slide_id = slide_id
+        self.session = StreamingEncoderSession(
+            submitter.model, submitter.params, int(n_tiles),
+            chunk_tiles=submitter.chunk_tiles, all_layer_embed=True,
+        )
+        self._t_open = time.monotonic()
+        self._outputs: Optional[Dict[str, np.ndarray]] = None
+        if submitter.runlog is not None:
+            submitter.runlog.event(
+                "stream_open", slide=slide_id, n_tiles=int(n_tiles),
+                n_chunks=self.session.n_chunks,
+                chunk_tiles=submitter.chunk_tiles,
+            )
+
+    def feed(self, chunk, embeds=None, coords=None) -> int:
+        """Fold one chunk (any arrival order). Returns the fold
+        frontier — how many chunks are folded so far."""
+        if embeds is None:
+            return self.session.feed(chunk.chunk_id, chunk.payload,
+                                     chunk.coords)
+        return self.session.feed(int(chunk), embeds, coords)
+
+    def pending(self) -> List[int]:
+        return self.session.pending()
+
+    def result(self) -> Dict[str, np.ndarray]:
+        if self._outputs is None:
+            self._outputs = embeds_to_outputs(self.session.finalize())
+            self.submitter.served += 1
+            if self.submitter.runlog is not None:
+                self.submitter.runlog.event(
+                    "stream_result", slide=self.slide_id,
+                    n_chunks=self.session.n_chunks,
+                    wall_s=round(time.monotonic() - self._t_open, 4),
+                )
+        return self._outputs
+
+
+class StreamingSubmitter:
+    """Streaming-prefill front end over one ``(model, params)`` pair.
+
+    ``open(slide_id, n_tiles)`` starts a slide; the per-chunk stage
+    executables (embed / qkv / fold / post-attention) are keyed on chunk
+    shape inside jax's jit cache, so slides of any length share them.
+    ``chunk_tiles`` defaults to the ``GIGAPATH_PREFILL_CHUNK`` host
+    flag."""
+
+    def __init__(self, model, params, *, chunk_tiles: Optional[int] = None,
+                 runlog=None, name: str = "serve.stream"):
+        self.model = model
+        self.params = params
+        self.chunk_tiles = int(chunk_tiles or prefill_chunk_tiles())
+        self.runlog = runlog
+        self.name = name
+        self.served = 0
+
+    def open(self, slide_id: str, n_tiles: int) -> StreamingSlideSession:
+        return StreamingSlideSession(self, slide_id, n_tiles)
+
+    def stream_slide(self, slide_id: str, chunks,
+                     n_tiles: int) -> Dict[str, np.ndarray]:
+        """Convenience: open + feed an iterable/channel of chunks +
+        result, folding each chunk the moment the iterable yields it
+        (a blocking channel ``recv`` loop overlaps production with the
+        folds for free)."""
+        session = self.open(slide_id, n_tiles)
+        for chunk in chunks:
+            session.feed(chunk)
+        return session.result()
+
+
+def streaming_head_logits(head_model, params, embeds) -> np.ndarray:
+    """Classifier tail of ``ClassificationHead`` over a streaming
+    session's per-layer embeds (feature-axis concat of the selected
+    layers + the linear classifier — per-slide [B, D] vectors, nothing
+    chunked left to stream). ``embeds``: the session's embed list or
+    its ``result()`` dict."""
+    from gigapath_tpu.models.classification_head import parse_feat_layer
+
+    if isinstance(embeds, dict):
+        n = sum(1 for key in embeds if key.startswith("layer_"))
+        embeds = [embeds[f"layer_{i}_embed"] for i in range(n)]
+    layers = parse_feat_layer(head_model.feat_layer)
+    h = jnp.concatenate(
+        [jnp.asarray(embeds[i]) for i in layers], axis=-1
+    )
+    p = params["classifier"]
+    dtype = h.dtype
+    logits = h.reshape(-1, h.shape[-1]) @ p["kernel"].astype(dtype)
+    logits = logits + p["bias"].astype(dtype)
+    return np.asarray(logits, np.float32)
+
+
+def head_streaming_submitter(head_model, params, *,
+                             chunk_tiles: Optional[int] = None,
+                             runlog=None) -> StreamingSubmitter:
+    """A :class:`StreamingSubmitter` for a ``ClassificationHead``: the
+    inner slide encoder streams; callers apply
+    :func:`streaming_head_logits` to each session's layer embeds."""
+    from gigapath_tpu.utils.registry import create_model_from_registry
+
+    inner = create_model_from_registry(
+        head_model.model_arch, in_chans=head_model.input_dim,
+        global_pool=head_model.global_pool, dtype=head_model.dtype,
+        **(head_model.slide_kwargs or {}),
+    )
+    return StreamingSubmitter(
+        inner, params["slide_encoder"], chunk_tiles=chunk_tiles,
+        runlog=runlog,
+    )

@@ -5,7 +5,7 @@ cross-stage boundary (ISSUE 11's acceptance driver).
     python scripts/dist_smoke.py
     python scripts/dist_smoke.py --json DIST_SMOKE.json
 
-Four checks, each a hard assertion (exit 1 + structured JSON on
+Five checks, each a hard assertion (exit 1 + structured JSON on
 violation, bench.py-style; progress rides stderr). Every check runs a
 REAL fleet: tile-worker OS processes + the slide-stage consumer in this
 process, joined by the directory boundary channel
@@ -27,6 +27,14 @@ process, joined by the directory boundary channel
    retransmit timer heals it — retransmits >= 1) and ``dup_chunk@K``
    sends one chunk twice (consumer dedup absorbs it — duplicates >= 1);
    the result is still bit-exact.
+5. **streaming_prefill**: the consumer runs in CHUNKED-PREFILL mode
+   (``plan.chunked_prefill`` — ROADMAP item 2 meets item 4): every
+   acked chunk folds into the slide encoder the moment the fold
+   frontier reaches it, the dense ``[n_tiles, D]`` sequence is never
+   assembled, the clean embedding matches the dense oracle at streaming
+   tolerance (1e-5), and a ``kill_worker@1`` run is BIT-exact vs the
+   clean STREAMING run — reassignment and out-of-order delivery are
+   invisible to the deterministic fold order.
 
 The JSON line carries the ``dist|smoke`` trend keys
 (``chunks_per_sec``, ``clean_wall_s``, ``recover_extra_s``);
@@ -108,7 +116,7 @@ def oracle(plan: dict):
 def check_clean_parity(root: str, plan: dict) -> dict:
     from gigapath_tpu.dist.pipeline import run_disaggregated
 
-    echo("1/4 clean_parity: two workers, no chaos")
+    echo("1/5 clean_parity: two workers, no chaos")
     t0 = time.monotonic()
     result = run_disaggregated(os.path.join(root, "clean"), plan=plan,
                                deadline_s=90)
@@ -126,7 +134,7 @@ def check_clean_parity(root: str, plan: dict) -> dict:
     assert all(rc == 0 for rc in result["worker_exit_codes"].values()), (
         result["worker_exit_codes"]
     )
-    echo(f"1/4 ok: bit-exact vs oracle, {stats['delivered']} chunks in "
+    echo(f"1/5 ok: bit-exact vs oracle, {stats['delivered']} chunks in "
          f"{wall:.1f}s")
     return {"wall_s": round(wall, 3), "chunks": stats["delivered"],
             "embedding": result["embedding"]}
@@ -135,7 +143,7 @@ def check_clean_parity(root: str, plan: dict) -> dict:
 def check_kill_recover(root: str, plan: dict, clean_embedding) -> dict:
     from gigapath_tpu.dist.pipeline import run_disaggregated
 
-    echo("2/4 kill_recover: SIGKILL w0 after 1 chunk, mid-slide")
+    echo("2/5 kill_recover: SIGKILL w0 after 1 chunk, mid-slide")
     t0 = time.monotonic()
     result = run_disaggregated(
         os.path.join(root, "kill"), plan=plan,
@@ -160,7 +168,7 @@ def check_kill_recover(root: str, plan: dict, clean_embedding) -> dict:
     unexpected = [ev for ev in events_of(events, "compile")
                   if ev.get("unexpected")]
     assert not unexpected, f"recovery paid unexpected retraces: {unexpected}"
-    echo(f"2/4 ok: lost w0, reassigned "
+    echo(f"2/5 ok: lost w0, reassigned "
          f"{reassigns[0].get('chunks')} chunk(s), bit-exact in {wall:.1f}s")
     return {"wall_s": round(wall, 3),
             "reassigned_chunks": reassigns[0].get("chunks")}
@@ -172,7 +180,7 @@ def check_slow_worker_skew(root: str, plan: dict, slow_s: float) -> dict:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import obs_report
 
-    echo(f"3/4 slow_worker_skew: w1 sleeps {slow_s}s per chunk")
+    echo(f"3/5 slow_worker_skew: w1 sleeps {slow_s}s per chunk")
     run_id = "dist-smoke-slow"
     out = os.path.join(root, "slow")
     result = run_disaggregated(
@@ -198,7 +206,7 @@ def check_slow_worker_skew(root: str, plan: dict, slow_s: float) -> dict:
     text = buf.getvalue()
     assert "per-rank skew (span 'dist.chunk')" in text, text
     assert "straggler: rank 1" in text, text
-    echo(f"3/4 ok: straggler rank 1 visible (medians {med})")
+    echo(f"3/5 ok: straggler rank 1 visible (medians {med})")
     return {"median_rank0_s": round(med[0], 4),
             "median_rank1_s": round(med[1], 4)}
 
@@ -206,7 +214,7 @@ def check_slow_worker_skew(root: str, plan: dict, slow_s: float) -> dict:
 def check_drop_dup_dedup(root: str, plan: dict, clean_embedding) -> dict:
     from gigapath_tpu.dist.pipeline import run_disaggregated
 
-    echo("4/4 drop_dup_dedup: drop chunk 0's first send, dup chunk 2")
+    echo("4/5 drop_dup_dedup: drop chunk 0's first send, dup chunk 2")
     result = run_disaggregated(
         os.path.join(root, "dropdup"), plan=plan,
         worker_chaos={"w0": "drop_chunk@0,dup_chunk@2"}, deadline_s=90,
@@ -226,10 +234,68 @@ def check_drop_dup_dedup(root: str, plan: dict, clean_embedding) -> dict:
         f"the dropped chunk was not retransmitted: {worker_ends}"
     )
     assert worker_ends[0].get("dropped", 0) >= 1, worker_ends
-    echo(f"4/4 ok: {stats['duplicates']} dup(s) deduped, "
+    echo(f"4/5 ok: {stats['duplicates']} dup(s) deduped, "
          f"{worker_ends[0]['retransmits']} retransmit(s) healed the drop")
     return {"duplicates": stats["duplicates"],
             "retransmits": worker_ends[0]["retransmits"]}
+
+
+def check_streaming_prefill(root: str, plan: dict, clean_embedding) -> dict:
+    """Check 5: the consumer in CHUNKED-PREFILL mode — chunks fold into
+    the slide encoder on arrival (no dense assembly), the clean result
+    matches the dense path at streaming tolerance, and a kill-recover
+    run is BIT-exact vs the clean STREAMING run (the deterministic fold
+    frontier absorbs reassignment + out-of-order delivery)."""
+    from gigapath_tpu.dist.pipeline import run_disaggregated
+
+    echo("5/5 streaming_prefill: consumer folds chunks on arrival")
+    stream_plan = dict(plan, chunked_prefill=True)
+    t0 = time.monotonic()
+    result = run_disaggregated(os.path.join(root, "stream"),
+                               plan=stream_plan, deadline_s=90)
+    wall = time.monotonic() - t0
+    assert result["streaming"] and result["assembled"] is None, (
+        "streaming consumer materialized the dense sequence"
+    )
+    assert np.allclose(result["embedding"], clean_embedding, atol=1e-5), (
+        "streaming embedding diverges from the dense oracle: "
+        f"{np.abs(result['embedding'] - clean_embedding).max()}"
+    )
+    kill = run_disaggregated(
+        os.path.join(root, "stream-kill"), plan=stream_plan,
+        worker_chaos={"w0": "kill_worker@1"}, deadline_s=90,
+    )
+    assert kill["worker_exit_codes"]["w0"] == -9, kill["worker_exit_codes"]
+    assert kill["lost"] == ["w0"] and kill["reassignments"] >= 1, (
+        kill["lost"], kill["reassignments"]
+    )
+    assert np.array_equal(kill["embedding"], result["embedding"]), (
+        "streaming kill-recover is NOT bit-exact vs the clean "
+        "streaming run"
+    )
+    events = run_events(os.path.join(root, "stream"))
+    opens = events_of(events, "stream_open")
+    finals = events_of(events, "stream_finalize")
+    assert opens and finals, "stream_open/stream_finalize events missing"
+    # stage executables must compile once per shape and never retrace —
+    # recovery (and the padded-tail single-shape contract) must never
+    # show up as a recompile, same invariant as check 2's dense forward
+    for leg in ("stream", "stream-kill"):
+        unexpected = [
+            ev for ev in events_of(run_events(os.path.join(root, leg)),
+                                   "compile")
+            if ev.get("unexpected")
+        ]
+        assert not unexpected, (
+            f"{leg}: streaming stages paid unexpected retraces: "
+            f"{unexpected}"
+        )
+    echo(f"5/5 ok: fold-on-arrival parity + BIT-exact kill-recover in "
+         f"{wall:.1f}s")
+    return {"wall_s": round(wall, 3),
+            "max_err_vs_dense": float(
+                np.abs(result["embedding"] - clean_embedding).max()),
+            "kill_reassignments": kill["reassignments"]}
 
 
 def run(args) -> dict:
@@ -252,6 +318,8 @@ def run(args) -> dict:
     checks["slow_worker_skew"] = check_slow_worker_skew(
         root, plan, args.slow_s)
     checks["drop_dup_dedup"] = check_drop_dup_dedup(
+        root, plan, clean_embedding)
+    checks["streaming_prefill"] = check_streaming_prefill(
         root, plan, clean_embedding)
     clean_wall = checks["clean_parity"]["wall_s"]
     return {

@@ -25,7 +25,10 @@
 #     replace);
 #   - the gigalint GL013 selftest: the seeded unbounded-channel fixture
 #     must fire (queue.Queue()/bare deque() as an inter-thread channel
-#     outside the sanctioned serve/queue.py + dist/boundary.py paths).
+#     outside the sanctioned serve/queue.py + dist/boundary.py paths);
+#   - the gigalint GL014 selftest: the seeded chunk-reassembly fixture
+#     must fire (jnp.concatenate/stack over the chunk axis inside a
+#     streaming-sanctioned module, outside the *dense_fallback* oracle).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 python scripts/obs_report.py --selftest 1>&2
@@ -70,5 +73,18 @@ if [ "$gl013_rc" -ne 1 ]; then
     exit 1
 fi
 echo "gigalint GL013 selftest OK" 1>&2
+
+# GL014 selftest: the seeded chunk-reassembly fixture MUST be found
+# (exit 1 = findings; 0 or 2 mean the rule went blind or crashed)
+set +e
+python -m tools.gigalint --no-waivers --select GL014 \
+    tools/gigalint/selftest/fixture/ops/streaming_prefill.py 1>&2
+gl014_rc=$?
+set -e
+if [ "$gl014_rc" -ne 1 ]; then
+    echo "GL014 selftest FAILED: expected findings (rc=1), got rc=$gl014_rc" 1>&2
+    exit 1
+fi
+echo "gigalint GL014 selftest OK" 1>&2
 
 exec python -m tools.gigalint gigapath_tpu scripts tests "$@"

@@ -127,17 +127,182 @@ def run_sharded(n: int, n_devices: int = 8) -> dict:
     }
 
 
+def run_stream(n: int, chunk: int = 2048) -> dict:
+    """Streaming-chunked-prefill vs dense-assemble A/B at the attention
+    level (reduced width, like ``run_sharded`` — the SEQUENCE scale is
+    what's under test): the ``adopt_chunked_prefill`` decision table.
+
+    Memory rows come from XLA memory analysis of the COMPILED programs
+    (AOT, nothing executed — the same ledger numbers the tier-1 pins
+    check): the dense variant is the whole ``dilated_attention`` forward
+    at ``[1, n, H, D]``; the streaming variant is the largest per-chunk
+    fold executable (``fold_pair`` at the widest branch), whose arg/temp
+    bytes are O(chunk) by construction. Walltime runs both variants at
+    ``n`` on a chip and at ``min(n, 4096)`` elsewhere (a laptop cannot
+    execute the 16k dense logits tensor just to time it); parity is
+    checked at the walltime geometry. ``perf_history.py ingest
+    --prefill`` folds the JSON under ``prefill|stream`` (non-chip runs
+    land stale, provenance only)."""
+    import functools
+
+    import jax.numpy as jnp
+
+    from gigapath_tpu.ops.dilated_attention import dilated_attention
+    from gigapath_tpu.ops.streaming_prefill import (
+        assemble_dense_fallback,
+        chunk_bounds,
+        fold_pair,
+        streaming_dilated_attention,
+    )
+    from gigapath_tpu.utils.profiling import compiled_memory
+
+    H, Dh = 4, 16
+    drs = [1, 2, 4]
+    sls = [min(1024, n), min(4096, n), n]
+    backend = jax.default_backend()
+    on_chip = backend in ("tpu", "gpu")
+
+    def make_qkv(m):
+        rng = np.random.default_rng(0)
+        return tuple(
+            jnp.asarray(rng.normal(size=(1, m, H, Dh)), jnp.float32)
+            for _ in range(3)
+        )
+
+    def mb(x):
+        return None if x is None else round(x / 2**20, 3)
+
+    # --- memory: AOT analysis at the full geometry, nothing executed ---
+    q, k, v = make_qkv(n)
+    dense_fn = lambda q, k, v: dilated_attention(q, k, v, sls, drs)  # noqa: E731
+    dense_mem = compiled_memory(dense_fn, q, k, v) or {}
+    cq = min(chunk, n)
+    qb, kb, vb = (x[:, :cq] for x in (q, k, v))
+    acc_out = jnp.zeros((1, cq, H, Dh), jnp.float32)
+    acc_lse = jnp.zeros((1, H, cq), jnp.float32)
+    widest = functools.partial(fold_pair, segment_len=min(sls[-1], n),
+                               ratio=drs[-1])
+    stream_mem = compiled_memory(
+        widest, acc_out, acc_lse, qb, kb, vb,
+        jnp.int32(0), jnp.int32(0), jnp.int32(n),
+    ) or {}
+
+    def peak(mem):
+        vals = [mem.get("argument_bytes"), mem.get("temp_bytes"),
+                mem.get("output_bytes")]
+        return None if any(v is None for v in vals) else sum(vals)
+
+    # --- walltime + parity at an executable geometry ---
+    wall_n = n if on_chip else min(n, 4096)
+    wall_sls = [min(s, wall_n) for s in sls]
+    qw, kw, vw = make_qkv(wall_n)
+    wall_bounds = chunk_bounds(wall_n, min(chunk, wall_n))
+    dense_jit = jax.jit(
+        lambda q, k, v: dilated_attention(q, k, v, wall_sls, drs)
+    )
+    dense_out = jax.block_until_ready(dense_jit(qw, kw, vw))  # compile
+    t0 = time.perf_counter()
+    dense_out = jax.block_until_ready(dense_jit(qw, kw, vw))
+    dense_wall = time.perf_counter() - t0
+
+    def stream_once():
+        blocks = streaming_dilated_attention(
+            [qw[:, a:b] for a, b in wall_bounds],
+            [kw[:, a:b] for a, b in wall_bounds],
+            [vw[:, a:b] for a, b in wall_bounds],
+            wall_bounds, wall_sls, drs,
+        )
+        jax.block_until_ready(blocks)
+        return blocks
+    blocks = stream_once()  # compile the stage executables
+    t0 = time.perf_counter()
+    blocks = stream_once()
+    stream_wall = time.perf_counter() - t0
+    parity = float(jnp.abs(
+        assemble_dense_fallback(blocks) - dense_out.astype(jnp.float32)
+    ).max())
+
+    dense_peak, stream_peak = peak(dense_mem), peak(stream_mem)
+    temp_ratio = peak_ratio = None
+    if dense_mem.get("temp_bytes") and stream_mem.get("temp_bytes") is not None:
+        temp_ratio = round(stream_mem["temp_bytes"] / dense_mem["temp_bytes"], 4)
+    if dense_peak and stream_peak is not None:
+        peak_ratio = round(stream_peak / dense_peak, 4)
+    payload = {
+        "metric": "prefill_stream",
+        "backend": backend,
+        "n_tokens": n,
+        "chunk": chunk,
+        "branches": list(zip(sls, drs)),
+        "wall_n_tokens": wall_n,
+        "dense_arg_mb": mb(dense_mem.get("argument_bytes")),
+        "dense_temp_mb": mb(dense_mem.get("temp_bytes")),
+        "dense_peak_mb": mb(dense_peak),
+        "stream_arg_mb": mb(stream_mem.get("argument_bytes")),
+        "stream_temp_mb": mb(stream_mem.get("temp_bytes")),
+        "stream_peak_mb": mb(stream_peak),
+        "temp_ratio": temp_ratio,
+        "peak_ratio": peak_ratio,
+        "dense_wall_s": round(dense_wall, 4),
+        "stream_wall_s": round(stream_wall, 4),
+        "parity_max_err": parity,
+        "decision": {
+            # adopt when the per-chunk fold's peak comes in under 0.6x
+            # the dense program AND the math matches the oracle — the
+            # acceptance thresholds, machine-checkable like
+            # adopt_stream_fusion / adopt_ring_attn
+            "adopt_chunked_prefill": bool(
+                peak_ratio is not None and peak_ratio < 0.6
+                and parity < 1e-5
+            ),
+            "peak_ratio": peak_ratio,
+            "parity_max_err": parity,
+        },
+    }
+    return payload
+
+
 def main():
     args = [a for a in sys.argv[1:]]
+    json_out = None
+    if "--json" in args:
+        i = args.index("--json")
+        json_out = args[i + 1]
+        del args[i:i + 2]
+    def emit(payload, n, many):
+        # one payload per file (perf_history ingest json.load's it):
+        # with several token counts, suffix each path so no row is
+        # silently overwritten
+        line = json.dumps(payload)
+        print(line)
+        if json_out:
+            path = json_out
+            if many:
+                root, ext = os.path.splitext(json_out)
+                path = f"{root}.n{n}{ext or '.json'}"
+            with open(path, "w", encoding="utf-8") as fh:
+                fh.write(line + "\n")
+
+    if "--stream" in args:
+        args.remove("--stream")
+        chunk = 2048
+        if "--chunk" in args:
+            i = args.index("--chunk")
+            chunk = int(args[i + 1])
+            del args[i:i + 2]
+        ns = [int(a) for a in args] or [16384]
+        for n in ns:
+            emit(run_stream(n, chunk), n, len(ns) > 1)
+        return
     if "--sharded" in args:
         args.remove("--sharded")
         ns = [int(a) for a in args] or [1048576]
         for n in ns:
-            print(json.dumps(run_sharded(n)))
+            emit(run_sharded(n), n, len(ns) > 1)
         return
     ns = [int(a) for a in args] or [65536, 131072]
     for n in ns:
-        print(json.dumps(run(n)))
+        emit(run(n), n, len(ns) > 1)
 
 
 if __name__ == "__main__":

@@ -120,6 +120,10 @@ def cmd_ingest(args) -> int:
             history.fold_dist(doc, _load_json(args.dist), args.label,
                               source=os.path.basename(args.dist),
                               force=args.force)
+        if args.prefill:
+            history.fold_prefill(doc, _load_json(args.prefill), args.label,
+                                 source=os.path.basename(args.prefill),
+                                 force=args.force)
         for path in args.ledger or []:
             history.fold_ledger(doc, _load_json(path), args.label,
                                 source=os.path.basename(path),
@@ -339,6 +343,45 @@ def selftest() -> int:
         render(dv, out=sys.stderr)
         return 1
 
+    # prefill|stream folding: same shared staleness policy (CPU point =
+    # stale with keys), and fold-executable memory growth flips the gate
+    history.fold_prefill(
+        serve_doc,
+        {"rc": 0, "parsed": {"backend": "cpu", "stream_temp_mb": 2.0,
+                             "peak_ratio": 0.3}}, "r01")
+    pre_points = serve_doc["entries"]["prefill|stream"]["points"]
+    if not pre_points[0].get("stale") or "stream_temp_mb" not in \
+            pre_points[0]["metrics"]:
+        print("perf_history selftest FAILED: CPU prefill point must be "
+              "stale WITH metric keys", file=sys.stderr)
+        return 1
+    history.fold_prefill(
+        serve_doc,
+        {"rc": 0, "parsed": {"backend": "tpu", "stream_temp_mb": 2.0,
+                             "stream_peak_mb": 8.0, "peak_ratio": 0.3}},
+        "r02")
+    history.fold_prefill(
+        serve_doc,
+        {"rc": 0, "parsed": {"backend": "tpu", "stream_temp_mb": 6.0,
+                             "stream_peak_mb": 8.0, "peak_ratio": 0.9}},
+        "r03")
+    pv = history.trend_verdict(serve_doc)
+    if pv["decision"]["ok"] or not any(
+        "prefill|stream: stream_temp_mb 2.0" in line
+        for line in pv["decision"]["regressed"]
+    ):
+        print("perf_history selftest FAILED: prefill fold-executable "
+              "memory growth undetected", file=sys.stderr)
+        render(pv, out=sys.stderr)
+        return 1
+    if not any(
+        "prefill|stream: peak_ratio 0.3" in line
+        for line in pv["decision"]["regressed"]
+    ):
+        print("perf_history selftest FAILED: prefill peak_ratio "
+              "regression undetected", file=sys.stderr)
+        return 1
+
     # append-only: reusing a label without force must refuse
     try:
         history.fold_bench(
@@ -412,6 +455,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                        help="dist_smoke snapshot JSON "
                        "(scripts/dist_smoke.py --json output) -> the "
                        "dist|smoke boundary trend entry")
+    p_ing.add_argument("--prefill", default=None,
+                       help="long_context_smoke --stream snapshot JSON "
+                       "-> the prefill|stream trend entry "
+                       "(streaming-vs-dense memory decision table)")
     p_ing.add_argument("--ledger", action="append", default=None,
                        help="per-run ledger JSON (repeatable)")
     p_ing.add_argument("--force", action="store_true",

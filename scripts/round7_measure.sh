@@ -75,5 +75,15 @@ tail -3 /tmp/r7_serve.log
 timeout 1200 python scripts/dist_smoke.py --json DIST_SMOKE.json \
   > /tmp/r7_dist.log 2>&1
 tail -3 /tmp/r7_dist.log
+
+# 9. streaming chunked prefill (ROADMAP item 2): the
+#    adopt_chunked_prefill decision table — per-variant XLA
+#    memory-analysis {arg,temp,peak}_mb of the dense forward vs the
+#    per-chunk fold executable, walltime, and dense-oracle parity, at
+#    the 16k smoke geometry. On-chip numbers land the prefill|stream
+#    trend entry; the committed CPU point is stale provenance.
+timeout 1200 python scripts/long_context_smoke.py --stream \
+  --json PREFILL_SMOKE.json 16384 > /tmp/r7_prefill.log 2>&1
+tail -3 /tmp/r7_prefill.log
 python scripts/perf_history.py ingest --label r07 --serve SERVE_SMOKE.json \
-  --dist DIST_SMOKE.json || true
+  --dist DIST_SMOKE.json --prefill PREFILL_SMOKE.json || true

@@ -750,3 +750,56 @@ class TestKillWorkerAcceptance:
         unexpected = [ev for ev in _of(events, "compile")
                       if ev.get("unexpected")]
         assert not unexpected, unexpected
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 12: the consumer folds chunks on arrival (streaming prefill)
+# ---------------------------------------------------------------------------
+
+class TestStreamingConsumer:
+    def test_streaming_fold_on_arrival_kill_recover_bit_exact(self, tmp_path):
+        """ISSUE 12 acceptance (dist leg): with ``chunked_prefill`` in
+        the plan the consumer folds every acked ``EmbeddingChunk``
+        straight into the streaming slide-encoder session — no dense
+        ``[n_tiles, D]`` assembly — and a SIGKILLed worker's
+        reassignment (out-of-order, retransmitted delivery included)
+        leaves the slide embedding BIT-exact vs the clean streaming run,
+        which itself matches the dense consumer at streaming tolerance."""
+        from gigapath_tpu.dist.pipeline import default_plan, run_disaggregated
+
+        plan = default_plan(n_tiles=40, chunk_tiles=8, lease_s=1.5,
+                            credits=4, retransmit_s=0.5)
+        dense = run_disaggregated(str(tmp_path / "dense"), plan=plan,
+                                  deadline_s=90)
+
+        stream_plan = dict(plan, chunked_prefill=True)
+        clean = run_disaggregated(str(tmp_path / "clean"), plan=stream_plan,
+                                  deadline_s=90)
+        assert clean["streaming"] and clean["assembled"] is None
+        assert clean["lost"] == [] and clean["reassignments"] == 0
+        np.testing.assert_allclose(clean["embedding"], dense["embedding"],
+                                   atol=1e-5, rtol=0)
+
+        chaos = run_disaggregated(
+            str(tmp_path / "chaos"), plan=stream_plan,
+            worker_chaos={"w0": "kill_worker@1"}, deadline_s=90,
+        )
+        assert chaos["worker_exit_codes"]["w0"] == -9
+        assert chaos["lost"] == ["w0"] and chaos["reassignments"] >= 1
+        np.testing.assert_array_equal(clean["embedding"],
+                                      chaos["embedding"])
+
+        events = []
+        for path in glob.glob(str(tmp_path / "clean" / "obs" / "*.jsonl")):
+            if os.path.basename(path).startswith("flight-"):
+                continue
+            with open(path, encoding="utf-8") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if line:
+                        try:
+                            events.append(json.loads(line))
+                        except json.JSONDecodeError:
+                            continue
+        assert _of(events, "stream_open")
+        assert _of(events, "stream_finalize")

@@ -51,7 +51,7 @@ def test_fixture_tree_fires_every_rule_class():
     fired = {f.rule for f in result.findings}
     expected = {"GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
                 "GL007", "GL008", "GL009", "GL010", "GL011", "GL012",
-                "GL013"}
+                "GL013", "GL014"}
     assert fired >= expected, (
         f"missing rule classes: {sorted(expected - fired)}"
     )
@@ -113,6 +113,11 @@ def test_fixture_specific_findings():
         # negative controls, rolling.py the no-threading deque control)
         ("GL013", "channels.py", "unbounded_queue_channel"),
         ("GL013", "channels.py", "unbounded_deque_channel"),
+        # chunk reassembly inside a streaming-sanctioned module (the
+        # fixture twins ops/streaming_prefill.py by path suffix; the
+        # *dense_fallback* oracle stays a negative control)
+        ("GL014", "streaming_prefill.py", "reassemble_chunks"),
+        ("GL014", "streaming_prefill.py", "stack_chunks_for_readout"),
         # maxsize=-1 is Python's explicitly-INFINITE queue, not a bound
         ("GL013", "channels.py", "unbounded_queue_negative_maxsize"),
     }

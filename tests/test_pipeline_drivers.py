@@ -256,6 +256,44 @@ class TestInferenceDriver:
             exact["confidence"], df["confidence"], atol=5e-3
         )
 
+    def test_streaming_route_matches_exact_path(self, tmp_path):
+        """The --stream (chunked prefill) route vs the exact-shape
+        oracle: same verdicts, confidences within f32 streaming
+        tolerance (the model here is f32; load_model's bf16 serving
+        default is exercised by the bucketed test above). Ragged final
+        chunks included (10 tiles, chunk 4)."""
+        import torch
+
+        from gigapath_tpu.inference import run_inference
+        from gigapath_tpu.models.classification_head import get_model
+
+        torch.manual_seed(0)
+        for i in range(3):
+            torch.save(
+                {"features": torch.randn(10, 16),
+                 "coords": torch.rand(10, 2) * 5000},
+                tmp_path / f"slide{i}_features.pt",
+            )
+        model, params = get_model(
+            input_dim=16, latent_dim=32, feat_layer="1", n_classes=2,
+            model_arch="gigapath_slide_enc_tiny", dtype=None,
+        )
+        exact = run_inference(
+            model, params, str(tmp_path), str(tmp_path / "exact.csv"),
+            use_buckets=False,
+        )
+        stream = run_inference(
+            model, params, str(tmp_path), str(tmp_path / "stream.csv"),
+            stream=True, stream_chunk=4, prefetch=2,
+        )
+        assert list(stream["slide_id"]) == list(exact["slide_id"])
+        assert list(stream["predicted_label"]) == list(
+            exact["predicted_label"]
+        )
+        np.testing.assert_allclose(
+            stream["confidence"], exact["confidence"], atol=1e-5
+        )
+
     def test_oversized_slide_falls_back_to_exact_shape(self, tmp_path,
                                                        monkeypatch):
         """A slide above the ladder's top rung must NOT abort the run:

@@ -969,3 +969,83 @@ def check_forbidden(project: Project) -> List[Finding]:
                             "construct inside",
                         ))
     return findings
+
+
+# ---------------------------------------------------------------------------
+# GL014 — chunk reassembly in streaming-sanctioned modules
+# ---------------------------------------------------------------------------
+
+# The streaming-prefill modules exist to fold chunk lists WITHOUT ever
+# materializing the dense sequence (ops/streaming_prefill.py,
+# models/streaming_encoder.py). A jnp.concatenate/stack over the chunk
+# axis inside them silently reintroduces the O(L) buffer the feature
+# removes — numerically invisible, exactly the regression a reviewer
+# will not catch. The one sanctioned reassembly is the oracle/fallback
+# surface, marked by a ``dense_fallback`` function name (matched on the
+# enclosing function's qualname, so helpers nested under the fallback
+# stay sanctioned too).
+_GL014_STREAMING_SUFFIXES = (
+    "ops/streaming_prefill.py",
+    "models/streaming_encoder.py",
+)
+_GL014_REASSEMBLY = frozenset({
+    "jax.numpy.concatenate", "jax.numpy.stack",
+    "jax.numpy.vstack", "jax.numpy.hstack",
+    "numpy.concatenate", "numpy.stack",
+    "numpy.vstack", "numpy.hstack",
+})
+_GL014_SANCTION_MARK = "dense_fallback"
+
+
+@register(
+    "GL014",
+    "chunk-list reassembly in a streaming-sanctioned module: "
+    "concatenate/stack here rebuilds the dense sequence the streaming "
+    "prefill exists to never materialize — fold blockwise (partial "
+    "attention + combine_partials, per-block reductions), or move the "
+    "code into an explicit *dense_fallback* oracle function",
+)
+def check_streaming_reassembly(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.modules.values():
+        if not any(
+            mod.path == s or mod.path == s.split("/")[-1]
+            or mod.path.endswith("/" + s)
+            for s in _GL014_STREAMING_SUFFIXES
+        ):
+            continue
+        spans = sorted(
+            (
+                (fn.lineno, getattr(fn.node, "end_lineno", fn.lineno), fn)
+                for fn in mod.functions.values()
+            ),
+            key=lambda t: t[1] - t[0],
+        )
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if not name:
+                continue
+            head, sep, rest = name.partition(".")
+            target = mod.imports.get(head)
+            resolved = (f"{target}.{rest}" if sep else target) if target else name
+            if resolved not in _GL014_REASSEMBLY:
+                continue
+            symbol = "<module>"
+            for lo, hi, fn in spans:
+                if lo <= node.lineno <= hi:
+                    symbol = fn.qualname
+                    break
+            if _GL014_SANCTION_MARK in symbol:
+                continue  # the sanctioned oracle/fallback surface
+            findings.append(Finding(
+                "GL014", mod.path, node.lineno, symbol,
+                f"{resolved}() in a streaming-sanctioned module "
+                "reassembles chunks into a dense sequence: the fold "
+                "path must stay O(chunk) — merge partials with "
+                "combine_partials / per-block reductions instead, or "
+                "rename the enclosing function *dense_fallback* if it "
+                "IS the sanctioned oracle path",
+            ))
+    return findings

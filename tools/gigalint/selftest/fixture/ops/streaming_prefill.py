@@ -1,0 +1,36 @@
+"""Seeded GL014 violations: chunk-list reassembly inside a
+streaming-sanctioned module (this file twins the real
+``ops/streaming_prefill.py`` by path suffix), plus the sanctioned
+``*dense_fallback*`` negative controls the rule must NOT flag."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def reassemble_chunks(blocks):
+    """SEEDED GL014: concatenating the chunk list rebuilds the dense
+    sequence the streaming path exists to never materialize."""
+    return jnp.concatenate(blocks, axis=1)
+
+
+def stack_chunks_for_readout(blocks):
+    """SEEDED GL014: np.stack over the chunk axis is the same dense
+    buffer under a different name."""
+    return np.stack(blocks).mean(axis=0)
+
+
+def negative_control_assemble_dense_fallback(blocks):
+    """The sanctioned oracle surface: *dense_fallback* in the name
+    exempts it (this IS the parity-oracle reassembly)."""
+    return jnp.concatenate(blocks, axis=1)
+
+
+def negative_control_blockwise_pool(blocks):
+    """Folding across blocks by reduction is the streaming idiom: no
+    reassembly, no finding."""
+    total = 0.0
+    count = 0
+    for blk in blocks:
+        total = total + blk.sum(axis=1)
+        count += blk.shape[1]
+    return total / count
