@@ -24,11 +24,22 @@ behind.
   ``parallel/mesh.py``'s axes plus a declarative sharding-rule registry
   (the ``match_partition_rules`` pattern) keyed per stage, consumed by
   both fleets;
+- :mod:`gigapath_tpu.dist.transport` — the REAL network transport
+  (TCP): length-prefixed sha256-digested frames, reconnect with capped
+  exponential backoff + full jitter, a handshake carrying the
+  consumer's ack watermark so a reconnect replays exactly the unacked
+  chunk ids, and the frame-layer chaos injectors (``drop_conn`` /
+  ``delay_frame`` / ``corrupt_frame`` / ``reorder_frame``); selected by
+  ``GIGAPATH_DIST_TRANSPORT`` through ``make_producer``/
+  ``make_consumer`` with zero changes to the fold path;
 - :mod:`gigapath_tpu.dist.worker` / :mod:`gigapath_tpu.dist.pipeline` —
   the runnable dryrun harness: real tile-worker *processes* and the
-  slide-stage consumer, provable on one machine (two process groups on
-  CPU), chaos-injectable via the ``GIGAPATH_CHAOS`` ``kill_worker`` /
-  ``slow_worker`` / ``drop_chunk`` / ``dup_chunk`` injectors.
+  slide-stage consumer (its own SIGKILLable process when needed, with
+  checkpointed fold state and bit-exact resume —
+  ``GIGAPATH_DIST_CONSUMER_CKPT_EVERY``), provable on one machine (two
+  process groups on CPU), chaos-injectable via the ``GIGAPATH_CHAOS``
+  ``kill_worker`` / ``kill_consumer`` / ``slow_worker`` /
+  ``drop_chunk`` / ``dup_chunk`` injectors.
 
 Everything protocol-level (boundary, membership, the chunk plan) is
 numpy + stdlib only — no jax import — so a tile worker process starts
@@ -52,4 +63,11 @@ from gigapath_tpu.dist.membership import (  # noqa: F401
     Membership,
     WorkerLease,
     write_reassignment,
+)
+from gigapath_tpu.dist.transport import (  # noqa: F401
+    TcpChannelConsumer,
+    TcpChannelProducer,
+    make_consumer,
+    make_producer,
+    transport_name,
 )

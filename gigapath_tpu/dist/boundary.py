@@ -62,6 +62,9 @@ class BoundaryConfig:
     chunk_tiles: int = 512     # tiles per chunk in the deterministic plan
     poll_s: float = 0.02       # producer block / consumer scan cadence
     retransmit_s: float = 2.0  # unacked-for-longer gets re-sent
+    # TCP transport (dist/transport.py) only
+    connect_timeout_s: float = 5.0  # per-connect AND per-frame deadline
+    backoff_s: float = 2.0          # reconnect backoff cap (full jitter)
 
     @classmethod
     def from_env(cls, **overrides) -> "BoundaryConfig":
@@ -72,6 +75,9 @@ class BoundaryConfig:
             poll_s=env_number("GIGAPATH_DIST_POLL_S", cls.poll_s),
             retransmit_s=env_number("GIGAPATH_DIST_RETRANSMIT_S",
                                     cls.retransmit_s),
+            connect_timeout_s=env_number("GIGAPATH_DIST_CONNECT_TIMEOUT_S",
+                                         cls.connect_timeout_s),
+            backoff_s=env_number("GIGAPATH_DIST_BACKOFF_S", cls.backoff_s),
         )
         fields.update({k: v for k, v in overrides.items() if v is not None})
         if fields["capacity"] < 1:
@@ -198,6 +204,10 @@ class ChannelStats:
     dropped: int = 0         # sends swallowed by chaos injection
     backpressure_events: int = 0
     blocked_s: float = 0.0   # total producer wall spent credit-blocked
+    # TCP transport only (dist/transport.py); zero on the other two
+    reconnects: int = 0      # connections re-established after the first
+    frame_errors: int = 0    # torn/corrupt/misframed wire frames dropped
+    bytes_sent: int = 0      # frame bytes pushed onto the wire
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -477,14 +487,24 @@ class DirChannelConsumer:
     consumer drains every producer's chunks — the fan-in point)."""
 
     def __init__(self, root: str, config: Optional[BoundaryConfig] = None, *,
-                 runlog=None, name: str = "dir"):
+                 runlog=None, name: str = "dir",
+                 delivered: Optional[Sequence[int]] = None):
+        """``delivered``: seqs a RESTARTED consumer already holds (its
+        checkpoint watermark) — seeded into the dedup set so retransmits
+        of pre-crash chunks are absorbed, not re-assembled."""
         self.cfg = config or BoundaryConfig()
         self.dir = os.path.join(root, "channel")
         os.makedirs(self.dir, exist_ok=True)
         self.name = name
         self._runlog = runlog
         self.stats = ChannelStats()
-        self._delivered: set = set()
+        self._delivered: set = set(
+            int(s) for s in delivered) if delivered else set()
+        # seqs this consumer considers DURABLE: the seeded watermark plus
+        # every ack it issued itself. Only these may be re-acked on a
+        # duplicate — a delivered-but-deferred-ack seq must NOT be (the
+        # deferred-ack discipline: an ack is a durability promise)
+        self._acked: set = set(self._delivered)
 
     def _load(self, path: str) -> Optional[EmbeddingChunk]:
         try:
@@ -518,6 +538,17 @@ class DirChannelConsumer:
                 if seq in self._delivered:
                     self.stats.duplicates += 1
                     _unlink_quiet(path)
+                    if seq in self._acked:
+                        # re-ack (idempotent marker): a RESTARTED
+                        # consumer's seeded watermark may cover seqs
+                        # whose deferred ack died with the predecessor
+                        # between checkpoint and flush — swallowing the
+                        # retransmit without acking would pin the
+                        # producer's credit forever. ONLY durable seqs:
+                        # acking a deferred-ack duplicate would promise
+                        # durability a crash can still revoke
+                        atomic_touch(os.path.join(self.dir,
+                                                  f"ack-{seq:06d}"))
                     continue
                 chunk = self._load(path)
                 if chunk is None:
@@ -535,6 +566,7 @@ class DirChannelConsumer:
 
     def ack(self, seq: int) -> None:
         atomic_touch(os.path.join(self.dir, f"ack-{seq:06d}"))
+        self._acked.add(int(seq))
         self.stats.acked += 1
 
 
@@ -562,6 +594,11 @@ class ChunkTracker:
 
     def expect(self, chunk_ids: Sequence[int]) -> None:
         self._expected = set(int(c) for c in chunk_ids)
+
+    def seed_received(self, chunk_ids: Sequence[int]) -> None:
+        """Mark chunks already held (a restarted consumer's checkpoint
+        watermark) so their retransmits dedup instead of re-folding."""
+        self._have.update(int(c) for c in chunk_ids)
 
     def add(self, chunk: EmbeddingChunk) -> bool:
         """Record one delivery; returns False for a chunk id already

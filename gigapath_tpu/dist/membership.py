@@ -119,6 +119,14 @@ class WorkerLease:
             pass
 
 
+def read_lease(root: str, worker_id: str) -> Optional[dict]:
+    """One lease document, raw (no expiry judgment) — the restarted
+    consumer reads its predecessor's stale lease for ``consumer_lost``
+    post-mortem context (pid, last renewal)."""
+    return _read_json(os.path.join(_members_dir(root),
+                                   f"lease-{worker_id}.json"))
+
+
 class Membership:
     """The consumer/coordinator's view of the worker fleet."""
 

@@ -26,6 +26,7 @@ BIT-exact, with the recovery visible as ``worker_lost`` +
 
 from __future__ import annotations
 
+import argparse
 import os
 import subprocess
 import sys
@@ -37,14 +38,23 @@ import numpy as np
 from gigapath_tpu.dist.boundary import (
     BoundaryConfig,
     ChunkTracker,
-    DirChannelConsumer,
     SlideAssembler,
     assign_chunks,
     atomic_touch,
     plan_chunks,
 )
-from gigapath_tpu.dist.membership import Membership, write_reassignment
+from gigapath_tpu.dist.membership import (
+    Membership,
+    WorkerLease,
+    read_lease,
+    write_reassignment,
+)
+from gigapath_tpu.dist.transport import make_consumer
 from gigapath_tpu.dist.worker import DONE_MARKER, load_plan, write_plan
+from gigapath_tpu.resilience.chaos import get_chaos
+
+RESULT_FILE = "result.npz"
+CONSUMER_CKPT_DIR = "consumer-ckpt"
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -56,13 +66,21 @@ def default_plan(*, slide_id: str = "slide0", n_tiles: int = 64,
                  encoder_seed: int = 7, lease_s: float = 1.0,
                  credits: int = 4, retransmit_s: float = 0.5,
                  poll_s: float = 0.02,
-                 chunked_prefill: bool = False) -> dict:
+                 chunked_prefill: bool = False,
+                 transport: Optional[str] = None,
+                 consumer_ckpt_every: Optional[int] = None) -> dict:
     """The dryrun's plan document (written to ``<root>/plan.json``,
     read by every process — the shared deterministic truth).
     ``chunked_prefill`` puts the consumer in streaming mode: chunks fold
     into the slide encoder on arrival instead of assembling the dense
-    sequence (the plan carries the mode so every process agrees)."""
-    return dict(
+    sequence (the plan carries the mode so every process agrees).
+    ``transport`` picks the boundary transport (``dir``/``tcp``; None =
+    the ``GIGAPATH_DIST_TRANSPORT`` snapshot) and
+    ``consumer_ckpt_every`` the consumer's checkpoint cadence in
+    delivered chunks (None = the ``GIGAPATH_DIST_CONSUMER_CKPT_EVERY``
+    snapshot; 0 = off) — in the plan so every process, restarted
+    consumers included, agrees."""
+    plan = dict(
         slide_id=slide_id, n_tiles=int(n_tiles), dim_in=int(dim_in),
         dim_out=int(dim_out), chunk_tiles=int(chunk_tiles),
         workers=sorted(workers or ["w0", "w1"]), tile_seed=int(tile_seed),
@@ -70,6 +88,11 @@ def default_plan(*, slide_id: str = "slide0", n_tiles: int = 64,
         credits=int(credits), retransmit_s=float(retransmit_s),
         poll_s=float(poll_s), chunked_prefill=bool(chunked_prefill),
     )
+    if transport is not None:
+        plan["transport"] = str(transport)
+    if consumer_ckpt_every is not None:
+        plan["consumer_ckpt_every"] = int(consumer_ckpt_every)
+    return plan
 
 
 def _default_streaming_forward():
@@ -156,12 +179,43 @@ def _default_forward():
     return build
 
 
+def _export_consumer_state(assembler, session) -> dict:
+    """The consumer's durable fold state: the delivered-chunk watermark
+    plus either the streaming session's frontier/partials or the dense
+    assembly buffers — exactly what a restarted consumer needs for a
+    BIT-exact resume."""
+    state: dict = {
+        "received": np.array(sorted(assembler.received), np.int64),
+    }
+    if session is not None:
+        state["session"] = session.export_state()
+    else:
+        state["embeds"] = np.asarray(assembler.embeds)
+        state["coords"] = np.asarray(assembler.coords)
+    return state
+
+
+def _restore_consumer_state(state: dict, assembler, session) -> List[int]:
+    """Inverse of :func:`_export_consumer_state`; returns the restored
+    watermark (sorted delivered chunk ids)."""
+    received = [int(c) for c in np.asarray(state["received"]).tolist()]
+    assembler.seed_received(received)
+    if session is not None:
+        session.restore_state(state["session"])
+    else:
+        assembler.embeds[...] = np.asarray(state["embeds"], np.float32)
+        assembler.coords[...] = np.asarray(state["coords"], np.float32)
+    return received
+
+
 def run_slide_consumer(root: str, *, runlog=None,
                        forward_builder: Optional[Callable] = None,
                        streaming: Optional[bool] = None,
                        streaming_builder: Optional[Callable] = None,
                        deadline_s: float = 120.0,
-                       worker_probe: Optional[Callable] = None) -> dict:
+                       worker_probe: Optional[Callable] = None,
+                       ckpt_every: Optional[int] = None,
+                       transport: Optional[str] = None) -> dict:
     """Assemble one slide from the channel, recovering from worker loss.
 
     ``streaming`` (default: the plan's ``chunked_prefill`` field, else
@@ -182,11 +236,24 @@ def run_slide_consumer(root: str, *, runlog=None,
     path to notice). Cross-host consumers pass nothing and rely on
     leases alone.
 
+    ``ckpt_every`` (plan ``consumer_ckpt_every`` /
+    ``GIGAPATH_DIST_CONSUMER_CKPT_EVERY``; 0 = off): checkpoint the
+    fold state every N delivered chunks through
+    :class:`~gigapath_tpu.resilience.checkpoint.ResilientCheckpointer`'s
+    atomic manifest discipline, and DEFER acks until the covering
+    checkpoint commits — the ack watermark is the durable watermark, so
+    a producer (or the reconnect handshake) replays exactly what a
+    SIGKILLed consumer actually lost. A restart finds the checkpoint,
+    emits ``consumer_lost`` + ``recovery action="consumer_resume"``,
+    reloads the watermark, re-handshakes, receives only post-watermark
+    chunks, and produces a BIT-exact slide embedding.
+
     Returns ``{"embedding", "assembled", "coords", "stats", "lost",
     "reassignments"}``; raises TimeoutError when the slide cannot
     complete within ``deadline_s`` (no silent partial slides)."""
-    from gigapath_tpu.obs.runlog import get_run_log
+    from gigapath_tpu.obs.runlog import env_number, get_run_log
     from gigapath_tpu.obs.watchdog import CompileWatchdog
+    from gigapath_tpu.resilience.checkpoint import ResilientCheckpointer
 
     plan = load_plan(root)
     cfg = BoundaryConfig.from_env(
@@ -201,6 +268,7 @@ def run_slide_consumer(root: str, *, runlog=None,
                     "workers": plan["workers"],
                     "chunk_tiles": cfg.chunk_tiles},
         )
+    chaos = get_chaos(runlog)
     if streaming is None:
         # one host-side read, the PipelineFlags convention: the plan
         # document wins (every process sees the same mode), the env
@@ -211,8 +279,61 @@ def run_slide_consumer(root: str, *, runlog=None,
             from gigapath_tpu.ops.pallas_dilated import snapshot_flags
 
             streaming = snapshot_flags().chunked_prefill
-    consumer = DirChannelConsumer(root, cfg, runlog=runlog)
+    if ckpt_every is None:
+        ckpt_every = plan.get("consumer_ckpt_every")
+    if ckpt_every is None:
+        ckpt_every = env_number("GIGAPATH_DIST_CONSUMER_CKPT_EVERY", 0)
+    ckpt_every = int(ckpt_every)
+    if ckpt_every > cfg.capacity:
+        # acks are deferred to the checkpoint cadence: a cadence past
+        # the credit window would park every producer at 0 credits while
+        # the consumer waits for chunks that can no longer arrive
+        raise ValueError(
+            f"consumer_ckpt_every={ckpt_every} exceeds the credit "
+            f"capacity {cfg.capacity}: the deferred-ack discipline "
+            "would deadlock — lower the cadence or raise "
+            "GIGAPATH_DIST_CREDITS"
+        )
+    checkpointer = (
+        ResilientCheckpointer(os.path.join(root, CONSUMER_CKPT_DIR),
+                              keep=2, runlog=runlog)
+        if ckpt_every > 0 else None
+    )
+    restored_state = None
+    prior = read_lease(root, "consumer")
+    if checkpointer is not None and checkpointer.checkpoints():
+        # a checkpoint exists before this consumer delivered anything:
+        # a predecessor died mid-slide. The worker_lost-style event
+        # first (with the stale lease as post-mortem context), then the
+        # verified restore.
+        prior = prior or {}
+        runlog.event(
+            "consumer_lost", stage="slide", reason="checkpoint_found",
+            pid=prior.get("pid"), last_renew=prior.get("renewed"),
+        )
+        runlog.echo(
+            "[dist] consumer_lost: predecessor left a mid-slide "
+            f"checkpoint (pid {prior.get('pid')}); resuming"
+        )
+        restored_state = checkpointer.restore_latest(emit_resume=False)
+    elif prior and prior.get("pid") != os.getpid():
+        # no checkpoint, but a stale consumer lease: the predecessor
+        # died before its first checkpoint ever committed (leases only
+        # outlive a CRASH — clean exits retire them). Nothing to
+        # restore — every chunk is still unacked at the producers — but
+        # the death itself must not be invisible on the bus.
+        runlog.event(
+            "consumer_lost", stage="slide", reason="stale_lease",
+            pid=prior.get("pid"), last_renew=prior.get("renewed"),
+        )
+        runlog.echo(
+            "[dist] consumer_lost: predecessor died before its first "
+            f"checkpoint (pid {prior.get('pid')}); starting fresh"
+        )
     membership = Membership(root, runlog=runlog)
+    lease = WorkerLease(root, "consumer", stage="slide",
+                        lease_s=plan.get("lease_s"))
+    lease.register()
     chunks = plan_chunks(int(plan["n_tiles"]), cfg.chunk_tiles)
     session = None
     head_fn = None
@@ -231,6 +352,26 @@ def run_slide_consumer(root: str, *, runlog=None,
     else:
         assembler = SlideAssembler(int(plan["n_tiles"]), int(plan["dim_out"]))
     assembler.expect([c[0] for c in chunks])
+    watermark: List[int] = []
+    if restored_state is not None:
+        state, ckpt_step = restored_state
+        watermark = _restore_consumer_state(state, assembler, session)
+        runlog.recovery(
+            action="consumer_resume", step=ckpt_step,
+            chunks=len(watermark),
+            missing=len(assembler.missing()),
+        )
+        runlog.echo(
+            f"[dist] consumer_resume: watermark {len(watermark)} "
+            f"chunk(s), {len(assembler.missing())} still missing"
+        )
+    # the transport seam (dir / tcp, one protocol): a restarted
+    # consumer seeds its dedup + ack watermark from the checkpoint, so
+    # the reconnect handshake replays only post-watermark chunks
+    consumer = make_consumer(root, cfg, runlog=runlog,
+                             transport=transport or plan.get("transport"),
+                             delivered=watermark,
+                             run_id=getattr(runlog, "run_id", ""))
 
     # who currently owns which chunk (updated by reassignments): the
     # coordinator's view of the SAME deterministic assignment the
@@ -241,8 +382,24 @@ def run_slide_consumer(root: str, *, runlog=None,
                                      plan["workers"]).items()
     }
     reassignments = 0
+    pending_acks: List[int] = []
+    delivered_here = 0  # chunks THIS process delivered (chaos cadence)
     deadline = time.monotonic() + deadline_s
     status = "ok"
+
+    def _commit(final: bool = False) -> None:
+        """Checkpoint the fold state, THEN flush the deferred acks: an
+        ack is a promise the chunk is durable, so it must never precede
+        the checkpoint that makes it so. With checkpointing off, acks
+        are immediate and this only flushes."""
+        if checkpointer is not None and (pending_acks or final):
+            checkpointer.save(
+                len(assembler.received),
+                _export_consumer_state(assembler, session),
+            )
+        while pending_acks:
+            consumer.ack(pending_acks.pop(0))
+
     try:
         while not assembler.complete():
             if time.monotonic() >= deadline:
@@ -250,7 +407,12 @@ def run_slide_consumer(root: str, *, runlog=None,
                     f"slide '{plan['slide_id']}' incomplete after "
                     f"{deadline_s}s: missing chunks {assembler.missing()}"
                 )
-            newly_lost = membership.poll_lost()
+            lease.renew()
+            # the lease directory also carries the consumer's OWN lease
+            # (and a crashed predecessor's stale one): only tile workers
+            # of the plan are reassignment subjects
+            newly_lost = [w for w in membership.poll_lost()
+                          if w in plan["workers"]]
             if worker_probe is not None:
                 for w, rc in worker_probe().items():
                     if rc is None or rc == 0:
@@ -283,8 +445,13 @@ def run_slide_consumer(root: str, *, runlog=None,
             chunk = consumer.recv(timeout=cfg.poll_s * 5)
             if chunk is None:
                 continue
-            consumer.ack(chunk.seq)
-            if assembler.add(chunk) and session is not None:
+            if not assembler.add(chunk):
+                # belt under the transport's dedup suspenders: already
+                # held (and, with a checkpoint, already durable) — ack
+                # so the producer's credit comes home
+                consumer.ack(chunk.seq)
+                continue
+            if session is not None:
                 # fold on arrival: the session frontier-buffers
                 # out-of-order deliveries, so the executed fold order —
                 # and the embedding, bit-exact — is the plan's, not the
@@ -292,7 +459,20 @@ def run_slide_consumer(root: str, *, runlog=None,
                 # stage-2 folding; by completion only the final layers
                 # remain.
                 session.feed(chunk.chunk_id, chunk.payload, chunk.coords)
+            delivered_here += 1
+            if chaos:
+                # the consumer-crash injection point: AFTER the fold,
+                # BEFORE any checkpoint/ack — what dies here is exactly
+                # the state only a checkpoint brings back
+                chaos.maybe_kill_consumer(delivered_here)
+            if checkpointer is None:
+                consumer.ack(chunk.seq)
+            else:
+                pending_acks.append(chunk.seq)
+                if len(pending_acks) >= ckpt_every:
+                    _commit()
 
+        _commit(final=True)
         if session is not None:
             embedding = head_fn(session.finalize())
             runlog.event("stream_finalize", slide=plan["slide_id"],
@@ -314,8 +494,15 @@ def run_slide_consumer(root: str, *, runlog=None,
         raise
     finally:
         # DONE even on failure: stranded workers must drain, not spin
-        # out their whole deadline
+        # out their whole deadline. (A SIGKILLed consumer never reaches
+        # here — no DONE — so the fleet keeps producing for the
+        # restarted consumer.)
         atomic_touch(os.path.join(root, DONE_MARKER))
+        if status == "ok":
+            lease.retire()
+        close = getattr(consumer, "close", None)
+        if close is not None:
+            close()
         if own_log:
             runlog.run_end(
                 status=status, slide=plan["slide_id"],
@@ -355,15 +542,56 @@ def spawn_worker(root: str, worker_id: str, *,
     )
 
 
+def spawn_consumer(root: str, *, chaos: Optional[str] = None,
+                   run_id: Optional[str] = None,
+                   deadline_s: float = 120.0) -> subprocess.Popen:
+    """The slide consumer as ITS OWN OS process (``python -m
+    gigapath_tpu.dist.pipeline``) — the shape the consumer-crash
+    acceptance needs: SIGKILLable, restartable, resuming from its
+    checkpoint. ``chaos`` lands in that process's ``GIGAPATH_CHAOS``
+    only (``kill_consumer@K``)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("GIGAPATH_CHAOS", None)
+    if chaos:
+        env["GIGAPATH_CHAOS"] = chaos
+    if run_id:
+        env["GIGAPATH_OBS_RUN_ID"] = run_id
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "gigapath_tpu.dist.pipeline",
+         "--root", root, "--deadline-s", str(deadline_s)],
+        env=env, cwd=REPO_ROOT,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def load_result(root: str) -> dict:
+    """The subprocess consumer's published result
+    (``<root>/result.npz``, atomic write)."""
+    with np.load(os.path.join(root, RESULT_FILE),
+                 allow_pickle=False) as z:
+        return {"embedding": np.asarray(z["embedding"]),
+                "streaming": bool(z["streaming"])}
+
+
 def run_disaggregated(root: str, *, plan: Optional[dict] = None,
                       worker_chaos: Optional[Dict[str, str]] = None,
                       runlog=None, deadline_s: float = 120.0,
-                      run_id: Optional[str] = None) -> dict:
+                      run_id: Optional[str] = None,
+                      consumer_chaos: Optional[str] = None,
+                      consumer_restarts: int = 1) -> dict:
     """The dryrun: plan -> worker fleet (real processes) -> consumer.
 
     ``worker_chaos`` maps worker id -> ``GIGAPATH_CHAOS`` spec for that
     worker's process. Returns the consumer result plus worker exit
-    codes."""
+    codes.
+
+    ``consumer_chaos`` (e.g. ``"kill_consumer@5"``) moves the consumer
+    into its OWN process too; when that process dies nonzero the
+    orchestrator restarts it (chaos-free) up to ``consumer_restarts``
+    times — the restarted consumer resumes from its checkpoint
+    watermark. The result then carries ``consumer_exit_codes``."""
     plan = plan or default_plan()
     write_plan(root, plan)
     worker_chaos = worker_chaos or {}
@@ -372,15 +600,34 @@ def run_disaggregated(root: str, *, plan: Optional[dict] = None,
                         deadline_s=deadline_s)
         for w in plan["workers"]
     }
+    consumer_exits: List[int] = []
     try:
-        result = run_slide_consumer(
-            root, runlog=runlog, deadline_s=deadline_s,
-            # the orchestrator holds the process handles: report a
-            # nonzero exit the moment it happens instead of waiting out
-            # the lease (and catch workers that died before their first
-            # lease registration)
-            worker_probe=lambda: {w: p.poll() for w, p in procs.items()},
-        )
+        if consumer_chaos is None:
+            result = run_slide_consumer(
+                root, runlog=runlog, deadline_s=deadline_s,
+                # the orchestrator holds the process handles: report a
+                # nonzero exit the moment it happens instead of waiting
+                # out the lease (and catch workers that died before
+                # their first lease registration)
+                worker_probe=lambda: {w: p.poll() for w, p in procs.items()},
+            )
+        else:
+            proc = spawn_consumer(root, chaos=consumer_chaos,
+                                  run_id=run_id, deadline_s=deadline_s)
+            consumer_exits.append(proc.wait())
+            while consumer_exits[-1] != 0 and \
+                    len(consumer_exits) <= consumer_restarts:
+                proc = spawn_consumer(root, run_id=run_id,
+                                      deadline_s=deadline_s)
+                consumer_exits.append(proc.wait())
+            if consumer_exits[-1] != 0:
+                raise RuntimeError(
+                    f"consumer never completed: exit codes "
+                    f"{consumer_exits}"
+                )
+            result = load_result(root)
+            result.update(assembled=None, coords=None, stats=None,
+                          lost=None, reassignments=None)
     finally:
         exit_codes: Dict[str, Optional[int]] = {}
         for w, proc in procs.items():
@@ -390,4 +637,31 @@ def run_disaggregated(root: str, *, plan: Optional[dict] = None,
                 proc.kill()
                 exit_codes[w] = proc.wait()
     result["worker_exit_codes"] = exit_codes
+    if consumer_exits:
+        result["consumer_exit_codes"] = consumer_exits
     return result
+
+
+def main(argv=None) -> int:
+    """``python -m gigapath_tpu.dist.pipeline`` — the slide consumer as
+    a standalone process (the SIGKILLable half of the consumer-crash
+    acceptance). Publishes its result atomically to
+    ``<root>/result.npz`` so the orchestrator reads it across the
+    process boundary."""
+    ap = argparse.ArgumentParser(
+        description="dist slide-stage consumer (module docstring)"
+    )
+    ap.add_argument("--root", required=True, help="shared pipeline workdir")
+    ap.add_argument("--deadline-s", type=float, default=120.0)
+    args = ap.parse_args(argv)
+    result = run_slide_consumer(args.root, deadline_s=args.deadline_s)
+    tmp = os.path.join(args.root, f"{RESULT_FILE}.tmp-{os.getpid()}")
+    with open(tmp, "wb") as fh:
+        np.savez(fh, embedding=np.asarray(result["embedding"], np.float32),
+                 streaming=np.bool_(result["streaming"]))
+    os.replace(tmp, os.path.join(args.root, RESULT_FILE))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

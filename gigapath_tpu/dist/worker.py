@@ -51,7 +51,6 @@ import numpy as np
 
 from gigapath_tpu.dist.boundary import (
     BoundaryConfig,
-    DirChannelProducer,
     EmbeddingChunk,
     assign_chunks,
     plan_chunks,
@@ -61,6 +60,7 @@ from gigapath_tpu.dist.membership import (
     atomic_write_json,
     reassignments_for,
 )
+from gigapath_tpu.dist.transport import make_producer
 from gigapath_tpu.resilience.chaos import get_chaos
 
 DONE_MARKER = "DONE"
@@ -117,7 +117,6 @@ def run_tile_worker(root: str, worker_id: str, *,
     re-assigned to it) until the consumer publishes DONE. Returns the
     channel stats (also folded into the worker's ``run_end``)."""
     plan = load_plan(root)
-    chaos = get_chaos()
     cfg = BoundaryConfig.from_env(
         capacity=plan.get("credits"), chunk_tiles=plan.get("chunk_tiles"),
         retransmit_s=plan.get("retransmit_s"), poll_s=plan.get("poll_s"),
@@ -134,6 +133,9 @@ def run_tile_worker(root: str, worker_id: str, *,
         runlog.event("run_start", driver=f"dist-{worker_id}",
                      pid=os.getpid(), worker=worker_id,
                      slide=plan.get("slide_id"))
+    # chaos parses AFTER the log exists: a typo'd spec is an error event
+    # + raise, never a silently clean chaos run
+    chaos = get_chaos(runlog)
     workers = sorted(plan["workers"])
     rank = workers.index(worker_id) if worker_id in workers else -1
     chunks = plan_chunks(int(plan["n_tiles"]), cfg.chunk_tiles)
@@ -146,8 +148,13 @@ def run_tile_worker(root: str, worker_id: str, *,
                         lease_s=plan.get("lease_s"))
     lease.register()
     weights = encoder_weights(plan)
-    producer = DirChannelProducer(root, cfg, producer=worker_id,
-                                  runlog=runlog, chaos=chaos)
+    # the transport seam: dir (the dryrun stand-in) or tcp (the real
+    # wire), chosen by the plan / GIGAPATH_DIST_TRANSPORT — nothing
+    # below this line changes with the transport
+    producer = make_producer(root, cfg, producer=worker_id,
+                             runlog=runlog, chaos=chaos,
+                             transport=plan.get("transport"),
+                             run_id=getattr(runlog, "run_id", ""))
     from gigapath_tpu.obs.spans import span
 
     pending: List[int] = list(mine)

@@ -384,6 +384,69 @@ class StreamingEncoderSession:
         return [i for i in range(self._next_tile_chunk, self.n_chunks)
                 if i not in self._held] + sorted(self._held)
 
+    # -- consumer crash recovery (ISSUE 13) ---------------------------------
+
+    def export_state(self) -> dict:
+        """The session's recovery-critical state as a string-keyed
+        pytree of host arrays: the ingest frontier, the resident
+        per-block residual stream, the frontier-held raw chunks, and the
+        layer-0 fold partials (:meth:`StreamingPrefillState.
+        export_state`). Saved by the dist consumer through
+        ``resilience/checkpoint.py``'s atomic manifest discipline;
+        restored into a geometry-identical fresh session, the remaining
+        feeds execute the same deterministic fold schedule and the final
+        embedding is BIT-exact vs an uninterrupted run."""
+        state: dict = {
+            "next_tile_chunk": np.int64(self._next_tile_chunk),
+        }
+        for i, blk in enumerate(self._h_blocks):
+            if blk is not None:
+                state[f"h_{i}"] = np.asarray(jax.device_get(blk))
+        for i, (embeds, coords) in self._held.items():
+            state[f"held_{i}"] = {"embeds": np.asarray(embeds),
+                                  "coords": np.asarray(coords)}
+        state["layer0"] = self._layer0.export_state()
+        return state
+
+    def restore_state(self, state: dict) -> None:
+        """Inverse of :meth:`export_state` — the session must have been
+        constructed with the same (model, n_tiles, chunk_tiles)
+        geometry; everything the constructor folded (the cls block) is
+        overwritten wholesale by the restored frontier.
+
+        Restored arrays are placed with the LIVE stage executables'
+        output sharding (taken from the constructor's own cls-block
+        fold): a restored block on the default SingleDeviceSharding
+        next to mesh-placed fresh blocks would give every post-resume
+        stage call a fresh jit cache key — one silent recompile per
+        shape, exactly what the per-stage watchdogs flag."""
+        sharding = None
+        cls_qkv = getattr(self._layer0, "_qkv", {}).get(0)
+        if cls_qkv is not None:
+            sharding = getattr(cls_qkv[0], "sharding", None)
+
+        def place(x):
+            arr = jnp.asarray(x, self.dtype)
+            if sharding is not None:
+                try:
+                    arr = jax.device_put(arr, sharding)
+                except (ValueError, TypeError):
+                    pass
+            return arr
+
+        self._next_tile_chunk = int(state["next_tile_chunk"])
+        self._h_blocks = [None] * len(self.token_bounds)
+        self._held = {}
+        for key, value in state.items():
+            if key.startswith("h_"):
+                self._h_blocks[int(key[len("h_"):])] = place(value)
+            elif key.startswith("held_"):
+                self._held[int(key[len("held_"):])] = (
+                    np.asarray(value["embeds"]),
+                    np.asarray(value["coords"], np.float32),
+                )
+        self._layer0.restore_state(state["layer0"], sharding=sharding)
+
     def complete(self) -> bool:
         return self._next_tile_chunk == self.n_chunks
 

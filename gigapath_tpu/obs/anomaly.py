@@ -88,6 +88,7 @@ from gigapath_tpu.obs.flight import FlightRecorder, register_signal_dump
 DETECTORS = (
     "step_time_spike", "throughput_dip", "stall", "unexpected_retrace",
     "memory_watermark", "nonfinite_step", "slo_burn", "worker_lost",
+    "consumer_lost",
 )
 
 
@@ -358,6 +359,17 @@ class AnomalyEngine(NullAnomalyEngine):
                     worker=record.get("worker"),
                     stage=record.get("stage"),
                     value=record.get("expired_by_s"),
+                )
+            elif kind == "consumer_lost":
+                # the slide-stage twin of worker_lost (a restarted
+                # consumer found its predecessor's mid-slide
+                # checkpoint): flight context for the post-mortem, the
+                # ``recovery action="consumer_resume"`` event follows
+                self._fire(
+                    "consumer_lost",
+                    stage=record.get("stage"),
+                    reason=record.get("reason"),
+                    value=record.get("pid"),
                 )
             elif kind == "error":
                 # context dump only — the error event is its own record

@@ -50,6 +50,8 @@ _DIRECTION_RULES: Tuple[Tuple[str, str], ...] = (
     ("queue_wait_p90_s", "down"),
     ("chunks_per_sec", "up"),
     ("recover_extra_s", "down"),   # kill-recover wall over the clean run's
+    ("reconnect_s", "down"),       # TCP chaos wall over the clean TCP run's
+    ("consumer_recover_s", "down"),  # consumer kill-restart extra wall
     # latency-histogram quantiles (the serve|latency entry and any
     # future *_pNN_s metric): tail latency down-is-good
     ("_p50_s", "down"),
@@ -277,9 +279,11 @@ def fold_serve_latency(doc: dict, snapshot: dict, label: str,
 
 
 # dist_smoke payload fields worth trending (scripts/dist_smoke.py's
-# JSON line): boundary throughput and the cost of losing a worker
+# JSON line): boundary throughput, the cost of losing a worker, and the
+# cost of surviving connection-level chaos on the TCP transport
 _DIST_METRICS = (
     "chunks_per_sec", "clean_wall_s", "recover_extra_s",
+    "reconnect_s", "consumer_recover_s",
     "workers", "chunks",
 )
 

@@ -57,6 +57,11 @@ Event kinds (schema v1, one JSON object per line, every record carries
   (:mod:`gigapath_tpu.dist.membership`): worker, stage, seconds past
   expiry, last renewal — fires the anomaly engine's ``worker_lost``
   detector and precedes the ``recovery action="reassign"`` event;
+- ``consumer_lost`` — a restarted slide-stage consumer found its dead
+  predecessor's mid-slide checkpoint (:mod:`gigapath_tpu.dist.pipeline`):
+  stage, reason, the stale lease's pid/renewal — fires the anomaly
+  engine's ``consumer_lost`` detector and precedes the
+  ``recovery action="consumer_resume"`` event;
 - ``error``      — exception surfaced by a driver;
 - ``run_end``    — terminal status + summary payload.
 
@@ -82,7 +87,7 @@ EVENT_KINDS = (
     "run_start", "step", "compile", "compile_profile", "span", "eval",
     "heartbeat", "stall", "anomaly", "recovery", "serve_dispatch",
     "cache_hit", "metrics", "slo", "trace", "backpressure", "worker_lost",
-    "error", "run_end",
+    "consumer_lost", "error", "run_end",
 )
 
 
@@ -331,7 +336,8 @@ class RunLog(NullRunLog):
         (:mod:`gigapath_tpu.resilience` / the serving self-healing):
         skip_step, rollback, rollback_unavailable, resume,
         emergency_checkpoint, data_retry, shed, deadline, bisect,
-        poisoned_request, breaker_*, drain, reassign —
+        poisoned_request, breaker_*, drain, reassign, reconnect,
+        consumer_resume —
         rendered by ``scripts/obs_report.py``'s ``== recovery ==``."""
         return self.event("recovery", action=action, **fields)
 

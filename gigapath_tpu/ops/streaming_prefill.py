@@ -394,6 +394,72 @@ class StreamingPrefillState:
         for j in [j for j in self._qkv if self._last_use[j] < self._next]:
             del self._qkv[j]
 
+    def export_state(self) -> dict:
+        """The fold's recovery-critical state as a flat-string-keyed
+        pytree of host arrays: the fold frontier, the resident q/k/v
+        blocks, and every branch's running ``(out, lse)`` partials.
+        Geometry (bounds/branches/plans) is NOT exported — it is a pure
+        function of the slide, reconstructed at restore by building the
+        same state object. ``restore_state`` on a geometry-identical
+        fresh instance is BIT-exact: the partials round-trip through
+        host memory unchanged and the remaining folds execute the same
+        deterministic schedule (the consumer-crash-recovery contract,
+        ISSUE 13)."""
+        import numpy as np
+
+        state: dict = {"next": np.int64(self._next),
+                       "folds": np.int64(self.folds)}
+        for i, (q, k, v) in self._qkv.items():
+            state[f"qkv_{i}"] = {
+                "q": np.asarray(jax.device_get(q)),
+                "k": np.asarray(jax.device_get(k)),
+                "v": np.asarray(jax.device_get(v)),
+            }
+        for b, per_chunk in enumerate(self._acc):
+            for i, acc in enumerate(per_chunk):
+                if acc is None:
+                    continue
+                state[f"acc_{b}_{i}"] = {
+                    "out": np.asarray(jax.device_get(acc[0])),
+                    "lse": np.asarray(jax.device_get(acc[1])),
+                }
+        return state
+
+    def restore_state(self, state: dict, *, sharding=None) -> None:
+        """Inverse of :meth:`export_state` (same geometry required).
+
+        ``sharding``: placement for the restored arrays — pass the LIVE
+        jit outputs' sharding (the :meth:`_seed` lesson: a restored
+        block left on the default SingleDeviceSharding while freshly
+        computed blocks carry a NamedSharding makes every post-resume
+        fold a fresh jit cache entry — one silent recompile per shape,
+        flagged by the stage watchdogs)."""
+
+        def place(x):
+            arr = jnp.asarray(x)
+            if sharding is not None:
+                try:
+                    arr = jax.device_put(arr, sharding)
+                except (ValueError, TypeError):
+                    pass  # rank-specific spec: keep the default placement
+            return arr
+
+        self._next = int(state["next"])
+        self.folds = int(state["folds"])
+        self._qkv = {}
+        self._acc = [[None] * self.n_chunks for _ in self.branches]
+        for key, value in state.items():
+            if key.startswith("qkv_"):
+                i = int(key[len("qkv_"):])
+                self._qkv[i] = (
+                    place(value["q"]), place(value["k"]), place(value["v"]),
+                )
+            elif key.startswith("acc_"):
+                b, i = (int(p) for p in key[len("acc_"):].split("_"))
+                self._acc[b][i] = (
+                    place(value["out"]), place(value["lse"]),
+                )
+
     def finalize(self) -> List[jnp.ndarray]:
         """-> per-chunk fused output blocks ``[B, c, H, D]`` in chunk
         order. Exact parity target: the dense oracle's per-position

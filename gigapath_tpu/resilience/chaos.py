@@ -30,6 +30,10 @@ trace time — GL001-clean because no injector is trace-reachable):
   producing K chunks (the hard-death case: no goodbye, the lease just
   stops renewing — drives lease expiry -> ``worker_lost`` ->
   reassignment in :mod:`gigapath_tpu.dist`);
+- ``kill_consumer@K`` — dist: the slide-stage consumer SIGKILLs itself
+  after K delivered chunks (the consumer-crash case: its streaming fold
+  state is gone unless checkpointed — drives the ``consumer_lost`` ->
+  ``recovery action="consumer_resume"`` path);
 - ``slow_worker@K:S`` — dist: sleep S seconds before producing chunk
   ``K`` (``K = *`` slows EVERY chunk — the straggler whose skew the
   per-rank span table must surface);
@@ -38,7 +42,21 @@ trace time — GL001-clean because no injector is trace-reachable):
   retransmit timer heals it);
 - ``dup_chunk@K``     — dist: chunk seq ``K`` is sent twice (the
   consumer's seq dedup absorbs the twin);
+- ``drop_conn@K``     — dist/tcp: the connection dies mid-frame at data
+  frame ``K`` (half the frame bytes land, then the socket closes — the
+  torn-write case; reconnect + handshake replay heals it);
+- ``delay_frame@K[:S]`` — dist/tcp: sleep S seconds before sending data
+  frame ``K`` (``K = *`` delays every frame);
+- ``corrupt_frame@K`` — dist/tcp: flip bytes inside data frame ``K``'s
+  body on the wire (the frame digest catches it; dropped + counted,
+  the retransmit timer heals it);
+- ``reorder_frame@K`` — dist/tcp: hold data frame ``K`` and send it
+  AFTER the next frame (out-of-order delivery; seq dedup + the fold
+  frontier absorb it);
 - ``seed=N``          — seed for the deterministic corruption bytes.
+
+All frame injectors act INSIDE the transport, host-side, at the frame
+layer — so a chaos run compiles the same programs as a clean one.
 
 All injection is host-side (batches are poisoned *before* they reach the
 jitted step), so chaos can change no compiled program and add no
@@ -92,6 +110,9 @@ class NullChaos:
     def maybe_kill_worker(self, produced: int) -> bool:
         return False
 
+    def maybe_kill_consumer(self, delivered: int) -> bool:
+        return False
+
     def slow_worker(self, chunk_index: int) -> float:
         return 0.0
 
@@ -99,6 +120,18 @@ class NullChaos:
         return False
 
     def dups_chunk(self, seq: int) -> bool:
+        return False
+
+    def drops_conn(self, frame_index: int) -> bool:
+        return False
+
+    def delay_frame(self, frame_index: int) -> float:
+        return 0.0
+
+    def corrupts_frame(self, frame_index: int) -> bool:
+        return False
+
+    def reorders_frame(self, frame_index: int) -> bool:
         return False
 
 
@@ -118,9 +151,14 @@ class ChaosInjector(NullChaos):
         self._poison_ids: List[str] = []
         self._slow_dispatch: Dict[str, float] = {}  # index (or "*") -> s
         self._kill_worker_after: Optional[int] = None
+        self._kill_consumer_after: Optional[int] = None
         self._slow_worker: Dict[str, float] = {}  # chunk (or "*") -> s
         self._drop_chunks: set = set()
         self._dup_chunks: set = set()
+        self._drop_conns: set = set()         # data frame index, one-shot
+        self._delay_frames: Dict[str, float] = {}  # frame (or "*") -> s
+        self._corrupt_frames: set = set()     # data frame index, one-shot
+        self._reorder_frames: set = set()     # data frame index, one-shot
         for token in spec.split(","):
             token = token.strip()
             if not token:
@@ -156,6 +194,8 @@ class ChaosInjector(NullChaos):
             self._slow_dispatch[idx or "*"] = float(secs) if secs else 1.0
         elif kind == "kill_worker":
             self._kill_worker_after = int(arg)
+        elif kind == "kill_consumer":
+            self._kill_consumer_after = int(arg)
         elif kind == "slow_worker":
             idx, _, secs = arg.partition(":")
             self._slow_worker[idx or "*"] = float(secs) if secs else 1.0
@@ -163,14 +203,25 @@ class ChaosInjector(NullChaos):
             self._drop_chunks.add(int(arg))
         elif kind == "dup_chunk":
             self._dup_chunks.add(int(arg))
+        elif kind == "drop_conn":
+            self._drop_conns.add(int(arg))
+        elif kind == "delay_frame":
+            idx, _, secs = arg.partition(":")
+            self._delay_frames[idx or "*"] = float(secs) if secs else 1.0
+        elif kind == "corrupt_frame":
+            self._corrupt_frames.add(int(arg))
+        elif kind == "reorder_frame":
+            self._reorder_frames.add(int(arg))
         else:
             raise ValueError(
                 f"GIGAPATH_CHAOS: unknown injector {token!r} (known: "
                 "nan_loss@K, corrupt_batch@K, sigterm@K, fail_loader@I[xN], "
                 "slow_loader@I[:S], corrupt_ckpt, poison@ID, "
                 "slow_dispatch@K[:S] (K='*' = all), kill_worker@K, "
-                "slow_worker@K[:S] (K='*' = all), drop_chunk@K, "
-                "dup_chunk@K, seed=N)"
+                "kill_consumer@K, slow_worker@K[:S] (K='*' = all), "
+                "drop_chunk@K, dup_chunk@K, drop_conn@K, "
+                "delay_frame@K[:S] (K='*' = all), corrupt_frame@K, "
+                "reorder_frame@K, seed=N)"
             )
 
     # -- batch faults (consulted by train loops, host-side) ---------------
@@ -253,6 +304,19 @@ class ChaosInjector(NullChaos):
         os.kill(os.getpid(), signal.SIGKILL)
         return True  # unreachable after SIGKILL; keeps the surface honest
 
+    def maybe_kill_consumer(self, delivered: int) -> bool:
+        """SIGKILL THIS process once ``delivered`` chunks have been
+        received — the slide-stage consumer consults this after each
+        delivery. The consumer-side twin of :meth:`maybe_kill_worker`:
+        no handler runs, the streaming fold state is simply gone, and
+        only a checkpoint brings the slide back."""
+        if (self._kill_consumer_after is None
+                or delivered < self._kill_consumer_after):
+            return False
+        self._kill_consumer_after = None  # one death per spec entry
+        os.kill(os.getpid(), signal.SIGKILL)
+        return True  # unreachable after SIGKILL; keeps the surface honest
+
     def slow_worker(self, chunk_index: int) -> float:
         """Seconds to sleep before producing chunk ``chunk_index``
         (``'*'`` = every chunk — the deterministic straggler)."""
@@ -271,6 +335,41 @@ class ChaosInjector(NullChaos):
     def dups_chunk(self, seq: int) -> bool:
         if seq in self._dup_chunks:
             self._dup_chunks.discard(seq)
+            return True
+        return False
+
+    # -- dist: TCP frame-layer faults (gigapath_tpu.dist.transport) -------
+    def drops_conn(self, frame_index: int) -> bool:
+        """True exactly ONCE per configured data-frame index: the
+        transport sends HALF the frame's bytes and closes the socket —
+        a torn write plus a dead connection, healed by reconnect +
+        handshake replay."""
+        if frame_index in self._drop_conns:
+            self._drop_conns.discard(frame_index)
+            return True
+        return False
+
+    def delay_frame(self, frame_index: int) -> float:
+        """Seconds to sleep before sending data frame ``frame_index``
+        (``'*'`` = every frame)."""
+        return self._delay_frames.get(
+            str(frame_index), self._delay_frames.get("*", 0.0)
+        )
+
+    def corrupts_frame(self, frame_index: int) -> bool:
+        """True exactly ONCE per configured data-frame index: bytes
+        inside the frame body are flipped AFTER the digest was computed,
+        so the receiver's sha256 check must catch and drop it."""
+        if frame_index in self._corrupt_frames:
+            self._corrupt_frames.discard(frame_index)
+            return True
+        return False
+
+    def reorders_frame(self, frame_index: int) -> bool:
+        """True exactly ONCE per configured data-frame index: the frame
+        is held back and sent after its successor."""
+        if frame_index in self._reorder_frames:
+            self._reorder_frames.discard(frame_index)
             return True
         return False
 
@@ -304,11 +403,25 @@ def corrupt_checkpoint_dir(path: str, seed: int = 0) -> Optional[str]:
     return target
 
 
-def get_chaos():
+def get_chaos(runlog=None):
     """Build the run's chaos injector from ``GIGAPATH_CHAOS``, read ONCE
     here, host-side, at driver start (never at trace time). Unset/empty
-    -> :class:`NullChaos` (falsy; drivers skip every consult)."""
+    -> :class:`NullChaos` (falsy; drivers skip every consult).
+
+    A typo'd spec must be a LOUD failure, never a silently clean run —
+    the whole point of a chaos run is the injection, and an injector
+    that quietly didn't parse is a recovery path that quietly wasn't
+    tested. Construction errors land as an ``error`` event on ``runlog``
+    (when given) and the ValueError propagates to the caller."""
     spec = os.environ.get("GIGAPATH_CHAOS", "").strip()
     if not spec:
         return NullChaos()
-    return ChaosInjector(spec)
+    try:
+        return ChaosInjector(spec)
+    except ValueError as e:
+        if runlog is not None:
+            try:
+                runlog.error("chaos_parse", e)
+            except Exception:
+                pass  # telemetry must not mask the parse error itself
+        raise

@@ -5,11 +5,11 @@ cross-stage boundary (ISSUE 11's acceptance driver).
     python scripts/dist_smoke.py
     python scripts/dist_smoke.py --json DIST_SMOKE.json
 
-Five checks, each a hard assertion (exit 1 + structured JSON on
+Seven checks, each a hard assertion (exit 1 + structured JSON on
 violation, bench.py-style; progress rides stderr). Every check runs a
-REAL fleet: tile-worker OS processes + the slide-stage consumer in this
-process, joined by the directory boundary channel
-(``gigapath_tpu/dist/``):
+REAL fleet: tile-worker OS processes + the slide-stage consumer, joined
+by the boundary channel (``gigapath_tpu/dist/``; directory transport
+for checks 1-5, the TCP transport for 6-7):
 
 1. **clean_parity**: two workers, no chaos — the assembled tile
    sequence and the slide forward match a single-process oracle
@@ -35,9 +35,25 @@ process, joined by the directory boundary channel
    tolerance (1e-5), and a ``kill_worker@1`` run is BIT-exact vs the
    clean STREAMING run — reassignment and out-of-order delivery are
    invisible to the deterministic fold order.
+6. **tcp_boundary** (ISSUE 13): the fleet joined by the REAL network
+   transport (``plan.transport="tcp"``, ``dist/transport.py``) — clean
+   TCP run bit-exact vs the single-process oracle, then a run under
+   ``drop_conn`` + ``corrupt_frame`` frame chaos (torn write + flipped
+   bytes, both healed by digest-drop/reconnect/handshake-replay) still
+   bit-exact, with frame errors counted, a ``reconnect`` recovery
+   event, and zero unexpected retraces. ``reconnect_s`` = chaos wall
+   over the clean TCP wall.
+7. **consumer_kill_recover** (ISSUE 13): the consumer runs as its OWN
+   process (streaming mode, TCP, ``consumer_ckpt_every``) and is
+   SIGKILLed mid-slide (``kill_consumer@K``); the restarted consumer
+   finds the checkpoint (``consumer_lost``), resumes from its ack
+   watermark (``recovery action="consumer_resume"``), receives only
+   post-watermark chunks, and the embedding is BIT-exact vs the clean
+   streaming run — zero unexpected retraces on the restarted leg.
 
 The JSON line carries the ``dist|smoke`` trend keys
-(``chunks_per_sec``, ``clean_wall_s``, ``recover_extra_s``);
+(``chunks_per_sec``, ``clean_wall_s``, ``recover_extra_s``,
+``reconnect_s``, ``consumer_recover_s``);
 ``perf_history.py ingest --dist`` folds them (CPU runs land stale —
 provenance, not a perf baseline). Pure-CPU, tiny shapes, no chip.
 """
@@ -116,7 +132,7 @@ def oracle(plan: dict):
 def check_clean_parity(root: str, plan: dict) -> dict:
     from gigapath_tpu.dist.pipeline import run_disaggregated
 
-    echo("1/5 clean_parity: two workers, no chaos")
+    echo("1/7 clean_parity: two workers, no chaos")
     t0 = time.monotonic()
     result = run_disaggregated(os.path.join(root, "clean"), plan=plan,
                                deadline_s=90)
@@ -134,7 +150,7 @@ def check_clean_parity(root: str, plan: dict) -> dict:
     assert all(rc == 0 for rc in result["worker_exit_codes"].values()), (
         result["worker_exit_codes"]
     )
-    echo(f"1/5 ok: bit-exact vs oracle, {stats['delivered']} chunks in "
+    echo(f"1/7 ok: bit-exact vs oracle, {stats['delivered']} chunks in "
          f"{wall:.1f}s")
     return {"wall_s": round(wall, 3), "chunks": stats["delivered"],
             "embedding": result["embedding"]}
@@ -143,7 +159,7 @@ def check_clean_parity(root: str, plan: dict) -> dict:
 def check_kill_recover(root: str, plan: dict, clean_embedding) -> dict:
     from gigapath_tpu.dist.pipeline import run_disaggregated
 
-    echo("2/5 kill_recover: SIGKILL w0 after 1 chunk, mid-slide")
+    echo("2/7 kill_recover: SIGKILL w0 after 1 chunk, mid-slide")
     t0 = time.monotonic()
     result = run_disaggregated(
         os.path.join(root, "kill"), plan=plan,
@@ -168,7 +184,7 @@ def check_kill_recover(root: str, plan: dict, clean_embedding) -> dict:
     unexpected = [ev for ev in events_of(events, "compile")
                   if ev.get("unexpected")]
     assert not unexpected, f"recovery paid unexpected retraces: {unexpected}"
-    echo(f"2/5 ok: lost w0, reassigned "
+    echo(f"2/7 ok: lost w0, reassigned "
          f"{reassigns[0].get('chunks')} chunk(s), bit-exact in {wall:.1f}s")
     return {"wall_s": round(wall, 3),
             "reassigned_chunks": reassigns[0].get("chunks")}
@@ -180,7 +196,7 @@ def check_slow_worker_skew(root: str, plan: dict, slow_s: float) -> dict:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import obs_report
 
-    echo(f"3/5 slow_worker_skew: w1 sleeps {slow_s}s per chunk")
+    echo(f"3/7 slow_worker_skew: w1 sleeps {slow_s}s per chunk")
     run_id = "dist-smoke-slow"
     out = os.path.join(root, "slow")
     result = run_disaggregated(
@@ -206,7 +222,7 @@ def check_slow_worker_skew(root: str, plan: dict, slow_s: float) -> dict:
     text = buf.getvalue()
     assert "per-rank skew (span 'dist.chunk')" in text, text
     assert "straggler: rank 1" in text, text
-    echo(f"3/5 ok: straggler rank 1 visible (medians {med})")
+    echo(f"3/7 ok: straggler rank 1 visible (medians {med})")
     return {"median_rank0_s": round(med[0], 4),
             "median_rank1_s": round(med[1], 4)}
 
@@ -214,7 +230,7 @@ def check_slow_worker_skew(root: str, plan: dict, slow_s: float) -> dict:
 def check_drop_dup_dedup(root: str, plan: dict, clean_embedding) -> dict:
     from gigapath_tpu.dist.pipeline import run_disaggregated
 
-    echo("4/5 drop_dup_dedup: drop chunk 0's first send, dup chunk 2")
+    echo("4/7 drop_dup_dedup: drop chunk 0's first send, dup chunk 2")
     result = run_disaggregated(
         os.path.join(root, "dropdup"), plan=plan,
         worker_chaos={"w0": "drop_chunk@0,dup_chunk@2"}, deadline_s=90,
@@ -234,7 +250,7 @@ def check_drop_dup_dedup(root: str, plan: dict, clean_embedding) -> dict:
         f"the dropped chunk was not retransmitted: {worker_ends}"
     )
     assert worker_ends[0].get("dropped", 0) >= 1, worker_ends
-    echo(f"4/5 ok: {stats['duplicates']} dup(s) deduped, "
+    echo(f"4/7 ok: {stats['duplicates']} dup(s) deduped, "
          f"{worker_ends[0]['retransmits']} retransmit(s) healed the drop")
     return {"duplicates": stats["duplicates"],
             "retransmits": worker_ends[0]["retransmits"]}
@@ -248,7 +264,7 @@ def check_streaming_prefill(root: str, plan: dict, clean_embedding) -> dict:
     frontier absorbs reassignment + out-of-order delivery)."""
     from gigapath_tpu.dist.pipeline import run_disaggregated
 
-    echo("5/5 streaming_prefill: consumer folds chunks on arrival")
+    echo("5/7 streaming_prefill: consumer folds chunks on arrival")
     stream_plan = dict(plan, chunked_prefill=True)
     t0 = time.monotonic()
     result = run_disaggregated(os.path.join(root, "stream"),
@@ -290,12 +306,122 @@ def check_streaming_prefill(root: str, plan: dict, clean_embedding) -> dict:
             f"{leg}: streaming stages paid unexpected retraces: "
             f"{unexpected}"
         )
-    echo(f"5/5 ok: fold-on-arrival parity + BIT-exact kill-recover in "
+    echo(f"5/7 ok: fold-on-arrival parity + BIT-exact kill-recover in "
          f"{wall:.1f}s")
     return {"wall_s": round(wall, 3),
             "max_err_vs_dense": float(
                 np.abs(result["embedding"] - clean_embedding).max()),
-            "kill_reassignments": kill["reassignments"]}
+            "kill_reassignments": kill["reassignments"],
+            "embedding": result["embedding"]}
+
+
+def check_tcp_boundary(root: str, plan: dict, clean_embedding) -> dict:
+    """Check 6: the REAL network transport (ISSUE 13 acceptance a) —
+    clean TCP parity vs the single-process oracle, then frame-layer
+    chaos (``drop_conn`` tears a frame mid-write and kills the
+    connection; ``corrupt_frame`` flips body bytes past the digest)
+    healed by reconnect + handshake-watermark replay, BIT-exact, with
+    zero unexpected retraces."""
+    from gigapath_tpu.dist.pipeline import run_disaggregated
+
+    echo("6/7 tcp_boundary: fleet over TCP, then drop_conn+corrupt_frame")
+    tcp_plan = dict(plan, transport="tcp")
+    t0 = time.monotonic()
+    result = run_disaggregated(os.path.join(root, "tcp"), plan=tcp_plan,
+                               deadline_s=90)
+    tcp_wall = time.monotonic() - t0
+    # check 1 already proved clean_embedding == the single-process
+    # oracle bit-exact; reuse it instead of paying a second oracle
+    # compile+forward
+    out = clean_embedding
+    assert np.array_equal(result["embedding"], out), (
+        "TCP clean run differs from the single-process oracle"
+    )
+    assert result["stats"]["frame_errors"] == 0, result["stats"]
+
+    t0 = time.monotonic()
+    chaos = run_disaggregated(
+        os.path.join(root, "tcp-chaos"), plan=tcp_plan,
+        worker_chaos={"w0": "drop_conn@1,corrupt_frame@2"}, deadline_s=90,
+    )
+    chaos_wall = time.monotonic() - t0
+    assert np.array_equal(chaos["embedding"], out), (
+        "TCP chaos run is NOT bit-exact vs the oracle"
+    )
+    assert chaos["stats"]["frame_errors"] >= 1, (
+        f"frame chaos left no frame_errors count: {chaos['stats']}"
+    )
+    events = run_events(os.path.join(root, "tcp-chaos"))
+    reconnects = events_of(events, "recovery", action="reconnect")
+    assert reconnects, "drop_conn did not force a reconnect"
+    unexpected = [ev for ev in events_of(events, "compile")
+                  if ev.get("unexpected")]
+    assert not unexpected, (
+        f"TCP chaos recovery paid unexpected retraces: {unexpected}"
+    )
+    reconnect_s = round(max(chaos_wall - tcp_wall, 0.0), 3)
+    echo(f"6/7 ok: TCP bit-exact clean+chaos, "
+         f"{chaos['stats']['frame_errors']} frame error(s) healed, "
+         f"reconnect_s={reconnect_s}")
+    return {"wall_s": round(tcp_wall, 3),
+            "chaos_wall_s": round(chaos_wall, 3),
+            "frame_errors": chaos["stats"]["frame_errors"],
+            "reconnects": len(reconnects),
+            "reconnect_s": reconnect_s}
+
+
+def check_consumer_kill_recover(root: str, plan: dict,
+                                stream_embedding, stream_wall: float,
+                                kill_after: int = 3) -> dict:
+    """Check 7: consumer crash recovery (ISSUE 13 acceptance b) — the
+    slide consumer runs as its own process over TCP in streaming mode
+    with checkpointing on, gets SIGKILLed after ``kill_after`` delivered
+    chunks, and the restarted consumer resumes from the checkpoint
+    watermark to a BIT-exact embedding, with ``consumer_lost`` +
+    ``recovery action="consumer_resume"`` on the bus and zero
+    unexpected retraces on the restarted leg."""
+    from gigapath_tpu.dist.pipeline import run_disaggregated
+
+    echo(f"7/7 consumer_kill_recover: SIGKILL consumer after "
+         f"{kill_after} chunks, restart from checkpoint")
+    ckpt_plan = dict(plan, chunked_prefill=True, transport="tcp",
+                     consumer_ckpt_every=2, lease_s=max(plan["lease_s"], 2.0))
+    out = os.path.join(root, "consumer-kill")
+    t0 = time.monotonic()
+    result = run_disaggregated(
+        out, plan=ckpt_plan,
+        consumer_chaos=f"kill_consumer@{kill_after}", deadline_s=90,
+    )
+    wall = time.monotonic() - t0
+    exits = result["consumer_exit_codes"]
+    assert exits[0] == -9, f"consumer was not SIGKILLed: {exits}"
+    assert exits[-1] == 0, f"restarted consumer failed: {exits}"
+    assert np.array_equal(result["embedding"], stream_embedding), (
+        "consumer kill-recover is NOT bit-exact vs the clean "
+        "streaming run"
+    )
+    events = run_events(out)
+    lost = events_of(events, "consumer_lost")
+    assert lost, "no consumer_lost event from the restarted consumer"
+    resumes = events_of(events, "recovery", action="consumer_resume")
+    assert resumes, "no consumer_resume recovery event"
+    assert resumes[0].get("chunks", 0) >= 1, (
+        f"resume watermark empty — the checkpoint never covered a "
+        f"chunk: {resumes}"
+    )
+    unexpected = [ev for ev in events_of(events, "compile")
+                  if ev.get("unexpected")]
+    assert not unexpected, (
+        f"consumer resume paid unexpected retraces: {unexpected}"
+    )
+    consumer_recover_s = round(max(wall - stream_wall, 0.0), 3)
+    echo(f"7/7 ok: consumer SIGKILLed at {kill_after}, resumed from "
+         f"watermark of {resumes[0].get('chunks')} chunk(s), bit-exact "
+         f"(consumer_recover_s={consumer_recover_s})")
+    return {"wall_s": round(wall, 3),
+            "watermark_chunks": resumes[0].get("chunks"),
+            "consumer_exit_codes": exits,
+            "consumer_recover_s": consumer_recover_s}
 
 
 def run(args) -> dict:
@@ -319,8 +445,12 @@ def run(args) -> dict:
         root, plan, args.slow_s)
     checks["drop_dup_dedup"] = check_drop_dup_dedup(
         root, plan, clean_embedding)
-    checks["streaming_prefill"] = check_streaming_prefill(
-        root, plan, clean_embedding)
+    stream = check_streaming_prefill(root, plan, clean_embedding)
+    stream_embedding = stream.pop("embedding")
+    checks["streaming_prefill"] = stream
+    checks["tcp_boundary"] = check_tcp_boundary(root, plan, clean_embedding)
+    checks["consumer_kill_recover"] = check_consumer_kill_recover(
+        root, plan, stream_embedding, stream["wall_s"])
     clean_wall = checks["clean_parity"]["wall_s"]
     return {
         "metric": "dist_smoke",
@@ -333,6 +463,9 @@ def run(args) -> dict:
         "clean_wall_s": clean_wall,
         "recover_extra_s": round(
             max(checks["kill_recover"]["wall_s"] - clean_wall, 0.0), 3),
+        "reconnect_s": checks["tcp_boundary"]["reconnect_s"],
+        "consumer_recover_s":
+            checks["consumer_kill_recover"]["consumer_recover_s"],
         "wall_s": round(time.monotonic() - T0, 3),
         "backend": jax.default_backend(),
         "out_dir": root,

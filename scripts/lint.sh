@@ -28,7 +28,11 @@
 #     outside the sanctioned serve/queue.py + dist/boundary.py paths);
 #   - the gigalint GL014 selftest: the seeded chunk-reassembly fixture
 #     must fire (jnp.concatenate/stack over the chunk axis inside a
-#     streaming-sanctioned module, outside the *dense_fallback* oracle).
+#     streaming-sanctioned module, outside the *dense_fallback* oracle);
+#   - the gigalint GL015 selftest: the seeded raw-socket fixture must
+#     fire (socket/socketserver outside the sanctioned dist/transport.py,
+#     and blocking recv/accept/connect with no configured deadline —
+#     flagged even inside the sanctioned module).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 python scripts/obs_report.py --selftest 1>&2
@@ -86,5 +90,18 @@ if [ "$gl014_rc" -ne 1 ]; then
     exit 1
 fi
 echo "gigalint GL014 selftest OK" 1>&2
+
+# GL015 selftest: the seeded raw-socket fixture MUST be found
+# (exit 1 = findings; 0 or 2 mean the rule went blind or crashed)
+set +e
+python -m tools.gigalint --no-waivers --select GL015 \
+    tools/gigalint/selftest/fixture/models/sockets.py 1>&2
+gl015_rc=$?
+set -e
+if [ "$gl015_rc" -ne 1 ]; then
+    echo "GL015 selftest FAILED: expected findings (rc=1), got rc=$gl015_rc" 1>&2
+    exit 1
+fi
+echo "gigalint GL015 selftest OK" 1>&2
 
 exec python -m tools.gigalint gigapath_tpu scripts tests "$@"

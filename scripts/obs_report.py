@@ -382,8 +382,32 @@ def render(events: List[dict], out=None) -> int:
     # -- dist (gigapath_tpu.dist: cross-stage boundary + membership) ------
     backpressures = by_kind.get("backpressure", [])
     lost_workers = by_kind.get("worker_lost", [])
-    if backpressures or lost_workers:
+    lost_consumers = by_kind.get("consumer_lost", [])
+    # transport counters (dist.reconnects / dist.frame_errors /
+    # dist.bytes_sent) ride the metrics registry; each process flushes
+    # exactly ONE final snapshot, so summing the finals is the fleet
+    # total with no double counting
+    transport_totals: Dict[str, float] = {}
+    for ev in by_kind.get("metrics", []):
+        if ev.get("reason") != "final":
+            continue
+        for cname, value in (ev.get("counters") or {}).items():
+            if str(cname).startswith("dist."):
+                transport_totals[str(cname)] = (
+                    transport_totals.get(str(cname), 0) + value
+                )
+    if backpressures or lost_workers or lost_consumers or \
+            any(transport_totals.values()):
         w("== dist ==\n")
+        if any(transport_totals.values()):
+            w(
+                "transport: reconnects {} / frame_errors {} / "
+                "bytes_sent {}\n".format(
+                    int(transport_totals.get("dist.reconnects", 0)),
+                    int(transport_totals.get("dist.frame_errors", 0)),
+                    int(transport_totals.get("dist.bytes_sent", 0)),
+                )
+            )
         if backpressures:
             by_channel: Dict[str, List[dict]] = {}
             for ev in backpressures:
@@ -412,6 +436,12 @@ def render(events: List[dict], out=None) -> int:
             w(
                 f"  WORKER_LOST at +{ev.get('t', 0.0) - t0:.1f}s: "
                 f"{ev.get('worker')} (stage {ev.get('stage')}, {how})\n"
+            )
+        for ev in lost_consumers:
+            w(
+                f"  CONSUMER_LOST at +{ev.get('t', 0.0) - t0:.1f}s: "
+                f"stage {ev.get('stage')}, {ev.get('reason', '?')} "
+                f"(predecessor pid {ev.get('pid')})\n"
             )
         w("\n")
 
@@ -616,6 +646,16 @@ def selftest() -> int:
                   expired_by_s=0.41, last_renew=100.0, pid=4242)
         log.recovery(action="reassign", worker="w0", chunks=3,
                      survivors=["w1", "w2"])
+        # ...a crashed-and-restarted slide consumer (ISSUE 13), and the
+        # TCP transport's counters riding a final metrics snapshot
+        log.event("consumer_lost", stage="slide",
+                  reason="checkpoint_found", pid=4243, last_renew=101.0)
+        log.recovery(action="consumer_resume", step=4, chunks=4,
+                     missing=2)
+        log.event("metrics", reason="final", counters={
+            "dist.reconnects": 1, "dist.frame_errors": 2,
+            "dist.bytes_sent": 65536,
+        }, gauges={}, histograms={})
 
         # -- a REAL traced smoke: submit -> dispatch -> resolve through
         # the serving RequestQueue, with request traces, latency
@@ -744,6 +784,9 @@ def selftest() -> int:
                 "channel 'dir': 2 episode(s), capacity 4, "
                 "max queue depth 4",
                 "WORKER_LOST at", "w0 (stage tile",
+                "CONSUMER_LOST at", "checkpoint_found",
+                "transport: reconnects 1 / frame_errors 2 / "
+                "bytes_sent 65536",
                 "REASSIGN at", "worker w0, 3 chunk(s), -> w1,w2")
     missing = [s for s in required if s not in text]
     required_fl = ("== flight dumps ==", "reason=step_time_spike")

@@ -69,7 +69,10 @@ tail -3 /tmp/r7_serve.log
 # 8. the disaggregated cross-stage boundary (ROADMAP item 4's dryrun):
 #    two tile-worker processes + the slide consumer over the credit-
 #    based channel — clean parity, kill-recover bit-exactness, straggler
-#    skew, drop/dup dedup, all hard-asserted. The ingest below folds the
+#    skew, drop/dup dedup, the TCP transport under drop_conn/
+#    corrupt_frame frame chaos (reconnect_s trend key), and consumer
+#    SIGKILL-and-resume from the checkpoint watermark
+#    (consumer_recover_s), all hard-asserted. The ingest below folds the
 #    dist|smoke entry next to the serve ones (the label lands once, with
 #    every snapshot measured this round).
 timeout 1200 python scripts/dist_smoke.py --json DIST_SMOKE.json \

@@ -51,6 +51,25 @@ def _events(path):
         return [json.loads(line) for line in fh if line.strip()]
 
 
+def _run_events(root):
+    """Every obs event of a (multi-process) run dir, torn tails
+    tolerated — a SIGKILLed process can die mid-line."""
+    events = []
+    for path in glob.glob(os.path.join(str(root), "obs", "*.jsonl")):
+        if os.path.basename(path).startswith("flight-"):
+            continue
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    return events
+
+
 def _of(events, kind, **match):
     out = [ev for ev in events if ev.get("kind") == kind]
     for k, v in match.items():
@@ -296,6 +315,50 @@ class TestDirChannel:
             "s0", 0, 0, 8, np.zeros((8, 4), np.float32), digest=False))
         assert cons.recv(timeout=0.1) is None
         assert cons.stats.corrupt == 1
+
+    def test_seeded_watermark_retransmit_is_reacked(self, tmp_path):
+        """A restarted consumer's watermark may cover a seq whose
+        deferred ack died with the predecessor (crash between
+        checkpoint commit and ack flush): the retransmit must be
+        swallowed AND re-acked, or the producer's credit is pinned
+        forever."""
+        root = str(tmp_path)
+        cfg = BoundaryConfig(capacity=2, poll_s=0.005, retransmit_s=0.05)
+        prod = DirChannelProducer(root, cfg, producer="w0")
+        prod.send(_chunk(0, 0, 8))
+        # the predecessor consumer delivered + checkpointed seq 0 but
+        # died before the ack flush; the restart seeds the watermark
+        cons = DirChannelConsumer(root, cfg, delivered=[0])
+        time.sleep(0.06)
+        assert prod.pump_retransmits() == 1
+        assert cons.recv(timeout=0.1) is None     # deduped, not re-folded
+        assert cons.stats.duplicates >= 1
+        assert prod.credits() == 2, "the swallowed retransmit must re-ack"
+        assert prod.unacked_seqs() == []
+
+    def test_deferred_ack_duplicate_is_not_reacked(self, tmp_path):
+        """The inverse guard: a retransmit duplicate of a chunk whose
+        ack is still DEFERRED (delivered this session, not yet covered
+        by a checkpoint) must NOT be acked — an ack is a durability
+        promise, and acking here would let a crash strand the chunk
+        forever (found by end-to-end verification: the predecessor
+        consumer deduped a retransmit of an uncheckpointed chunk, acked
+        it, died, and the slide could never complete)."""
+        root = str(tmp_path)
+        cfg = BoundaryConfig(capacity=2, poll_s=0.005, retransmit_s=0.05)
+        prod = DirChannelProducer(root, cfg, producer="w0")
+        cons = DirChannelConsumer(root, cfg)
+        prod.send(_chunk(0, 0, 8))
+        assert cons.recv(timeout=1).seq == 0   # delivered, ack DEFERRED
+        time.sleep(0.06)
+        assert prod.pump_retransmits() == 1
+        assert cons.recv(timeout=0.1) is None  # deduped
+        assert cons.stats.duplicates >= 1
+        assert prod.credits() == 1, (
+            "a deferred-ack duplicate must not refund the credit"
+        )
+        cons.ack(0)                            # the checkpoint commits
+        assert prod.credits() == 2
 
     def test_backpressure_event_from_dir_producer(self, tmp_path):
         log = RunLog(str(tmp_path / "run.jsonl"), driver="t", echo=False)
@@ -729,24 +792,128 @@ class TestKillWorkerAcceptance:
         np.testing.assert_array_equal(clean["embedding"],
                                       chaos["embedding"])
 
-        events = []
-        for path in glob.glob(str(tmp_path / "chaos" / "obs" / "*.jsonl")):
-            if os.path.basename(path).startswith("flight-"):
-                continue
-            with open(path, encoding="utf-8") as fh:
-                for line in fh:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        events.append(json.loads(line))
-                    except json.JSONDecodeError:
-                        continue  # the SIGKILLed worker's torn tail
+        events = _run_events(tmp_path / "chaos")
         assert _of(events, "worker_lost", worker="w0")
         reassigns = _of(events, "recovery", action="reassign")
         assert reassigns and reassigns[0]["worker"] == "w0"
         assert reassigns[0]["chunks"] >= 1
         assert _of(events, "anomaly", detector="worker_lost")
+        unexpected = [ev for ev in _of(events, "compile")
+                      if ev.get("unexpected")]
+        assert not unexpected, unexpected
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 13 acceptance (a): the TCP transport under frame chaos
+# ---------------------------------------------------------------------------
+
+class TestTcpBoundaryAcceptance:
+    def test_tcp_chaos_run_is_bit_exact_vs_memory_channel_oracle(
+            self, tmp_path):
+        """ISSUE 13 acceptance (a): a REAL two-process run joined by
+        the TCP transport, under ``drop_conn`` (torn frame + dead
+        connection) and ``corrupt_frame`` (flipped body bytes) chaos,
+        produces a slide embedding BIT-exact vs a clean in-process
+        MemoryChannel oracle — with the frame errors counted, a
+        ``reconnect`` recovery event on the bus, and zero unexpected
+        retraces."""
+        from gigapath_tpu.dist.boundary import (
+            BoundaryConfig,
+            MemoryChannel,
+            SlideAssembler,
+        )
+        from gigapath_tpu.dist.pipeline import (
+            _default_forward,
+            default_plan,
+            run_disaggregated,
+        )
+        from gigapath_tpu.dist.worker import encode_chunk, encoder_weights
+
+        plan = default_plan(n_tiles=40, chunk_tiles=8, lease_s=1.5,
+                            credits=4, retransmit_s=0.5, transport="tcp")
+
+        # the clean MemoryChannel oracle: same chunks, in process,
+        # through the third transport of the same protocol
+        weights = encoder_weights(plan)
+        channel = MemoryChannel(BoundaryConfig(capacity=8))
+        chunks = plan_chunks(plan["n_tiles"], plan["chunk_tiles"])
+        for cid, start, stop in chunks:
+            embeds, coords = encode_chunk(plan, weights, start, stop)
+            channel.send(EmbeddingChunk.build(
+                plan["slide_id"], cid, start, stop, embeds, coords=coords,
+            ))
+        asm = SlideAssembler(plan["n_tiles"], plan["dim_out"])
+        asm.expect([c[0] for c in chunks])
+        while not asm.complete():
+            chunk = channel.recv(timeout=1)
+            asm.add(chunk)
+            channel.ack(chunk.seq)
+        forward, params = _default_forward()(plan["dim_out"])
+        oracle = np.asarray(
+            forward(params, asm.embeds[None], asm.coords[None]), np.float32
+        )[0]
+
+        chaos = run_disaggregated(
+            str(tmp_path / "tcp-chaos"), plan=plan,
+            worker_chaos={"w0": "drop_conn@1,corrupt_frame@2"},
+            deadline_s=90,
+        )
+        np.testing.assert_array_equal(chaos["embedding"], oracle)
+        np.testing.assert_array_equal(chaos["assembled"], asm.embeds)
+        assert chaos["stats"]["frame_errors"] >= 1, chaos["stats"]
+        assert chaos["lost"] == [], "frame chaos must not read as death"
+
+        events = _run_events(tmp_path / "tcp-chaos")
+        assert _of(events, "recovery", action="reconnect"), (
+            "drop_conn must surface as a reconnect recovery event"
+        )
+        unexpected = [ev for ev in _of(events, "compile")
+                      if ev.get("unexpected")]
+        assert not unexpected, unexpected
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 13 acceptance (b): consumer SIGKILL + checkpoint resume
+# ---------------------------------------------------------------------------
+
+class TestConsumerKillAcceptance:
+    def test_consumer_sigkill_resumes_from_watermark_bit_exact(
+            self, tmp_path):
+        """ISSUE 13 acceptance (b): the slide consumer (own OS process,
+        streaming fold, TCP transport, checkpoint cadence 2) is
+        SIGKILLed mid-slide; the restarted consumer finds the
+        checkpoint, reloads the watermark, re-handshakes, receives only
+        post-watermark chunks, and produces a BIT-exact embedding — with
+        ``consumer_lost`` + ``recovery action="consumer_resume"`` on the
+        bus and zero unexpected retraces."""
+        from gigapath_tpu.dist.pipeline import default_plan, run_disaggregated
+
+        plan = default_plan(n_tiles=40, chunk_tiles=8, lease_s=2.0,
+                            credits=4, retransmit_s=0.5,
+                            chunked_prefill=True, transport="tcp",
+                            consumer_ckpt_every=2)
+        clean = run_disaggregated(str(tmp_path / "clean"), plan=plan,
+                                  deadline_s=90)
+        assert clean["streaming"]
+
+        chaos = run_disaggregated(
+            str(tmp_path / "kill"), plan=plan,
+            consumer_chaos="kill_consumer@3", deadline_s=90,
+        )
+        exits = chaos["consumer_exit_codes"]
+        assert exits[0] == -9, f"consumer was not SIGKILLed: {exits}"
+        assert exits[-1] == 0, f"restarted consumer failed: {exits}"
+        np.testing.assert_array_equal(clean["embedding"],
+                                      chaos["embedding"])
+
+        events = _run_events(tmp_path / "kill")
+        lost = _of(events, "consumer_lost")
+        assert lost and lost[0].get("reason") == "checkpoint_found"
+        resumes = _of(events, "recovery", action="consumer_resume")
+        assert resumes and resumes[0].get("chunks", 0) >= 1, resumes
+        assert _of(events, "anomaly", detector="consumer_lost"), (
+            "the anomaly engine did not react to consumer_lost"
+        )
         unexpected = [ev for ev in _of(events, "compile")
                       if ev.get("unexpected")]
         assert not unexpected, unexpected
@@ -789,17 +956,6 @@ class TestStreamingConsumer:
         np.testing.assert_array_equal(clean["embedding"],
                                       chaos["embedding"])
 
-        events = []
-        for path in glob.glob(str(tmp_path / "clean" / "obs" / "*.jsonl")):
-            if os.path.basename(path).startswith("flight-"):
-                continue
-            with open(path, encoding="utf-8") as fh:
-                for line in fh:
-                    line = line.strip()
-                    if line:
-                        try:
-                            events.append(json.loads(line))
-                        except json.JSONDecodeError:
-                            continue
+        events = _run_events(tmp_path / "clean")
         assert _of(events, "stream_open")
         assert _of(events, "stream_finalize")

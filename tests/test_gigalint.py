@@ -51,7 +51,7 @@ def test_fixture_tree_fires_every_rule_class():
     fired = {f.rule for f in result.findings}
     expected = {"GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
                 "GL007", "GL008", "GL009", "GL010", "GL011", "GL012",
-                "GL013", "GL014"}
+                "GL013", "GL014", "GL015"}
     assert fired >= expected, (
         f"missing rule classes: {sorted(expected - fired)}"
     )
@@ -120,6 +120,20 @@ def test_fixture_specific_findings():
         ("GL014", "streaming_prefill.py", "stack_chunks_for_readout"),
         # maxsize=-1 is Python's explicitly-INFINITE queue, not a bound
         ("GL013", "channels.py", "unbounded_queue_negative_maxsize"),
+        # raw socket plumbing outside the sanctioned dist/transport.py
+        # (whose fixture twin is the negative control for the
+        # connection-primitive check)...
+        ("GL015", "sockets.py", "open_raw_socket"),
+        ("GL015", "sockets.py", "dial_without_deadline"),
+        ("GL015", "sockets.py", "serve_with_socketserver"),
+        ("GL015", "sockets.py", "recv_without_timeout"),
+        # a 3-positional select.select(r, w, x) blocks forever: no
+        # deadline credit (only selectors' select(timeout) or stdlib's
+        # 4th positional count)
+        ("GL015", "sockets.py", "select_without_timeout"),
+        # ...and the deadline discipline fires EVEN inside the
+        # sanctioned transport module
+        ("GL015", "transport.py", "recv_without_deadline"),
     }
     assert expected <= got, f"missing: {sorted(expected - got)}"
 

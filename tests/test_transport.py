@@ -1,0 +1,468 @@
+"""The TCP boundary transport (gigapath_tpu/dist/transport.py): frame
+layer, credit/ack parity with the other transports, frame-layer chaos
+(corrupt / reorder / torn-connection / delay), reconnect with
+handshake-watermark replay, and the restarted-consumer dedup seed.
+
+All loopback sockets, deterministic chaos specs, no sleeps beyond the
+channels' own (tiny) retransmit timers — default tier."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from gigapath_tpu.dist.boundary import BoundaryConfig, EmbeddingChunk
+from gigapath_tpu.dist.transport import (
+    FrameBuffer,
+    FrameError,
+    TcpChannelConsumer,
+    TcpChannelProducer,
+    blob_to_chunk,
+    chunk_to_blob,
+    encode_frame,
+    make_consumer,
+    make_producer,
+    read_endpoint,
+    transport_name,
+)
+from gigapath_tpu.resilience.chaos import ChaosInjector
+
+CFG = dict(capacity=4, poll_s=0.005, retransmit_s=0.08,
+           connect_timeout_s=2.0, backoff_s=0.2)
+
+
+def _cfg(**over):
+    return BoundaryConfig(**{**CFG, **over})
+
+
+def _chunk(cid, dim=4, slide="s0", producer="w0"):
+    rng = np.random.default_rng([7, cid])
+    return EmbeddingChunk.build(
+        slide, cid, cid * 8, cid * 8 + 8,
+        rng.standard_normal((8, dim), dtype=np.float32),
+        coords=rng.uniform(0, 100, (8, 2)).astype(np.float32),
+        producer=producer,
+    )
+
+
+@pytest.fixture
+def channel(tmp_path):
+    cons = TcpChannelConsumer(str(tmp_path), _cfg())
+    prod = TcpChannelProducer(str(tmp_path), _cfg(), producer="w0")
+    yield prod, cons
+    prod.close()
+    cons.close()
+
+
+# ---------------------------------------------------------------------------
+# frame layer
+# ---------------------------------------------------------------------------
+
+class TestFrames:
+    def test_roundtrip_and_partial_feed(self):
+        frame = encode_frame({"type": "chunk", "seq": 3},
+                             chunk_to_blob(_chunk(3)))
+        buf = FrameBuffer()
+        buf.feed(frame[:11])
+        assert buf.frames() == []          # incomplete: nothing yet
+        buf.feed(frame[11:])
+        [(header, blob)] = buf.frames()
+        assert header["seq"] == 3
+        chunk = blob_to_chunk(blob)
+        assert chunk.seq == 3 and chunk.verify()
+
+    def test_digest_mismatch_skips_frame_keeps_framing(self):
+        good = encode_frame({"type": "ack", "seq": 1})
+        bad = bytearray(encode_frame({"type": "ack", "seq": 2}))
+        bad[-3] ^= 0xFF                    # flip a body byte past the digest
+        buf = FrameBuffer()
+        buf.feed(bytes(bad) + good)
+        frames = buf.frames()
+        assert [h["seq"] for h, _ in frames] == [1]
+        assert buf.digest_errors == 1      # corrupt frame counted, dropped
+
+    def test_misframed_stream_raises(self):
+        buf = FrameBuffer()
+        buf.feed(b"XXXX" + b"\x00" * 48)
+        with pytest.raises(FrameError):
+            buf.frames()
+
+    def test_chunk_blob_matches_dir_layout(self):
+        chunk = _chunk(5)
+        again = blob_to_chunk(chunk_to_blob(chunk))
+        assert again.checksum == chunk.checksum and again.verify()
+        np.testing.assert_array_equal(again.payload, chunk.payload)
+        np.testing.assert_array_equal(again.coords, chunk.coords)
+
+
+# ---------------------------------------------------------------------------
+# protocol parity with the other transports
+# ---------------------------------------------------------------------------
+
+class TestTcpChannel:
+    def test_roundtrip_out_of_order_and_ack_credits(self, channel):
+        prod, cons = channel
+        for cid in (2, 0, 1):
+            prod.send(_chunk(cid), timeout=5)
+        got = {}
+        for _ in range(3):
+            chunk = cons.recv(timeout=2)
+            assert chunk is not None and chunk.verify()
+            cons.ack(chunk.seq)
+            got[chunk.seq] = chunk
+        assert sorted(got) == [0, 1, 2]
+        assert prod.credits() == 4         # acks refunded every credit
+        assert prod.unacked_seqs() == []
+        assert cons.stats.duplicates == 0 and cons.stats.frame_errors == 0
+        assert prod.stats.bytes_sent > 0
+
+    def test_backpressure_event_at_zero_credits(self, tmp_path):
+        from gigapath_tpu.obs.runlog import RunLog
+
+        log = RunLog(str(tmp_path / "run.jsonl"), driver="t", echo=False)
+        cons = TcpChannelConsumer(str(tmp_path), _cfg(capacity=1))
+        prod = TcpChannelProducer(str(tmp_path), _cfg(capacity=1),
+                                  producer="w0", runlog=log)
+        prod.send(_chunk(0), timeout=5)
+        with pytest.raises(TimeoutError):
+            prod.send(_chunk(1), timeout=0.05)
+        assert prod.stats.backpressure_events == 1
+        log.close()
+        import json
+
+        events = [json.loads(line) for line in open(log.path)
+                  if line.strip()]
+        bp = [ev for ev in events if ev.get("kind") == "backpressure"]
+        assert bp and bp[0]["credits"] == 0 and bp[0]["capacity"] == 1
+        prod.close()
+        cons.close()
+
+    def test_endpoint_file_published(self, channel, tmp_path):
+        _, cons = channel
+        host, port = read_endpoint(str(tmp_path))
+        assert host == "127.0.0.1" and port == cons.port
+
+
+# ---------------------------------------------------------------------------
+# frame-layer adversity (the chaos injectors act INSIDE the transport)
+# ---------------------------------------------------------------------------
+
+class TestFrameChaos:
+    def test_corrupt_frame_dropped_counted_retransmitted(self, tmp_path):
+        cons = TcpChannelConsumer(str(tmp_path), _cfg())
+        prod = TcpChannelProducer(str(tmp_path), _cfg(), producer="w0",
+                                  chaos=ChaosInjector("corrupt_frame@0"))
+        prod.send(_chunk(0), timeout=5)
+        assert cons.recv(timeout=0.1) is None, "corrupt frame delivered"
+        assert cons.stats.frame_errors >= 1
+        time.sleep(CFG["retransmit_s"])
+        assert prod.pump_retransmits() >= 1
+        chunk = cons.recv(timeout=2)
+        assert chunk is not None and chunk.seq == 0 and chunk.verify()
+        prod.close()
+        cons.close()
+
+    def test_reorder_frame_absorbed_by_seq_layer(self, tmp_path):
+        cons = TcpChannelConsumer(str(tmp_path), _cfg())
+        prod = TcpChannelProducer(str(tmp_path), _cfg(), producer="w0",
+                                  chaos=ChaosInjector("reorder_frame@0"))
+        prod.send(_chunk(0), timeout=5)
+        prod.send(_chunk(1), timeout=5)
+        first = cons.recv(timeout=2)
+        second = cons.recv(timeout=2)
+        assert first.seq == 1 and second.seq == 0  # swapped on the wire
+        assert first.verify() and second.verify()
+        prod.close()
+        cons.close()
+
+    def test_delay_frame_delays_but_delivers(self, tmp_path):
+        cons = TcpChannelConsumer(str(tmp_path), _cfg())
+        prod = TcpChannelProducer(str(tmp_path), _cfg(), producer="w0",
+                                  chaos=ChaosInjector("delay_frame@0:0.05"))
+        t0 = time.monotonic()
+        prod.send(_chunk(0), timeout=5)
+        assert time.monotonic() - t0 >= 0.05
+        assert cons.recv(timeout=2).seq == 0
+        prod.close()
+        cons.close()
+
+    def test_drop_conn_torn_frame_reconnect_replays(self, tmp_path):
+        """drop_conn sends HALF the frame then kills the socket: the
+        consumer counts the torn tail, the producer reconnects and the
+        handshake watermark replays exactly the unacked chunk."""
+        cons = TcpChannelConsumer(str(tmp_path), _cfg())
+        prod = TcpChannelProducer(str(tmp_path), _cfg(), producer="w0",
+                                  chaos=ChaosInjector("drop_conn@0"))
+        prod.send(_chunk(0), timeout=5)
+        assert cons.recv(timeout=0.1) is None, "torn frame delivered"
+        deadline = time.monotonic() + 5
+        chunk = None
+        while chunk is None and time.monotonic() < deadline:
+            prod.pump_retransmits()
+            chunk = cons.recv(timeout=0.05)
+        assert chunk is not None and chunk.seq == 0 and chunk.verify()
+        assert prod.stats.reconnects == 1
+        assert cons.stats.frame_errors >= 1  # the torn tail was counted
+        assert cons.stats.duplicates == 0    # replayed once, not sprayed
+        prod.close()
+        cons.close()
+
+    def test_dup_chunk_still_deduped_over_tcp(self, tmp_path):
+        cons = TcpChannelConsumer(str(tmp_path), _cfg())
+        prod = TcpChannelProducer(str(tmp_path), _cfg(), producer="w0",
+                                  chaos=ChaosInjector("dup_chunk@1"))
+        prod.send(_chunk(1), timeout=5)
+        assert cons.recv(timeout=2).seq == 1
+        assert cons.recv(timeout=0.1) is None
+        assert cons.stats.duplicates == 1
+        prod.close()
+        cons.close()
+
+
+# ---------------------------------------------------------------------------
+# reconnect handshake: the ack watermark bounds the replay
+# ---------------------------------------------------------------------------
+
+class TestReconnectWatermark:
+    def test_restarted_consumer_gets_only_post_watermark_chunks(
+            self, tmp_path):
+        """The consumer-crash shape at the channel level: chunks the
+        dead consumer ACKED (= checkpoint-covered) are never replayed;
+        the delivered-but-unacked one is."""
+        root = str(tmp_path)
+        cons = TcpChannelConsumer(root, _cfg())
+        prod = TcpChannelProducer(root, _cfg(), producer="w0")
+        prod.send(_chunk(0), timeout=5)
+        assert cons.recv(timeout=2).seq == 0
+        cons.ack(0)                          # durable at the watermark
+        prod.send(_chunk(1), timeout=5)
+        assert cons.recv(timeout=2).seq == 1  # delivered, NOT acked
+        cons.close()                          # the consumer "dies"
+
+        cons2 = TcpChannelConsumer(root, _cfg(), delivered=[0])
+        deadline = time.monotonic() + 5
+        chunk = None
+        while chunk is None and time.monotonic() < deadline:
+            prod.pump_retransmits()
+            chunk = cons2.recv(timeout=0.05)
+        assert chunk is not None and chunk.seq == 1, (
+            "the unacked chunk must be replayed to the restarted consumer"
+        )
+        assert 0 not in {chunk.seq}, "watermarked chunk must NOT replay"
+        assert cons2.recv(timeout=0.1) is None
+        assert cons2.stats.duplicates == 0, (
+            "the watermark bounded the replay — nothing to dedup"
+        )
+        prod.close()
+        cons2.close()
+
+    def test_seeded_delivered_set_dedups_retransmits(self, tmp_path):
+        root = str(tmp_path)
+        cons = TcpChannelConsumer(root, _cfg(), delivered=[3])
+        prod = TcpChannelProducer(root, _cfg(), producer="w0")
+        prod.send(_chunk(3), timeout=5)
+        assert cons.recv(timeout=0.2) is None
+        assert cons.stats.duplicates == 1
+        prod.close()
+        cons.close()
+
+
+# ---------------------------------------------------------------------------
+# the factory seam
+# ---------------------------------------------------------------------------
+
+class TestTransportSelection:
+    def test_default_is_dir(self, monkeypatch):
+        monkeypatch.delenv("GIGAPATH_DIST_TRANSPORT", raising=False)
+        assert transport_name() == "dir"
+
+    def test_env_and_explicit_selection(self, monkeypatch):
+        monkeypatch.setenv("GIGAPATH_DIST_TRANSPORT", "tcp")
+        assert transport_name() == "tcp"
+        assert transport_name("dir") == "dir"  # explicit (plan) wins
+
+    def test_unknown_transport_is_loud(self):
+        with pytest.raises(ValueError, match="known transports"):
+            transport_name("carrier-pigeon")
+
+    def test_factory_builds_the_selected_pair(self, tmp_path, monkeypatch):
+        from gigapath_tpu.dist.boundary import (
+            DirChannelConsumer,
+            DirChannelProducer,
+        )
+
+        monkeypatch.delenv("GIGAPATH_DIST_TRANSPORT", raising=False)
+        assert isinstance(make_producer(str(tmp_path), _cfg()),
+                          DirChannelProducer)
+        assert isinstance(make_consumer(str(tmp_path), _cfg()),
+                          DirChannelConsumer)
+        tcp_cons = make_consumer(str(tmp_path / "tcp"), _cfg(),
+                                 transport="tcp")
+        tcp_prod = make_producer(str(tmp_path / "tcp"), _cfg(),
+                                 transport="tcp")
+        assert isinstance(tcp_cons, TcpChannelConsumer)
+        assert isinstance(tcp_prod, TcpChannelProducer)
+        tcp_prod.close()
+        tcp_cons.close()
+
+
+# ---------------------------------------------------------------------------
+# transport counters on the bus
+# ---------------------------------------------------------------------------
+
+class TestTransportMetrics:
+    def test_counters_ride_the_final_metrics_flush(self, tmp_path,
+                                                   monkeypatch):
+        import json
+
+        from gigapath_tpu.obs.runlog import RunLog
+
+        monkeypatch.delenv("GIGAPATH_METRICS", raising=False)
+        log = RunLog(str(tmp_path / "run.jsonl"), driver="t", echo=False)
+        cons = TcpChannelConsumer(str(tmp_path), _cfg(), runlog=log)
+        prod = TcpChannelProducer(str(tmp_path), _cfg(), producer="w0",
+                                  runlog=log,
+                                  chaos=ChaosInjector("corrupt_frame@0"))
+        prod.send(_chunk(0), timeout=5)
+        assert cons.recv(timeout=0.1) is None
+        time.sleep(CFG["retransmit_s"])
+        prod.pump_retransmits()
+        assert cons.recv(timeout=2).seq == 0
+        log.run_end(status="ok")
+        events = [json.loads(line) for line in open(log.path)
+                  if line.strip()]
+        finals = [ev for ev in events if ev.get("kind") == "metrics"
+                  and ev.get("reason") == "final"]
+        assert finals, "no final metrics flush on run_end"
+        counters = finals[-1]["counters"]
+        assert counters.get("dist.bytes_sent", 0) > 0
+        assert counters.get("dist.frame_errors", 0) >= 1
+        prod.close()
+        cons.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos parser: loud on typos, new injectors parse
+# ---------------------------------------------------------------------------
+
+class TestChaosParsing:
+    def test_frame_injectors_parse(self):
+        c = ChaosInjector("drop_conn@1,delay_frame@2:0.5,corrupt_frame@3,"
+                          "reorder_frame@4,kill_consumer@5")
+        assert c.drops_conn(1) and not c.drops_conn(1)          # one-shot
+        assert c.delay_frame(2) == 0.5 and c.delay_frame(0) == 0.0
+        assert c.corrupts_frame(3) and not c.corrupts_frame(3)
+        assert c.reorders_frame(4) and not c.reorders_frame(4)
+        assert c._kill_consumer_after == 5
+
+    def test_null_chaos_has_the_frame_surface(self):
+        from gigapath_tpu.resilience.chaos import NullChaos
+
+        n = NullChaos()
+        assert not n.drops_conn(0) and not n.corrupts_frame(0)
+        assert not n.reorders_frame(0) and n.delay_frame(0) == 0.0
+        assert not n.maybe_kill_consumer(5)
+
+    def test_typoed_spec_is_error_event_plus_raise(self, tmp_path,
+                                                   monkeypatch):
+        """The satellite: a typo'd GIGAPATH_CHAOS must never be a
+        silently clean run — error event on the bus AND the raise."""
+        import json
+
+        from gigapath_tpu.obs.runlog import RunLog
+        from gigapath_tpu.resilience.chaos import get_chaos
+
+        monkeypatch.setenv("GIGAPATH_CHAOS", "explode_consumer@1")
+        log = RunLog(str(tmp_path / "run.jsonl"), driver="t", echo=False)
+        with pytest.raises(ValueError, match="unknown injector"):
+            get_chaos(log)
+        log.close()
+        events = [json.loads(line) for line in open(log.path)
+                  if line.strip()]
+        errors = [ev for ev in events if ev.get("kind") == "error"]
+        assert errors and "unknown injector" in errors[0]["error"]
+
+    def test_typoed_spec_raises_without_runlog_too(self, monkeypatch):
+        from gigapath_tpu.resilience.chaos import get_chaos
+
+        monkeypatch.setenv("GIGAPATH_CHAOS", "nonsense@9")
+        with pytest.raises(ValueError):
+            get_chaos()
+
+
+# ---------------------------------------------------------------------------
+# streaming fold state: export/restore is bit-exact
+# ---------------------------------------------------------------------------
+
+class TestSessionCheckpoint:
+    def test_export_restore_midstream_is_bit_exact(self):
+        """Fold half the chunks, export, restore into a FRESH session,
+        fold the rest: the embedding equals the uninterrupted run's
+        BIT-exact — the consumer-crash-recovery contract at the session
+        level."""
+        import jax
+
+        from gigapath_tpu.models.classification_head import get_model
+        from gigapath_tpu.models.streaming_encoder import (
+            StreamingEncoderSession,
+        )
+        from gigapath_tpu.utils.registry import create_model_from_registry
+
+        n_tiles, chunk_tiles, dim_in = 24, 8, 8
+        _, params = get_model(
+            input_dim=dim_in, latent_dim=32, feat_layer="1", n_classes=2,
+            model_arch="gigapath_slide_enc_tiny", dtype=None,
+        )
+        inner = create_model_from_registry(
+            "gigapath_slide_enc_tiny", in_chans=dim_in, global_pool=False,
+            dtype=None,
+        )
+        rng = np.random.default_rng(0)
+        tiles = rng.standard_normal((n_tiles, dim_in), dtype=np.float32)
+        coords = rng.uniform(0, 1000, (n_tiles, 2)).astype(np.float32)
+
+        def feed(session, idx):
+            a, b = session.tile_bounds[idx]
+            session.feed(idx, tiles[a:b], coords[a:b])
+
+        def build():
+            return StreamingEncoderSession(
+                inner, params["slide_encoder"], n_tiles,
+                chunk_tiles=chunk_tiles, all_layer_embed=True,
+            )
+
+        straight = build()
+        for i in range(straight.n_chunks):
+            feed(straight, i)
+        want = [np.asarray(e) for e in straight.finalize()]
+
+        first = build()
+        feed(first, 0)
+        # an out-of-order arrival parks in the frontier buffer and must
+        # survive the checkpoint too
+        feed(first, 2)
+        state = first.export_state()
+        # round-trip through host bytes like the real checkpoint does
+        state = jax.tree_util.tree_map(np.asarray, state)
+
+        resumed = build()
+        resumed.restore_state(state)
+        assert resumed.pending() == first.pending()
+        feed(resumed, 1)
+        got = [np.asarray(e) for e in resumed.finalize()]
+        assert len(got) == len(want)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g, w)
+
+    def test_ckpt_cadence_past_credits_is_loud(self, tmp_path):
+        """Deferred acks past the credit window would deadlock the
+        fleet: construction must refuse, not hang."""
+        from gigapath_tpu.dist.pipeline import default_plan, run_slide_consumer
+        from gigapath_tpu.dist.worker import write_plan
+
+        root = str(tmp_path)
+        write_plan(root, default_plan(n_tiles=8, chunk_tiles=8, credits=2,
+                                      consumer_ckpt_every=5))
+        with pytest.raises(ValueError, match="consumer_ckpt_every"):
+            run_slide_consumer(root, deadline_s=1.0)

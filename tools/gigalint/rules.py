@@ -930,6 +930,198 @@ def check_signal_hygiene(project: Project) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# GL015 — raw socket hygiene
+# ---------------------------------------------------------------------------
+
+# Raw socket plumbing in library code means a second, unaudited
+# transport: no credits, no backpressure events, no frame digests, no
+# reconnect discipline — everything dist/transport.py exists to own in
+# ONE place. And a blocking recv/accept/connect without a configured
+# deadline is the classic distributed-systems hang: a silent peer parks
+# the process forever with no stall event and no recovery path. Two
+# checks:
+#   1. socket/socketserver CONNECTION primitives (socket.socket,
+#      create_connection/server, socketpair, any socketserver.*) in
+#      library code only inside the path-sanctioned dist/transport.py;
+#   2. EVEN THERE, every function that calls .recv/.accept/.connect
+#      (or create_connection) must configure a deadline in that same
+#      function: settimeout(non-None), setblocking(False), a
+#      select(timeout=...), or create_connection(..., timeout=...).
+_GL015_SOCKET_CALLS = frozenset({
+    "socket.socket", "socket.create_connection", "socket.create_server",
+    "socket.socketpair", "socket.fromfd",
+})
+_GL015_BLOCKING_SUFFIXES = (".recv", ".recvfrom", ".recv_into",
+                            ".accept", ".connect")
+# matched by path suffix so fixture trees can carry their own
+# dist/transport.py twin (the GL010/GL011/GL013 pattern)
+_GL015_SANCTIONED_SUFFIX = "dist/transport.py"
+_GL015_EXEMPT_SEGMENTS = frozenset({"scripts", "tests", "demo"})
+
+
+def _gl015_resolved(mod, name: str) -> str:
+    head, sep, rest = name.partition(".")
+    target = mod.imports.get(head)
+    if target:
+        return f"{target}.{rest}" if sep else target
+    return name
+
+
+def _gl015_module_sockets(mod) -> bool:
+    """Does the module deal in sockets (import socket/socketserver at
+    any level)? The scoping signal for the deadline discipline —
+    ``.connect()`` on a database handle in a socket-free module is not
+    this rule's business."""
+    return any(
+        target in ("socket", "socketserver")
+        or target.startswith("socket.")
+        or target.startswith("socketserver.")
+        for target in mod.imports.values()
+    )
+
+
+def _gl015_conn_timeout(node: ast.Call) -> bool:
+    """create_connection carries its deadline inline: a second
+    positional or a non-None ``timeout`` kwarg."""
+    if len(node.args) >= 2:
+        return True
+    for kw in node.keywords:
+        if kw.arg == "timeout" and not (
+            isinstance(kw.value, ast.Constant) and kw.value.value is None
+        ):
+            return True
+    return False
+
+
+def _gl015_fn_has_deadline(mod, fn) -> bool:
+    """Any deadline-configuring call inside the function body."""
+    for node in ast.walk(fn.node):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if not name:
+            continue
+        if name.endswith(".settimeout") and node.args:
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant) and arg.value is None):
+                return True
+        elif name.endswith(".setblocking") and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and arg.value is False:
+                return True
+        elif name.endswith(".select"):
+            # the timeout operand's position depends on the API:
+            # selectors' select(timeout) is the ONLY positional; stdlib
+            # select.select(r, w, x, timeout) puts it fourth — a
+            # 3-positional select.select(r, w, x) blocks forever and
+            # must earn NO credit (its rlist is not a deadline)
+            operands = [kw.value for kw in node.keywords
+                        if kw.arg == "timeout"]
+            if len(node.args) >= 4:
+                operands.append(node.args[3])
+            elif len(node.args) == 1:
+                operands.append(node.args[0])
+            if any(
+                not (isinstance(op, ast.Constant) and op.value is None)
+                for op in operands
+            ):
+                return True
+    return False
+
+
+@register(
+    "GL015",
+    "raw socket use in library code outside the sanctioned "
+    "dist/transport.py, or a blocking recv/accept/connect without a "
+    "configured timeout (flagged even inside the sanctioned transport) — "
+    "sockets get credits/digests/reconnect discipline in ONE place, and "
+    "no read blocks without a deadline",
+)
+def check_socket_hygiene(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.modules.values():
+        segments = mod.path.split("/")[:-1]
+        if mod.is_test_file or any(
+            s in _GL015_EXEMPT_SEGMENTS for s in segments
+        ):
+            continue
+        sanctioned = (
+            mod.path == _GL015_SANCTIONED_SUFFIX
+            or mod.path == _GL015_SANCTIONED_SUFFIX.split("/")[-1]
+            or mod.path.endswith("/" + _GL015_SANCTIONED_SUFFIX)
+        )
+        module_sockets = _gl015_module_sockets(mod)
+        spans = sorted(
+            (
+                (fn.lineno, getattr(fn.node, "end_lineno", fn.lineno), fn)
+                for fn in mod.functions.values()
+            ),
+            key=lambda t: t[1] - t[0],
+        )
+
+        def symbol_at(lineno: int) -> str:
+            for lo, hi, fn in spans:
+                if lo <= lineno <= hi:
+                    return fn.qualname
+            return "<module>"
+
+        # check 1: connection primitives outside the sanctioned module
+        if not sanctioned:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if not name:
+                    continue
+                resolved = _gl015_resolved(mod, name)
+                if resolved in _GL015_SOCKET_CALLS or resolved.startswith(
+                    "socketserver."
+                ):
+                    findings.append(Finding(
+                        "GL015", mod.path, node.lineno,
+                        symbol_at(node.lineno),
+                        f"raw {resolved}() in library code: a second "
+                        "unaudited transport with no credits, digests or "
+                        "reconnect discipline — route the flow through "
+                        "gigapath_tpu/dist/transport.py (or the boundary "
+                        "channels behind it)",
+                    ))
+        # check 2: deadline discipline, sanctioned module INCLUDED
+        if not module_sockets:
+            continue
+        for fn in mod.functions.values():
+            has_deadline = _gl015_fn_has_deadline(mod, fn)
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if not name:
+                    continue
+                resolved = _gl015_resolved(mod, name)
+                if resolved.endswith("create_connection"):
+                    if not _gl015_conn_timeout(node):
+                        findings.append(Finding(
+                            "GL015", mod.path, node.lineno, fn.qualname,
+                            "create_connection() without a timeout: a "
+                            "silent peer parks this call forever — pass "
+                            "timeout= (the connect deadline)",
+                        ))
+                    continue
+                if any(name.endswith(s) for s in _GL015_BLOCKING_SUFFIXES) \
+                        and "." in name and not has_deadline:
+                    findings.append(Finding(
+                        "GL015", mod.path, node.lineno, fn.qualname,
+                        f"blocking {name.rsplit('.', 1)[1]}() with no "
+                        "configured deadline in this function: a silent "
+                        "peer hangs the process with no stall event — "
+                        "settimeout(...), setblocking(False) + select("
+                        "timeout=...), or bound the wait another way",
+                    ))
+                    break  # one deadline finding per function is enough
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # GL004 — forbidden APIs
 # ---------------------------------------------------------------------------
 

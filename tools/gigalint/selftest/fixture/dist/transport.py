@@ -1,0 +1,32 @@
+"""GL015 sanctioned-twin fixture (never imported — parsed only).
+
+This module's path ends in ``dist/transport.py`` — the one module
+sanctioned to hold raw sockets — so the connection-primitive check must
+stay silent here. The DEADLINE check does not: a blocking recv without a
+configured timeout is flagged even inside the sanctioned transport."""
+
+import socket
+
+
+def negative_control_sanctioned_dial():
+    """create_connection with a timeout, inside the sanctioned module:
+    no finding on either check."""
+    return socket.create_connection(("127.0.0.1", 9), timeout=5.0)
+
+
+def negative_control_timed_recv(sock):
+    """settimeout in the same function: deadline discipline satisfied."""
+    sock.settimeout(1.0)
+    return sock.recv(65536)
+
+
+def negative_control_select_recv(sock, sel):
+    """A select with an explicit timeout also counts as the deadline."""
+    sel.select(timeout=0.02)
+    return sock.recv(65536)
+
+
+def recv_without_deadline(sock):
+    """SEEDED GL015: even the sanctioned transport may not block on a
+    bare recv — no recv without a deadline, anywhere."""
+    return sock.recv(65536)

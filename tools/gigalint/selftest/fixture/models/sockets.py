@@ -1,0 +1,55 @@
+"""Seeded GL015 violations (never imported — parsed only): raw socket
+plumbing outside the sanctioned ``dist/transport.py``, and blocking
+socket calls with no configured deadline — plus the negative controls
+the rule must NOT flag."""
+
+import socket
+import socketserver
+
+
+def open_raw_socket():
+    """SEEDED GL015: socket.socket() in library code — a second,
+    unaudited transport."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.settimeout(5.0)
+    return sock
+
+
+def dial_without_deadline(addr):
+    """SEEDED GL015 (both checks): create_connection outside the
+    sanctioned module AND without a timeout."""
+    return socket.create_connection(addr)
+
+
+def serve_with_socketserver(handler):
+    """SEEDED GL015: socketserver in library code."""
+    return socketserver.TCPServer(("127.0.0.1", 0), handler)
+
+
+def recv_without_timeout(sock):
+    """SEEDED GL015: a blocking recv whose function never configures a
+    deadline — the silent-peer hang."""
+    return sock.recv(4096)
+
+
+def select_without_timeout(sock):
+    """SEEDED GL015: stdlib 3-positional select.select blocks forever —
+    its rlist is not a deadline, so the following recv has none."""
+    import select
+
+    select.select([sock], [], [])
+    return sock.recv(4096)
+
+
+def negative_control_hostname():
+    """socket.gethostname() is not a connection primitive: no finding
+    (the obs layer's per-rank file naming uses it)."""
+    return socket.gethostname()
+
+
+def negative_control_timed_recv(sock):
+    """A recv whose function sets a timeout satisfies the deadline
+    discipline (the raw-use findings fire on constructors, not on a
+    read whose owner configured its deadline)."""
+    sock.settimeout(2.0)
+    return sock.recv(4096)
