@@ -68,7 +68,10 @@ def default_plan(*, slide_id: str = "slide0", n_tiles: int = 64,
                  poll_s: float = 0.02,
                  chunked_prefill: bool = False,
                  transport: Optional[str] = None,
-                 consumer_ckpt_every: Optional[int] = None) -> dict:
+                 consumer_ckpt_every: Optional[int] = None,
+                 encoder: Optional[str] = None,
+                 quant: Optional[str] = None,
+                 img_size: Optional[int] = None) -> dict:
     """The dryrun's plan document (written to ``<root>/plan.json``,
     read by every process — the shared deterministic truth).
     ``chunked_prefill`` puts the consumer in streaming mode: chunks fold
@@ -92,6 +95,15 @@ def default_plan(*, slide_id: str = "slide0", n_tiles: int = 64,
         plan["transport"] = str(transport)
     if consumer_ckpt_every is not None:
         plan["consumer_ckpt_every"] = int(consumer_ckpt_every)
+    if encoder is not None:
+        # "dryrun" (numpy projection) or "quant_vit" (the REAL quantized
+        # tile encoder behind worker.make_encoder's seam); in the plan so
+        # every worker — restarted or reassigned — builds the same one
+        plan["encoder"] = str(encoder)
+    if quant is not None:
+        plan["quant"] = str(quant)
+    if img_size is not None:
+        plan["img_size"] = int(img_size)
     return plan
 
 

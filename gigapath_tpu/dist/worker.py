@@ -32,10 +32,14 @@ embedding, bit-exact.
 
 The dryrun encoder is numpy (a fixed seeded projection + tanh): bitwise
 deterministic across processes, imports in milliseconds, and keeps the
-protocol layer provably free of traced code. The real ViT-G tile
-encoder drops in behind the same ``encode(feats) -> embeds`` surface
-(quantized per ROADMAP item 3), sharded per the ``tile_encoder`` entry
-of :mod:`gigapath_tpu.dist.stagemesh`.
+protocol layer provably free of traced code. The REAL quantized tile
+encoder (ROADMAP item 3, ``gigapath_tpu/quant/``) drops in behind the
+same ``encode`` seam when the plan says ``encoder: "quant_vit"`` — see
+:func:`make_encoder`: the registry ViT arch with the quantized-Dense
+tier, params deterministic from the plan's ``encoder_seed``, placed per
+the ``tile_encoder`` entry of :mod:`gigapath_tpu.dist.stagemesh`, with
+the kill/recover bit-exactness contract unchanged (re-encoding a chunk
+is the same jitted program on the same machine).
 """
 
 from __future__ import annotations
@@ -107,6 +111,91 @@ def encode_chunk(plan: dict, weights: np.ndarray, start: int, stop: int):
     return np.tanh(feats @ weights, dtype=np.float32), coords
 
 
+def chunk_images(plan: dict, start: int, stop: int):
+    """Synthetic tile IMAGES + coords for one tile range — the real-
+    encoder twin of :func:`chunk_tiles`, a pure function of
+    (tile_seed, tile index) so retransmits, reassignment and interleaved
+    multi-worker production stay bit-exact."""
+    rng = np.random.default_rng([int(plan["tile_seed"]), int(start)])
+    n = stop - start
+    img = int(plan.get("img_size", 32))
+    imgs = rng.standard_normal((n, img, img, 3)).astype(np.float32)
+    coords = rng.uniform(0, 25000, (n, 2)).astype(np.float32)
+    return imgs, coords
+
+
+def make_encoder(plan: dict):
+    """The ``encode(start, stop) -> (embeds, coords)`` seam.
+
+    ``plan["encoder"]`` selects the implementation behind the UNCHANGED
+    surface: ``"dryrun"`` (default) is the seeded numpy projection;
+    ``"quant_vit"`` is the REAL quantized ViT tile encoder (ROADMAP
+    item 3 meeting item 4) — the registry tile arch with
+    ``plan["quant"]``'s quantized-Dense tier, params deterministic from
+    ``encoder_seed``, placed through the ``tile_encoder`` entry of the
+    stage-sharding registry (a 1-device stage mesh in the dryrun — the
+    same declarative path a sharded fleet consumes), one jitted forward
+    per worker process. Produced embeddings round through the shared
+    bf16 helper so every producer of tile embeddings — this worker, the
+    dense pipeline entry, the streaming entry — feeds the slide stage
+    bit-identical inputs. jax imports stay inside the quant_vit arm:
+    the default dryrun worker remains numpy-only and starts in
+    milliseconds."""
+    encoder = plan.get("encoder", "dryrun")
+    if encoder == "dryrun":
+        weights = encoder_weights(plan)
+        return lambda start, stop: encode_chunk(plan, weights, start, stop)
+    if encoder != "quant_vit":
+        # a typo'd encoder name must never silently run the dryrun
+        # projection and look healthy (the get_chaos/normalize_mode
+        # loud-typo discipline)
+        raise ValueError(
+            f"unknown plan encoder '{encoder}' (known: dryrun, quant_vit)"
+        )
+
+    import jax
+    import jax.numpy as jnp
+
+    from gigapath_tpu.dist.stagemesh import stage_mesh, stage_param_shardings
+    from gigapath_tpu.models.tile_encoder import init_params
+    from gigapath_tpu.quant.qtensor import bf16_round_trip, normalize_mode
+    from gigapath_tpu.utils.registry import create_model_from_registry
+
+    mode = normalize_mode(plan.get("quant", "int8"))
+    model = create_model_from_registry(
+        plan.get("tile_arch", "vit_tile_enc_test"),
+        img_size=int(plan.get("img_size", 32)),
+        embed_dim=int(plan["dim_out"]),
+        quant=mode,
+    )
+    params = init_params(
+        model, rng=jax.random.PRNGKey(int(plan["encoder_seed"]))
+    )
+    mesh = stage_mesh("tile_encoder", devices=jax.devices()[:1])
+    params = jax.device_put(
+        params, stage_param_shardings("tile_encoder", params, mesh)
+    )
+    forward = jax.jit(lambda p, x: model.apply({"params": p}, x))
+    # warm EVERY chunk shape NOW, before the caller registers its
+    # lease: the compiles must never land inside the lease window (a
+    # worker paying its first compile mid-slide would look exactly like
+    # a dead worker to the membership layer). plan_chunks emits at most
+    # two shapes — the full chunk and a ragged tail.
+    chunk = int(plan.get("chunk_tiles", 8))
+    img = int(plan.get("img_size", 32))
+    tail = int(plan["n_tiles"]) % chunk if plan.get("n_tiles") else 0
+    for n in {chunk} | ({tail} if tail else set()):
+        forward(params, jnp.zeros((n, img, img, 3), jnp.float32)
+                ).block_until_ready()
+
+    def encode(start: int, stop: int):
+        imgs, coords = chunk_images(plan, start, stop)
+        embeds = np.asarray(forward(params, jnp.asarray(imgs)), np.float32)
+        return bf16_round_trip(embeds), coords
+
+    return encode
+
+
 # ---------------------------------------------------------------------------
 # the worker loop
 # ---------------------------------------------------------------------------
@@ -144,10 +233,14 @@ def run_tile_worker(root: str, worker_id: str, *,
         [c[0] for c in chunks], workers,
     ).get(worker_id, [])
 
+    # build (and, for the quant_vit encoder, jit-warm) the encoder
+    # BEFORE registering the lease: the expensive one-time setup must
+    # not eat into the first lease window — a worker importing jax is
+    # not a dead worker
+    encode = make_encoder(plan)
     lease = WorkerLease(root, worker_id, stage="tile",
                         lease_s=plan.get("lease_s"))
     lease.register()
-    weights = encoder_weights(plan)
     # the transport seam: dir (the dryrun stand-in) or tcp (the real
     # wire), chosen by the plan / GIGAPATH_DIST_TRANSPORT — nothing
     # below this line changes with the transport
@@ -182,7 +275,7 @@ def run_tile_worker(root: str, worker_id: str, *,
                         slow = chaos.slow_worker(cid)
                         if slow:
                             time.sleep(slow)
-                    embeds, coords = encode_chunk(plan, weights, start, stop)
+                    embeds, coords = encode(start, stop)
                     chunk = EmbeddingChunk.build(
                         plan["slide_id"], cid, start, stop, embeds,
                         coords=coords, producer=worker_id,

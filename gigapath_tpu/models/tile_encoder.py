@@ -92,11 +92,38 @@ class LayerScale(nn.Module):
         return x * gamma.astype(x.dtype)
 
 
+def _dense(features: int, *, quant: str, quant_pallas: bool, dtype,
+           param_dtype, name: str):
+    """The quantized-tier seam: ``nn.Dense`` when ``quant`` is empty
+    (the f32/bf16 fallback and parity oracle — byte-identical trace to
+    the pre-quant program), else the ``QuantDense`` twin (same param
+    names/shapes, so checkpoints and the sharding-rule name lists are
+    oblivious). ``quant``/``quant_pallas`` come from the caller's
+    ``PipelineFlags`` snapshot — never from the environment here."""
+    if not quant:
+        return nn.Dense(
+            features, dtype=dtype, param_dtype=param_dtype, name=name
+        )
+    from gigapath_tpu.quant.qmatmul import QuantDense
+
+    return QuantDense(
+        features, mode=quant, use_pallas=quant_pallas, dtype=dtype,
+        param_dtype=param_dtype, name=name,
+    )
+
+
 class ViTAttention(nn.Module):
-    """Packed-qkv multi-head self-attention (timm ``Attention``)."""
+    """Packed-qkv multi-head self-attention (timm ``Attention``).
+
+    ``quant`` routes the qkv/proj matmuls through the quantized tier
+    (gigapath_tpu/quant/); the ``+attn`` rider additionally computes
+    the attention logits from dynamically-quantized int8 Q/K
+    (quant/qflash.py) — f32 softmax statistics either way."""
 
     dim: int
     num_heads: int
+    quant: str = ""
+    quant_pallas: bool = False
     dtype: Any = None
     param_dtype: Any = jnp.float32
 
@@ -105,15 +132,22 @@ class ViTAttention(nn.Module):
         B, N, D = x.shape
         H = self.num_heads
         hd = D // H
-        qkv = nn.Dense(
-            3 * D, dtype=self.dtype, param_dtype=self.param_dtype, name="qkv"
+        qkv = _dense(
+            3 * D, quant=self.quant, quant_pallas=self.quant_pallas,
+            dtype=self.dtype, param_dtype=self.param_dtype, name="qkv"
         )(x)
         qkv = qkv.reshape(B, N, 3, H, hd)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        out, _ = attention_with_lse(q, k, v)
+        if self.quant and self.quant.endswith("+attn"):
+            from gigapath_tpu.quant.qflash import q_flash_attention
+
+            out, _ = q_flash_attention(q, k, v, use_pallas=self.quant_pallas)
+        else:
+            out, _ = attention_with_lse(q, k, v)
         out = out.reshape(B, N, D)
-        return nn.Dense(
-            D, dtype=self.dtype, param_dtype=self.param_dtype, name="proj"
+        return _dense(
+            D, quant=self.quant, quant_pallas=self.quant_pallas,
+            dtype=self.dtype, param_dtype=self.param_dtype, name="proj"
         )(out)
 
 
@@ -123,18 +157,24 @@ class SwiGLUPacked(nn.Module):
 
     hidden_dim: int
     out_dim: int
+    quant: str = ""
+    quant_pallas: bool = False
     dtype: Any = None
     param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        x = nn.Dense(
-            self.hidden_dim, dtype=self.dtype, param_dtype=self.param_dtype, name="fc1"
+        x = _dense(
+            self.hidden_dim, quant=self.quant,
+            quant_pallas=self.quant_pallas, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="fc1"
         )(x)
         x1, x2 = jnp.split(x, 2, axis=-1)
         x = nn.silu(x1) * x2
-        return nn.Dense(
-            self.out_dim, dtype=self.dtype, param_dtype=self.param_dtype, name="fc2"
+        return _dense(
+            self.out_dim, quant=self.quant,
+            quant_pallas=self.quant_pallas, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="fc2"
         )(x)
 
 
@@ -143,17 +183,23 @@ class Mlp(nn.Module):
 
     hidden_dim: int
     out_dim: int
+    quant: str = ""
+    quant_pallas: bool = False
     dtype: Any = None
     param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        x = nn.Dense(
-            self.hidden_dim, dtype=self.dtype, param_dtype=self.param_dtype, name="fc1"
+        x = _dense(
+            self.hidden_dim, quant=self.quant,
+            quant_pallas=self.quant_pallas, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="fc1"
         )(x)
         x = nn.gelu(x, approximate=False)
-        return nn.Dense(
-            self.out_dim, dtype=self.dtype, param_dtype=self.param_dtype, name="fc2"
+        return _dense(
+            self.out_dim, quant=self.quant,
+            quant_pallas=self.quant_pallas, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="fc2"
         )(x)
 
 
@@ -167,6 +213,8 @@ class ViTBlock(nn.Module):
     init_values: Optional[float] = 1e-5
     drop_path: float = 0.0
     norm_eps: float = 1e-6
+    quant: str = ""
+    quant_pallas: bool = False
     dtype: Any = None
     param_dtype: Any = jnp.float32
 
@@ -182,6 +230,8 @@ class ViTBlock(nn.Module):
         h = ViTAttention(
             self.dim,
             self.num_heads,
+            quant=self.quant,
+            quant_pallas=self.quant_pallas,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="attn",
@@ -196,6 +246,8 @@ class ViTBlock(nn.Module):
         h = mlp_cls(
             self.mlp_hidden_dim,
             self.dim,
+            quant=self.quant,
+            quant_pallas=self.quant_pallas,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="mlp",
@@ -228,6 +280,13 @@ class VisionTransformer(nn.Module):
     drop_path_rate: float = 0.0
     norm_eps: float = 1e-6
     global_pool: str = "token"
+    # quantized-weight tier ('' = off — the f32/bf16 fallback and parity
+    # oracle; 'int8' / 'fp8_e4m3', optionally '+attn'): the value of the
+    # caller's PipelineFlags.quant_tile snapshot (GIGAPATH_QUANT_TILE),
+    # passed at construction so the traced program — and therefore the
+    # jit cache key — is distinct per tier
+    quant: str = ""
+    quant_pallas: bool = False
     dtype: Any = None
     param_dtype: Any = jnp.float32
 
@@ -281,6 +340,8 @@ class VisionTransformer(nn.Module):
                 init_values=self.init_values,
                 drop_path=float(dpr[i]),
                 norm_eps=self.norm_eps,
+                quant=self.quant,
+                quant_pallas=self.quant_pallas,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 name=f"blocks_{i}",
@@ -408,6 +469,29 @@ def create_tile_encoder(
     """
     model = create_model_from_registry(model_arch, **kwargs)
     params = init_params(model, rng=rng)
+    if pretrained and os.path.isdir(pretrained) and os.path.exists(
+        os.path.join(pretrained, "manifest.json")
+    ):
+        # a quantized artifact (quant/convert.py): manifest-verified
+        # load, then the f32 dequant contract back into the param tree
+        # (QuantDense re-quantizes in-graph to the identical grid —
+        # the round-trip is idempotent by construction)
+        from gigapath_tpu.quant.convert import (
+            _walk,
+            dequantize_params,
+            load_quantized,
+        )
+
+        qparams, qmeta = load_quantized(pretrained)
+        converted = dict(_walk(dequantize_params(qparams)))
+        params, missing, unexpected = merge_into_params(params, converted)
+        console(
+            f"\033[92m Loaded quantized tile-encoder artifact from "
+            f"{pretrained} (mode={qmeta.get('mode')}, "
+            f"{qmeta.get('n_quantized')} quantized kernels, "
+            f"{len(missing)} missing, {len(unexpected)} unexpected) \033[00m"
+        )
+        return model, params
     if pretrained and os.path.exists(pretrained):
         state = load_torch_state_dict(pretrained)
         converted = convert_timm_state_dict(state, target_grid=model.grid_size)

@@ -59,6 +59,11 @@ _DIRECTION_RULES: Tuple[Tuple[str, str], ...] = (
     ("_p95_s", "down"),
     ("_p99_s", "down"),
     ("compile_seconds_total", "down"),
+    # quantized tile tier (tile|quant entry, scripts/ab_tile.py):
+    # throughput rides the tiles_per_sec rule above; drift vs the f32
+    # oracle and the downstream probe delta are down-good
+    ("cosine_drift", "down"),
+    ("probe_delta_pt", "down"),
     # streaming-prefill decision-table rows (prefill|stream entry):
     # executable arg/temp/peak megabytes and stream-vs-dense ratios,
     # smaller is better
@@ -323,6 +328,30 @@ def fold_prefill(doc: dict, snapshot: dict, label: str,
     )
 
 
+# ab_tile payload fields worth trending (scripts/ab_tile.py's JSON):
+# per-variant tile throughput, the int8/bf16 walltime ratio, and the
+# parity numbers behind the adopt_quant_tile decision row
+_TILE_METRICS = (
+    # variant keys as ab_tile flattens them: '+' -> '_' on the variant
+    # name, so the fp8 and attn-rider variants fold too
+    "bf16_tiles_per_sec", "int8_tiles_per_sec", "fp8_e4m3_tiles_per_sec",
+    "int8_attn_tiles_per_sec",
+    "int8_over_bf16", "cosine_drift", "probe_delta_pt",
+)
+
+
+def fold_tile(doc: dict, snapshot: dict, label: str,
+              source: Optional[str] = None, force: bool = False) -> dict:
+    """One ``ab_tile`` JSON -> one point under ``tile|quant`` (the
+    quantized tile tier's trend entry — same shared staleness policy as
+    the serve/dist/prefill entries: a CPU parity run carries the metric
+    KEYS but never moves the trend; only on-chip throughput does)."""
+    return _fold_serve_snapshot(
+        doc, snapshot, label, key="tile|quant",
+        metric_keys=_TILE_METRICS, source=source, force=force,
+    )
+
+
 def fold_multichip(doc: dict, snapshot: dict, label: str,
                    source: Optional[str] = None, force: bool = False) -> dict:
     metrics = {
@@ -355,6 +384,11 @@ def _flatten_ledger_entry(entry: dict) -> Dict[str, float]:
     num = _finite_number(jaxpr.get("eqns_total"))
     if num is not None:
         metrics["jaxpr.eqns_total"] = num
+    num = _finite_number(jaxpr.get("quant"))
+    if num is not None:
+        # recorded, not direction-gated: the quant eqn count changes
+        # legitimately with the tier flag; ledger_diff pins it per-key
+        metrics["jaxpr.quant"] = num
     return metrics
 
 

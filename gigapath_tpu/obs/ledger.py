@@ -59,17 +59,37 @@ FINGERPRINT_COLUMNS = (
 # jaxpr fingerprint
 # ---------------------------------------------------------------------------
 
-def _count_eqns(jaxpr, counts: Dict[str, int]) -> None:
+def _eqn_is_quant(eqn) -> bool:
+    """Does an equation touch a low-precision (int8 / float8_*) aval?
+    The ``quant`` fingerprint column: when the quantized kernel tier
+    (gigapath_tpu/quant/, GIGAPATH_QUANT_TILE) is on, the traced
+    program must SHOW low-precision operands — a tier flag that
+    compiles the f32 program silently is exactly the regression this
+    column pins, the same way ppermute/all_gather pin the ring tier."""
+    for var in list(eqn.invars) + list(eqn.outvars):
+        aval = getattr(var, "aval", None)
+        dtype = str(getattr(aval, "dtype", ""))
+        if dtype == "int8" or dtype.startswith("float8"):
+            return True
+    return False
+
+
+def _count_eqns(jaxpr, counts: Dict[str, int],
+                qbox: Optional[List[int]] = None) -> None:
     """Recursive primitive histogram over a jaxpr and every sub-jaxpr
-    (pjit bodies, custom_vjp calls, scan/cond branches, pallas_call)."""
+    (pjit bodies, custom_vjp calls, scan/cond branches, pallas_call).
+    ``qbox`` (a 1-element list) additionally accumulates the
+    low-precision eqn count for the ``quant`` column."""
     for eqn in jaxpr.eqns:
         counts[eqn.primitive.name] = counts.get(eqn.primitive.name, 0) + 1
+        if qbox is not None and _eqn_is_quant(eqn):
+            qbox[0] += 1
         for val in eqn.params.values():
             for item in val if isinstance(val, (list, tuple)) else (val,):
                 sub = getattr(item, "jaxpr", None)
                 if sub is not None:
                     # ClosedJaxpr has .jaxpr.eqns; Jaxpr has .eqns
-                    _count_eqns(getattr(sub, "jaxpr", sub), counts)
+                    _count_eqns(getattr(sub, "jaxpr", sub), counts, qbox)
                 elif hasattr(item, "eqns") and eqn.primitive.name != "pallas_call":
                     # a RAW Jaxpr param (shard_map bodies ride as one):
                     # without this arm the whole sharded program would
@@ -77,23 +97,28 @@ def _count_eqns(jaxpr, counts: Dict[str, int]) -> None:
                     # kernel bodies stay opaque on purpose — the KERNEL
                     # COUNT is the round-6 column's signal; Mosaic
                     # kernel-internal ops are not XLA glue
-                    _count_eqns(item, counts)
+                    _count_eqns(item, counts, qbox)
 
 
 def jaxpr_fingerprint(fn, *args, **kwargs) -> Dict[str, Any]:
     """Eqn counts by primitive for ``fn(*args, **kwargs)``'s traced
-    program: ``{"eqns_total": N, "primitives": {name: count}}`` with the
-    :data:`FINGERPRINT_COLUMNS` always present. One extra trace, no
-    compile. ``fn`` may be jitted or plain."""
+    program: ``{"eqns_total": N, "quant": Q, "primitives": {name:
+    count}}`` with the :data:`FINGERPRINT_COLUMNS` always present and
+    ``quant`` the count of eqns touching int8/float8 avals (the
+    quantized-tier op-mix pin — NOT a primitive, so it never feeds
+    ``eqns_total``). One extra trace, no compile. ``fn`` may be jitted
+    or plain."""
     import jax
 
     closed = jax.make_jaxpr(fn)(*args, **kwargs)
     counts: Dict[str, int] = {}
-    _count_eqns(closed.jaxpr, counts)
+    qbox = [0]
+    _count_eqns(closed.jaxpr, counts, qbox)
     for col in FINGERPRINT_COLUMNS:
         counts.setdefault(col, 0)
     return {
         "eqns_total": int(sum(counts.values())),
+        "quant": int(qbox[0]),
         "primitives": {k: int(v) for k, v in sorted(counts.items())},
     }
 

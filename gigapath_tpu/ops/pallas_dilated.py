@@ -849,19 +849,33 @@ class PipelineFlags(NamedTuple):
     # (dist consumer, inference --stream default) instead of
     # assemble-then-encode; the dense path stays the fallback/oracle
     chunked_prefill: bool = False
+    # quantized tile-encoder tier (gigapath_tpu/quant/): '' = off (the
+    # f32/bf16 fallback and parity oracle), 'int8' / 'fp8_e4m3' =
+    # quantized Dense kernels, '+attn' rider = int8 attention logits
+    # too. Drivers holding a snapshot pass this into the tile-encoder
+    # factory; the quant ops themselves never read the environment
+    quant_tile: str = ""
+    # Pallas tier for the quantized matmul/attention kernels (the jnp
+    # reference formulation is the default tier)
+    quant_pallas: bool = False
 
 
 def snapshot_flags() -> PipelineFlags:
     """Read GIGAPATH_PIPELINED_ATTN/_BWD, GIGAPATH_PIPE(_BWD)_BLOCK_K,
-    GIGAPATH_PACK_DIRECT, GIGAPATH_STREAM_FUSION, GIGAPATH_RING_ATTN and
-    GIGAPATH_CHUNKED_PREFILL from the environment, once."""
+    GIGAPATH_PACK_DIRECT, GIGAPATH_STREAM_FUSION, GIGAPATH_RING_ATTN,
+    GIGAPATH_CHUNKED_PREFILL, GIGAPATH_QUANT_TILE and
+    GIGAPATH_QUANT_PALLAS from the environment, once."""
     import os
 
     from gigapath_tpu.ops.common import env_flag
+    from gigapath_tpu.quant.qtensor import normalize_mode
 
     def _int(name: str) -> Optional[int]:
         raw = os.environ.get(name, "").strip()
         return int(raw) if raw else None
+
+    def _str(name: str) -> str:
+        return os.environ.get(name, "").strip()
 
     return PipelineFlags(
         pipelined_fwd=env_flag("GIGAPATH_PIPELINED_ATTN"),
@@ -872,6 +886,8 @@ def snapshot_flags() -> PipelineFlags:
         stream_fusion=env_flag("GIGAPATH_STREAM_FUSION"),
         ring_attn=env_flag("GIGAPATH_RING_ATTN"),
         chunked_prefill=env_flag("GIGAPATH_CHUNKED_PREFILL"),
+        quant_tile=normalize_mode(_str("GIGAPATH_QUANT_TILE")),
+        quant_pallas=env_flag("GIGAPATH_QUANT_PALLAS"),
     )
 
 

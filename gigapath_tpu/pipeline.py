@@ -89,9 +89,19 @@ def load_tile_slide_encoder(
     global_pool: bool = False,
 ) -> Tuple[tuple, tuple]:
     """Load both encoders; returns ``((tile_model, tile_params),
-    (slide_model, slide_params))`` (reference ``pipeline.py:118-137``)."""
+    (slide_model, slide_params))`` (reference ``pipeline.py:118-137``).
+
+    The tile encoder honors the ``GIGAPATH_QUANT_TILE`` kernel tier via
+    one host-side ``PipelineFlags`` snapshot (the same convention every
+    kernel flag follows): quant off builds the byte-identical f32/bf16
+    program, quant on builds the quantized-Dense tier — a distinct
+    traced program, so the jit cache can never serve the wrong tier."""
+    from gigapath_tpu.ops.pallas_dilated import snapshot_flags
+
+    flags = snapshot_flags()
     tile_model, tile_params = tile_encoder_lib.create_tile_encoder(
-        pretrained=local_tile_encoder_path, dtype=jnp.bfloat16
+        pretrained=local_tile_encoder_path, dtype=jnp.bfloat16,
+        quant=flags.quant_tile, quant_pallas=flags.quant_pallas,
     )
     n_tile = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(tile_params))
     console(f"Tile encoder param # {n_tile}")
@@ -179,20 +189,20 @@ def run_inference_with_slide_encoder_streaming(
         chunk_tiles=chunk_tiles, all_layer_embed=True,
     )
 
-    def quantize(embeds):
-        # the dense entry casts activations to bf16 before apply
-        # (pipeline.py TPU shape); mirror that quantization per chunk so
-        # the two entries see identical inputs
-        return np.asarray(
-            jnp.asarray(embeds, jnp.bfloat16).astype(jnp.float32)
-        )
+    # the dense entry casts activations to bf16 before apply (the TPU
+    # shape); the ONE shared helper (quant/qtensor.py) mirrors that
+    # quantization per chunk so every entry — dense, streaming, and the
+    # dist tile worker's real encoder — feeds the slide encoder
+    # bit-identical inputs (parity-pinned in tests/test_quant.py)
+    from gigapath_tpu.quant.qtensor import bf16_round_trip
 
     for item in chunks:
         if hasattr(item, "chunk_id"):  # EmbeddingChunk duck type
-            session.feed(item.chunk_id, quantize(item.payload), item.coords)
+            session.feed(item.chunk_id, bf16_round_trip(item.payload),
+                         item.coords)
         else:
             idx, embeds, coords = item
-            session.feed(idx, quantize(embeds), coords)
+            session.feed(idx, bf16_round_trip(embeds), coords)
     return embeds_to_outputs(session.finalize())
 
 

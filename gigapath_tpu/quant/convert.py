@@ -1,0 +1,238 @@
+"""Checkpoint converter: timm/flax weights -> a calibrated, quantized,
+atomically-persisted on-disk artifact.
+
+The artifact follows ``resilience/checkpoint.py``'s manifest
+discipline: every save lands in a ``.tmp-*`` directory and is renamed
+into place (a SIGKILL mid-write leaves a stale tmp dir, never a
+half-written artifact), and a ``manifest.json`` of per-file sha256
+digests is re-hashed on load — bit rot or a truncated copy is a
+refused load (:class:`CorruptQuantArtifact`), never silently-wrong
+scales.
+
+What is quantized: every 2-D ``kernel`` leaf (the Dense matmuls —
+qkv/proj/fc1/fc2; exactly the layers ``QuantDense`` consumes). The
+conv patch embed (4-D), biases, norms, tokens and position tables stay
+full precision — they are noise-sized next to the 1.13 B of Dense
+kernels, and quantizing them buys nothing. Calibration is the
+per-output-channel absmax of qtensor.py — data-free, idempotent
+(``quantize(dequantize(q)) == q`` bit-exactly, pinned in
+tests/test_quant.py), so the artifact can be round-tripped through the
+f32 dequant contract without drift.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from gigapath_tpu.quant.qtensor import (
+    QTensor,
+    base_mode,
+    dequantize,
+    normalize_mode,
+    quantize_per_channel,
+)
+
+ARTIFACT_SCHEMA_VERSION = 1
+_ARRAYS = "arrays.npz"
+_META = "meta.json"
+_MANIFEST = "manifest.json"
+
+
+class CorruptQuantArtifact(ValueError):
+    """A quantized artifact whose manifest verification failed."""
+
+
+def _is_dense_kernel(path: Tuple[str, ...], leaf) -> bool:
+    return (
+        len(path) > 0 and path[-1] == "kernel"
+        and getattr(leaf, "ndim", 0) == 2
+    )
+
+
+def _walk(tree: Dict[str, Any], prefix: Tuple[str, ...] = ()):
+    for key in sorted(tree):
+        value = tree[key]
+        if isinstance(value, dict) and not isinstance(value, QTensor):
+            yield from _walk(value, prefix + (key,))
+        else:
+            yield prefix + (key,), value
+
+
+def quantize_params(params: Dict[str, Any], mode: str) -> Dict[str, Any]:
+    """Param tree -> same-shaped tree with every Dense kernel replaced
+    by a :class:`QTensor` (host numpy leaves — no device allocation for
+    the 1.13 B-param flagship)."""
+    mode = base_mode(normalize_mode(mode))
+
+    def one(path, leaf):
+        if _is_dense_kernel(path, leaf):
+            qt = quantize_per_channel(np.asarray(leaf, np.float32), mode)
+            return QTensor(np.asarray(qt.data), np.asarray(qt.scale))
+        return np.asarray(leaf)
+
+    out: Dict[str, Any] = {}
+    for path, leaf in _walk(params):
+        node = out
+        for key in path[:-1]:
+            node = node.setdefault(key, {})
+        node[path[-1]] = one(path, leaf)
+    return out
+
+
+def dequantize_params(qparams: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse view: QTensor leaves -> f32 arrays (the dequant
+    contract), everything else passed through."""
+    out: Dict[str, Any] = {}
+    for path, leaf in _walk(qparams):
+        node = out
+        for key in path[:-1]:
+            node = node.setdefault(key, {})
+        if isinstance(leaf, QTensor):
+            node[path[-1]] = np.asarray(dequantize(leaf))
+        else:
+            node[path[-1]] = leaf
+    return out
+
+
+def convert_timm_quantized(
+    state_dict: Dict[str, Any], mode: str, *,
+    target_grid: Optional[int] = None,
+) -> Dict[str, Any]:
+    """The timm-checkpoint path (``convert_timm_state_dict``) composed
+    with calibration: timm state dict -> flax tree -> quantized tree."""
+    from gigapath_tpu.models.tile_encoder import convert_timm_state_dict
+
+    flat = convert_timm_state_dict(state_dict, target_grid=target_grid)
+    nested: Dict[str, Any] = {}
+    for path, arr in flat.items():
+        node = nested
+        for key in path[:-1]:
+            node = node.setdefault(key, {})
+        node[path[-1]] = arr
+    return quantize_params(nested, mode)
+
+
+# ---------------------------------------------------------------------------
+# the on-disk artifact
+# ---------------------------------------------------------------------------
+
+def _sha256_file(path: str) -> str:
+    digest = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            digest.update(chunk)
+    return digest.hexdigest()
+
+
+def _hash_tree(root: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for dirpath, _, files in os.walk(root):
+        for name in sorted(files):
+            if dirpath == root and name == _MANIFEST:
+                continue
+            full = os.path.join(dirpath, name)
+            out[os.path.relpath(full, root)] = _sha256_file(full)
+    return out
+
+
+def save_quantized(path: str, qparams: Dict[str, Any], *,
+                   meta: Optional[dict] = None) -> str:
+    """Atomic verified save: ``.tmp-*`` staging + manifest + rename —
+    the commit point is the rename, exactly like the resilient
+    checkpointer's."""
+    path = os.path.abspath(path)
+    parent = os.path.dirname(path)
+    os.makedirs(parent, exist_ok=True)
+    tmp = os.path.join(parent, f".tmp-{os.path.basename(path)}-{os.getpid()}")
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp)
+
+    arrays: Dict[str, np.ndarray] = {}
+    n_quant = n_raw = 0
+    mode = ""
+    for tree_path, leaf in _walk(qparams):
+        key = "/".join(tree_path)
+        if isinstance(leaf, QTensor):
+            data = np.asarray(leaf.data)
+            if data.dtype == np.int8:
+                arrays[f"{key}.q"] = data
+            else:
+                # fp8 rides the npz as a uint8 bitcast (the npy format
+                # cannot serialize ml_dtypes custom dtypes); the load
+                # path views it back — bit-exact either way
+                arrays[f"{key}.qf8"] = data.view(np.uint8)
+            arrays[f"{key}.scale"] = np.asarray(leaf.scale, np.float32)
+            mode = mode or leaf.mode
+            n_quant += 1
+        else:
+            arrays[f"{key}.raw"] = np.asarray(leaf)
+            n_raw += 1
+    with open(os.path.join(tmp, _ARRAYS), "wb") as fh:
+        np.savez(fh, **arrays)
+    doc = {
+        "v": ARTIFACT_SCHEMA_VERSION, "mode": mode,
+        "n_quantized": n_quant, "n_raw": n_raw, **(meta or {}),
+    }
+    with open(os.path.join(tmp, _META), "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+    manifest = {"v": ARTIFACT_SCHEMA_VERSION, "files": _hash_tree(tmp)}
+    with open(os.path.join(tmp, _MANIFEST), "w", encoding="utf-8") as fh:
+        json.dump(manifest, fh, indent=1, sort_keys=True)
+    shutil.rmtree(path, ignore_errors=True)
+    os.rename(tmp, path)
+    return path
+
+
+def load_quantized(path: str, *, verify: bool = True
+                   ) -> Tuple[Dict[str, Any], dict]:
+    """Verified load: re-hash against the manifest first; any missing,
+    mismatched or extra file refuses the artifact loudly."""
+    path = os.path.abspath(path)
+    if verify:
+        try:
+            with open(os.path.join(path, _MANIFEST), encoding="utf-8") as fh:
+                manifest = json.load(fh)
+            expected = manifest["files"]
+        except (OSError, ValueError, KeyError) as e:
+            raise CorruptQuantArtifact(
+                f"{path}: unreadable manifest ({type(e).__name__}: {e})"
+            ) from None
+        actual = _hash_tree(path)
+        if actual != expected:
+            bad = sorted(
+                set(expected.items()) ^ set(actual.items())
+            )[:3]
+            raise CorruptQuantArtifact(
+                f"{path}: manifest verification failed (first deltas: "
+                f"{[name for name, _ in bad]})"
+            )
+    with open(os.path.join(path, _META), encoding="utf-8") as fh:
+        meta = json.load(fh)
+    qparams: Dict[str, Any] = {}
+    with np.load(os.path.join(path, _ARRAYS), allow_pickle=False) as z:
+        staged: Dict[str, dict] = {}
+        for key in z.files:
+            tree_key, _, kind = key.rpartition(".")
+            staged.setdefault(tree_key, {})[kind] = z[key]
+    for tree_key, parts in staged.items():
+        node = qparams
+        path_parts = tree_key.split("/")
+        for key in path_parts[:-1]:
+            node = node.setdefault(key, {})
+        if "raw" in parts:
+            node[path_parts[-1]] = parts["raw"]
+        elif "qf8" in parts:
+            from gigapath_tpu.quant.qtensor import fp8_dtype
+
+            node[path_parts[-1]] = QTensor(
+                parts["qf8"].view(fp8_dtype()), parts["scale"]
+            )
+        else:
+            node[path_parts[-1]] = QTensor(parts["q"], parts["scale"])
+    return qparams, meta

@@ -1,0 +1,204 @@
+"""Drift-vs-oracle parity harness for the quantized tile tier.
+
+The adoption evidence for ``GIGAPATH_QUANT_TILE`` is two numbers per
+variant, both computed against the f32 oracle forward on the COMMITTED
+fixture weights (``tests/fixtures/quant_tile_fixture.npz``, regenerate
+with ``scripts/gen_quant_fixture.py``):
+
+- **embedding cosine** — mean per-tile cosine between the variant's
+  embeddings and the f32 oracle's (the acceptance bar: int8 >= 0.999);
+- **downstream linear-probe delta** — the PCam-recipe linear probe
+  (lr 0.02 SGD, the ``scripts/run_pcam.py`` hyperparameters scaled to
+  the fixture) trained on each variant's embeddings; the variant's
+  held-out accuracy minus the oracle's, in points (bar: |delta| <=
+  0.5 pt). Cosine alone can hide a systematic rotation that a linear
+  head feels; the probe delta is the downstream-task check.
+
+``decision_table`` renders the ``ab_dilated``-shaped
+``adopt_quant_tile`` row: parity gates ALWAYS apply; the speed gate
+(int8 at least 3% faster than bf16) applies only when walltime was
+measured, so a CPU run emits the full table with ``adopt_quant_tile``
+false and ``parity_ok`` true — the same "CPU rows never flip defaults"
+stance every decision table in this repo takes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+DEFAULT_FIXTURE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "tests", "fixtures", "quant_tile_fixture.npz",
+)
+FIXTURE_ARCH = "vit_tile_enc_test"
+
+COSINE_BAR = 0.999
+PROBE_DELTA_BAR_PT = 0.5
+SPEEDUP_BAR = 1.03
+
+
+def load_fixture(path: Optional[str] = None
+                 ) -> Tuple[Dict[str, Any], np.ndarray, np.ndarray]:
+    """(params, images f32 [N, H, W, 3], labels [N]) from the committed
+    fixture npz."""
+    path = path or DEFAULT_FIXTURE
+    params: Dict[str, Any] = {}
+    with np.load(path, allow_pickle=False) as z:
+        for key in z.files:
+            if not key.startswith("param/"):
+                continue
+            node = params
+            parts = key[len("param/"):].split("/")
+            for part in parts[:-1]:
+                node = node.setdefault(part, {})
+            node[parts[-1]] = z[key]
+        images = z["images"].astype(np.float32) / 127.5 - 1.0
+        labels = z["labels"].astype(np.int64)
+    return params, images, labels
+
+
+def build_variant(arch: str, *, quant: str = "", quant_pallas: bool = False,
+                  dtype_name: str = "bfloat16", **kwargs):
+    """One tile-encoder variant: '' + dtype 'float32' is the oracle,
+    '' + bf16 the production baseline, 'int8'/'fp8_e4m3'(+attn) the
+    quantized tiers."""
+    import jax.numpy as jnp
+
+    import gigapath_tpu.models.tile_encoder  # noqa: F401  (registry entries)
+    from gigapath_tpu.utils.registry import create_model_from_registry
+
+    dtype = None if dtype_name in ("", "float32") else getattr(jnp, dtype_name)
+    return create_model_from_registry(
+        arch, dtype=dtype, quant=quant, quant_pallas=quant_pallas, **kwargs
+    )
+
+
+def encode(model, params, images: np.ndarray, *, jit: bool = True
+           ) -> np.ndarray:
+    """Variant embeddings [N, D] f32 (one jitted forward — the fixture
+    is one batch by construction, so exactly one compile)."""
+    import jax
+    import jax.numpy as jnp
+
+    def fwd(p, x):
+        return model.apply({"params": p}, x)
+
+    fn = jax.jit(fwd) if jit else fwd
+    return np.asarray(fn(params, jnp.asarray(images)), np.float32)
+
+
+def mean_cosine(a: np.ndarray, b: np.ndarray) -> float:
+    """Mean per-row cosine similarity."""
+    a = a / np.maximum(np.linalg.norm(a, axis=-1, keepdims=True), 1e-12)
+    b = b / np.maximum(np.linalg.norm(b, axis=-1, keepdims=True), 1e-12)
+    return float(np.mean(np.sum(a * b, axis=-1)))
+
+
+def fit_probe(embeds: np.ndarray, labels: np.ndarray, *,
+              iters: int = 400, lr: float = 0.02, seed: int = 42) -> float:
+    """The PCam-recipe linear probe on frozen embeddings, scaled down
+    to the fixture: full-batch SGD at the run_pcam.py learning rate,
+    deterministic even/odd train/eval split; returns held-out accuracy
+    in [0, 1]."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from gigapath_tpu.linear_probe.main import init_linear_probe
+
+    # deterministic class-balanced split: indices 0,1 of every 4 train,
+    # 2,3 eval (the fixture's labels alternate, so a plain even/odd
+    # split would put one whole class in each half)
+    idx = np.arange(len(labels))
+    train = idx % 4 < 2
+    train_x, train_y = embeds[train], labels[train]
+    test_x, test_y = embeds[~train], labels[~train]
+    n_classes = int(labels.max()) + 1
+    params = init_linear_probe(embeds.shape[-1], n_classes, seed)
+    tx = optax.sgd(lr)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        def loss_fn(p):
+            logits = x @ p["kernel"] + p["bias"]
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y
+            ).mean()
+
+        grads = jax.grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    x = jnp.asarray(train_x)
+    y = jnp.asarray(train_y)
+    for _ in range(iters):
+        params, opt_state = step(params, opt_state, x, y)
+    logits = test_x @ np.asarray(params["kernel"]) + np.asarray(params["bias"])
+    return float((logits.argmax(-1) == test_y).mean())
+
+
+def parity_report(
+    params: Dict[str, Any], images: np.ndarray, labels: np.ndarray, *,
+    arch: str = FIXTURE_ARCH,
+    variants: Sequence[str] = ("bf16", "int8"),
+    quant_pallas: bool = False,
+) -> Dict[str, Any]:
+    """Per-variant drift vs the f32 oracle + probe deltas.
+
+    Variant names: ``bf16`` (production baseline, no quant), ``int8``,
+    ``fp8_e4m3``, and their ``+attn`` riders. The f32 oracle is always
+    computed (it is the reference, not a variant)."""
+    oracle = encode(build_variant(arch, dtype_name="float32"), params, images)
+    oracle_acc = fit_probe(oracle, labels)
+    report: Dict[str, Any] = {
+        "oracle": {"probe_acc": oracle_acc},
+        "variants": {},
+    }
+    for name in variants:
+        quant = "" if name == "bf16" else name
+        model = build_variant(
+            arch, quant=quant, quant_pallas=quant_pallas,
+            dtype_name="bfloat16",
+        )
+        embeds = encode(model, params, images)
+        acc = fit_probe(embeds, labels)
+        report["variants"][name] = {
+            "cosine": round(mean_cosine(embeds, oracle), 6),
+            "probe_acc": round(acc, 4),
+            "probe_delta_pt": round((acc - oracle_acc) * 100.0, 3),
+        }
+    return report
+
+
+def decision_table(report: Dict[str, Any],
+                   timings: Optional[Dict[str, float]] = None,
+                   *, candidate: str = "int8",
+                   baseline: str = "bf16") -> Dict[str, Any]:
+    """The ``adopt_quant_tile`` decision row (ab_dilated shape):
+    parity gates always, speed gate only when measured."""
+    cand = report["variants"].get(candidate, {})
+    cosine = float(cand.get("cosine", 0.0))
+    delta = float(cand.get("probe_delta_pt", 100.0))
+    parity_ok = cosine >= COSINE_BAR and abs(delta) <= PROBE_DELTA_BAR_PT
+    decision: Dict[str, Any] = {
+        "candidate": candidate,
+        "cosine": cosine,
+        "cosine_drift": round(1.0 - cosine, 6),
+        "probe_delta_pt": delta,
+        "parity_ok": bool(parity_ok),
+    }
+    speedup_ok = None
+    if timings and candidate in timings and baseline in timings:
+        base_s = timings[baseline]
+        cand_s = timings[candidate]
+        decision[f"{baseline}_ms"] = round(base_s * 1e3, 3)
+        decision[f"{candidate}_ms"] = round(cand_s * 1e3, 3)
+        decision[f"{candidate}_over_{baseline}"] = round(cand_s / base_s, 4)
+        speedup_ok = cand_s <= base_s / SPEEDUP_BAR
+        decision["speedup_ok"] = bool(speedup_ok)
+    decision["adopt_quant_tile"] = bool(parity_ok and speedup_ok)
+    return decision

@@ -1,0 +1,164 @@
+"""Quantized-weight containers and the ONE sanctioned quantize/dequantize
+helper set.
+
+Low-precision storage in this repo flows through exactly this module:
+gigalint GL016 flags any raw ``astype``/``asarray`` cast to ``int8`` or a
+``float8_*`` dtype in library code outside the path-sanctioned ``quant/``
+package, so every quantization decision — scale granularity, clipping,
+the f32 dequant contract — stays auditable in one place, the same
+discipline the boundary channels (GL013) and the TCP transport (GL015)
+follow for their domains.
+
+Two weight formats (PAPERS.md [5], [6] — what this repo takes):
+
+- **int8 per-channel absmax** (LLM.int8(), Dettmers et al. 2022): each
+  output channel's absolute maximum maps to 127, symmetric, no zero
+  point. The repo takes the per-channel (vector-wise) scale granularity
+  and the observation that weight matrices quantize benignly at 8 bits;
+  the outlier-decomposition half of that paper targets *activation*
+  outliers in 100B+ LMs and is not needed at ViT-G weight statistics.
+- **fp8-e4m3 per-channel** (FP8 Formats, Micikevicius et al. 2022): the
+  same absmax scale mapped to the e4m3 max normal (448), trading int8's
+  uniform grid for floating-point's relative precision. The repo takes
+  e4m3 as the forward/weight format (e5m2 is a gradient format; nothing
+  here quantizes gradients).
+
+The contract every consumer relies on:
+
+- ``QTensor(data, scale)`` — ``data`` in the low-precision dtype,
+  ``scale`` f32 broadcastable against it (per-OUTPUT-channel:
+  ``[1, ..., C]`` for a ``[..., C]`` kernel);
+- ``dequantize(qt)`` returns **f32** (never bf16 — double rounding
+  through bf16 would break the round-trip pin in tests/test_quant.py);
+- ``quantize_per_channel(dequantize(qt), mode) == qt`` bit-exactly (the
+  converter's idempotence guarantee: re-quantizing a dequantized
+  checkpoint can never drift).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+QINT8 = "int8"
+QFP8 = "fp8_e4m3"
+QUANT_MODES: Tuple[str, ...] = (QINT8, QFP8)
+
+_INT8_MAX = 127.0
+_FP8_E4M3_MAX = 448.0  # e4m3 max normal (FP8 Formats table 1)
+
+
+def fp8_dtype():
+    """The fp8-e4m3 jnp dtype, or None when this jax build lacks it
+    (callers gate the fp8 mode on availability instead of crashing)."""
+    return getattr(jnp, "float8_e4m3fn", None)
+
+
+def normalize_mode(mode: str) -> str:
+    """One spelling per mode: '', '0', 'false', 'no' -> '' (off);
+    '1'/'true'/'yes'/'int8' -> int8; 'fp8'/'fp8_e4m3'/'e4m3' -> fp8.
+    A ``+attn`` suffix (quantized attention logits on top of quantized
+    weights) passes through. Unknown spellings raise — a typo'd quant
+    mode must never silently serve the f32 path."""
+    raw = (mode or "").strip().lower()
+    base, plus, suffix = raw.partition("+")
+    if suffix not in ("", "attn"):
+        raise ValueError(f"unknown quant suffix '+{suffix}' in '{mode}'")
+    aliases = {
+        "": "", "0": "", "false": "", "no": "",
+        "1": QINT8, "true": QINT8, "yes": QINT8, "int8": QINT8,
+        "fp8": QFP8, "fp8_e4m3": QFP8, "e4m3": QFP8, "float8_e4m3": QFP8,
+    }
+    if base not in aliases:
+        raise ValueError(
+            f"unknown quant mode '{mode}' (modes: {QUANT_MODES}, "
+            "optionally '+attn')"
+        )
+    base = aliases[base]
+    return f"{base}+attn" if (base and suffix) else base
+
+
+def base_mode(mode: str) -> str:
+    """'int8+attn' -> 'int8' (the weight format without the attn rider)."""
+    return normalize_mode(mode).partition("+")[0]
+
+
+def quant_attn(mode: str) -> bool:
+    """True when the mode quantizes attention logits too ('+attn')."""
+    return normalize_mode(mode).endswith("+attn")
+
+
+class QTensor(NamedTuple):
+    """A quantized weight: low-precision ``data`` + f32 ``scale``
+    broadcastable against it. A NamedTuple so it is a pytree — QTensors
+    ride through jit/vjp as two ordinary leaves."""
+
+    data: jnp.ndarray
+    scale: jnp.ndarray
+
+    @property
+    def mode(self) -> str:
+        return QINT8 if self.data.dtype == jnp.int8 else QFP8
+
+
+def _absmax_scale(w: jnp.ndarray, qmax: float, axis: int) -> jnp.ndarray:
+    """Per-channel absmax / qmax, keepdims (broadcastable), f32; an
+    all-zero channel gets scale 1 so dequant stays exact zeros."""
+    w32 = jnp.asarray(w, jnp.float32)
+    reduce_axes = tuple(a for a in range(w32.ndim) if a != axis % w32.ndim)
+    absmax = jnp.max(jnp.abs(w32), axis=reduce_axes, keepdims=True)
+    return jnp.where(absmax > 0, absmax / qmax, 1.0).astype(jnp.float32)
+
+
+def quantize_per_channel(w, mode: str = QINT8, *, axis: int = -1) -> QTensor:
+    """The sanctioned quantizer: symmetric per-channel absmax along
+    ``axis`` (the OUTPUT channel of a Dense kernel — scales then fold
+    into the matmul epilogue as one row-broadcast multiply)."""
+    mode = base_mode(mode)
+    w32 = jnp.asarray(w, jnp.float32)
+    if mode == QINT8:
+        scale = _absmax_scale(w32, _INT8_MAX, axis)
+        q = jnp.clip(jnp.round(w32 / scale), -_INT8_MAX, _INT8_MAX)
+        return QTensor(q.astype(jnp.int8), scale)
+    if mode == QFP8:
+        f8 = fp8_dtype()
+        if f8 is None:
+            raise NotImplementedError(
+                "this jax build has no float8_e4m3fn dtype; use the int8 "
+                "mode (GIGAPATH_QUANT_TILE=int8)"
+            )
+        scale = _absmax_scale(w32, _FP8_E4M3_MAX, axis)
+        return QTensor((w32 / scale).astype(f8), scale)
+    raise ValueError(f"unknown quant mode '{mode}' (modes: {QUANT_MODES})")
+
+
+def dequantize(qt: QTensor) -> jnp.ndarray:
+    """The f32 dequant contract: ``data * scale`` in f32, always."""
+    return qt.data.astype(jnp.float32) * qt.scale
+
+
+def quantize_dynamic(x: jnp.ndarray, *, axis: int = -1) -> QTensor:
+    """Dynamic (in-graph) int8 activation quantization for the '+attn'
+    tier: absmax over every axis EXCEPT the kept ``axis`` prefix is
+    wrong for activations — here scales keep all leading axes and
+    reduce only the trailing (L, D) block, i.e. one scale per (batch,
+    head). ``x`` is [B, H, L, D]; returns data [B, H, L, D] int8 with
+    scale [B, H, 1, 1]."""
+    x32 = jnp.asarray(x, jnp.float32)
+    absmax = jnp.max(jnp.abs(x32), axis=(-2, -1), keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / _INT8_MAX, 1.0)
+    q = jnp.clip(jnp.round(x32 / scale), -_INT8_MAX, _INT8_MAX)
+    return QTensor(q.astype(jnp.int8), scale.astype(jnp.float32))
+
+
+def bf16_round_trip(embeds) -> np.ndarray:
+    """The ONE TPU-shape embedding quantization: round to bf16, return
+    f32 numpy. The dense slide entry casts tile embeddings to bf16
+    before apply (pipeline.py); every OTHER producer of tile embeddings
+    — the streaming entry's per-chunk feed, the dist tile worker's real
+    encoder — must round through this helper so all paths feed the
+    slide encoder bit-identical inputs (pinned by tests/test_quant.py).
+    """
+    return np.asarray(jnp.asarray(embeds, jnp.bfloat16).astype(jnp.float32))

@@ -8,9 +8,11 @@ restart loads the persisted artifact instead of retracing (ROADMAP item
 1. **in-memory**: the executable already built this process;
 2. **artifact**: a persisted ``jax.experimental.serialize_executable``
    payload under ``artifact_dir``, keyed by an environment fingerprint
-   (jax version, backend, input signature, caller identity) so a stale
-   artifact from another jax build or model shape can never be executed
-   — any mismatch or load failure falls through to a fresh compile;
+   (jax version, backend, input signature, caller identity, and the
+   kernel-tier ``PipelineFlags`` snapshot — quant tier included) so a
+   stale artifact from another jax build, model shape or kernel tier
+   can never be executed — any mismatch or load failure falls through
+   to a fresh compile;
 3. **compile**: ``jit(forward, donate_argnums=(1, 2)).lower(...).compile()``
    over ``jax.ShapeDtypeStruct`` inputs (no dummy arrays are ever
    materialized), then persisted best-effort for the next process.
@@ -90,6 +92,18 @@ class AotExecutableCache:
             forward, donate_argnums=(1, 2) if donate else ()
         )
         self._param_sig = _param_signature(params)
+        # the FULL kernel-tier flag snapshot participates in the
+        # artifact identity: a forward built under one tier (quant,
+        # ring, stream fusion, ...) must never be satisfied by a
+        # persisted executable of another. The code signature usually
+        # catches this too, but an untraceable forward degrades to
+        # shapes-only — the flag fingerprint is the belt under that
+        # suspender, and a NamedTuple repr covers every current and
+        # future field without hand-picking. One host-side snapshot at
+        # construction, the PipelineFlags convention.
+        from gigapath_tpu.ops.pallas_dilated import snapshot_flags
+
+        self._flags_sig = repr(snapshot_flags())
         self._code_sig: Optional[str] = None  # lazy; see _code_signature
         self._executables: Dict[Tuple[int, int], Callable] = {}
         # provenance per key: "compiled" | "artifact"
@@ -165,7 +179,7 @@ class AotExecutableCache:
         for part in (
             str(ARTIFACT_SCHEMA_VERSION), jax.__version__,
             jax.default_backend(), self.identity, self._param_sig,
-            self._code_signature(),
+            self._code_signature(), self._flags_sig,
             f"{capacity}x{bucket_n}x{self.feature_dim}",
         ):
             h.update(part.encode())

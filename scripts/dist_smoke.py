@@ -5,11 +5,11 @@ cross-stage boundary (ISSUE 11's acceptance driver).
     python scripts/dist_smoke.py
     python scripts/dist_smoke.py --json DIST_SMOKE.json
 
-Seven checks, each a hard assertion (exit 1 + structured JSON on
+Eight checks, each a hard assertion (exit 1 + structured JSON on
 violation, bench.py-style; progress rides stderr). Every check runs a
 REAL fleet: tile-worker OS processes + the slide-stage consumer, joined
 by the boundary channel (``gigapath_tpu/dist/``; directory transport
-for checks 1-5, the TCP transport for 6-7):
+for checks 1-5 and 8, the TCP transport for 6-7):
 
 1. **clean_parity**: two workers, no chaos — the assembled tile
    sequence and the slide forward match a single-process oracle
@@ -50,6 +50,13 @@ for checks 1-5, the TCP transport for 6-7):
    watermark (``recovery action="consumer_resume"``), receives only
    post-watermark chunks, and the embedding is BIT-exact vs the clean
    streaming run — zero unexpected retraces on the restarted leg.
+8. **quant_encoder** (ROADMAP item 3 meets item 4): the plan's
+   ``encoder: "quant_vit"`` puts the REAL quantized ViT tile encoder
+   (``gigapath_tpu/quant/``, int8 quantized-Dense tier, params placed
+   per the ``tile_encoder`` stagemesh entry) behind the workers'
+   ``encode`` seam; the fleet-assembled rows match an in-process
+   encode BIT-exactly, and a ``kill_worker@1`` run is BIT-exact vs the
+   clean quant run.
 
 The JSON line carries the ``dist|smoke`` trend keys
 (``chunks_per_sec``, ``clean_wall_s``, ``recover_extra_s``,
@@ -132,7 +139,7 @@ def oracle(plan: dict):
 def check_clean_parity(root: str, plan: dict) -> dict:
     from gigapath_tpu.dist.pipeline import run_disaggregated
 
-    echo("1/7 clean_parity: two workers, no chaos")
+    echo("1/8 clean_parity: two workers, no chaos")
     t0 = time.monotonic()
     result = run_disaggregated(os.path.join(root, "clean"), plan=plan,
                                deadline_s=90)
@@ -150,7 +157,7 @@ def check_clean_parity(root: str, plan: dict) -> dict:
     assert all(rc == 0 for rc in result["worker_exit_codes"].values()), (
         result["worker_exit_codes"]
     )
-    echo(f"1/7 ok: bit-exact vs oracle, {stats['delivered']} chunks in "
+    echo(f"1/8 ok: bit-exact vs oracle, {stats['delivered']} chunks in "
          f"{wall:.1f}s")
     return {"wall_s": round(wall, 3), "chunks": stats["delivered"],
             "embedding": result["embedding"]}
@@ -159,7 +166,7 @@ def check_clean_parity(root: str, plan: dict) -> dict:
 def check_kill_recover(root: str, plan: dict, clean_embedding) -> dict:
     from gigapath_tpu.dist.pipeline import run_disaggregated
 
-    echo("2/7 kill_recover: SIGKILL w0 after 1 chunk, mid-slide")
+    echo("2/8 kill_recover: SIGKILL w0 after 1 chunk, mid-slide")
     t0 = time.monotonic()
     result = run_disaggregated(
         os.path.join(root, "kill"), plan=plan,
@@ -184,7 +191,7 @@ def check_kill_recover(root: str, plan: dict, clean_embedding) -> dict:
     unexpected = [ev for ev in events_of(events, "compile")
                   if ev.get("unexpected")]
     assert not unexpected, f"recovery paid unexpected retraces: {unexpected}"
-    echo(f"2/7 ok: lost w0, reassigned "
+    echo(f"2/8 ok: lost w0, reassigned "
          f"{reassigns[0].get('chunks')} chunk(s), bit-exact in {wall:.1f}s")
     return {"wall_s": round(wall, 3),
             "reassigned_chunks": reassigns[0].get("chunks")}
@@ -196,7 +203,7 @@ def check_slow_worker_skew(root: str, plan: dict, slow_s: float) -> dict:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import obs_report
 
-    echo(f"3/7 slow_worker_skew: w1 sleeps {slow_s}s per chunk")
+    echo(f"3/8 slow_worker_skew: w1 sleeps {slow_s}s per chunk")
     run_id = "dist-smoke-slow"
     out = os.path.join(root, "slow")
     result = run_disaggregated(
@@ -222,7 +229,7 @@ def check_slow_worker_skew(root: str, plan: dict, slow_s: float) -> dict:
     text = buf.getvalue()
     assert "per-rank skew (span 'dist.chunk')" in text, text
     assert "straggler: rank 1" in text, text
-    echo(f"3/7 ok: straggler rank 1 visible (medians {med})")
+    echo(f"3/8 ok: straggler rank 1 visible (medians {med})")
     return {"median_rank0_s": round(med[0], 4),
             "median_rank1_s": round(med[1], 4)}
 
@@ -230,7 +237,7 @@ def check_slow_worker_skew(root: str, plan: dict, slow_s: float) -> dict:
 def check_drop_dup_dedup(root: str, plan: dict, clean_embedding) -> dict:
     from gigapath_tpu.dist.pipeline import run_disaggregated
 
-    echo("4/7 drop_dup_dedup: drop chunk 0's first send, dup chunk 2")
+    echo("4/8 drop_dup_dedup: drop chunk 0's first send, dup chunk 2")
     result = run_disaggregated(
         os.path.join(root, "dropdup"), plan=plan,
         worker_chaos={"w0": "drop_chunk@0,dup_chunk@2"}, deadline_s=90,
@@ -250,7 +257,7 @@ def check_drop_dup_dedup(root: str, plan: dict, clean_embedding) -> dict:
         f"the dropped chunk was not retransmitted: {worker_ends}"
     )
     assert worker_ends[0].get("dropped", 0) >= 1, worker_ends
-    echo(f"4/7 ok: {stats['duplicates']} dup(s) deduped, "
+    echo(f"4/8 ok: {stats['duplicates']} dup(s) deduped, "
          f"{worker_ends[0]['retransmits']} retransmit(s) healed the drop")
     return {"duplicates": stats["duplicates"],
             "retransmits": worker_ends[0]["retransmits"]}
@@ -264,7 +271,7 @@ def check_streaming_prefill(root: str, plan: dict, clean_embedding) -> dict:
     frontier absorbs reassignment + out-of-order delivery)."""
     from gigapath_tpu.dist.pipeline import run_disaggregated
 
-    echo("5/7 streaming_prefill: consumer folds chunks on arrival")
+    echo("5/8 streaming_prefill: consumer folds chunks on arrival")
     stream_plan = dict(plan, chunked_prefill=True)
     t0 = time.monotonic()
     result = run_disaggregated(os.path.join(root, "stream"),
@@ -306,7 +313,7 @@ def check_streaming_prefill(root: str, plan: dict, clean_embedding) -> dict:
             f"{leg}: streaming stages paid unexpected retraces: "
             f"{unexpected}"
         )
-    echo(f"5/7 ok: fold-on-arrival parity + BIT-exact kill-recover in "
+    echo(f"5/8 ok: fold-on-arrival parity + BIT-exact kill-recover in "
          f"{wall:.1f}s")
     return {"wall_s": round(wall, 3),
             "max_err_vs_dense": float(
@@ -324,7 +331,7 @@ def check_tcp_boundary(root: str, plan: dict, clean_embedding) -> dict:
     zero unexpected retraces."""
     from gigapath_tpu.dist.pipeline import run_disaggregated
 
-    echo("6/7 tcp_boundary: fleet over TCP, then drop_conn+corrupt_frame")
+    echo("6/8 tcp_boundary: fleet over TCP, then drop_conn+corrupt_frame")
     tcp_plan = dict(plan, transport="tcp")
     t0 = time.monotonic()
     result = run_disaggregated(os.path.join(root, "tcp"), plan=tcp_plan,
@@ -360,7 +367,7 @@ def check_tcp_boundary(root: str, plan: dict, clean_embedding) -> dict:
         f"TCP chaos recovery paid unexpected retraces: {unexpected}"
     )
     reconnect_s = round(max(chaos_wall - tcp_wall, 0.0), 3)
-    echo(f"6/7 ok: TCP bit-exact clean+chaos, "
+    echo(f"6/8 ok: TCP bit-exact clean+chaos, "
          f"{chaos['stats']['frame_errors']} frame error(s) healed, "
          f"reconnect_s={reconnect_s}")
     return {"wall_s": round(tcp_wall, 3),
@@ -382,7 +389,7 @@ def check_consumer_kill_recover(root: str, plan: dict,
     unexpected retraces on the restarted leg."""
     from gigapath_tpu.dist.pipeline import run_disaggregated
 
-    echo(f"7/7 consumer_kill_recover: SIGKILL consumer after "
+    echo(f"7/8 consumer_kill_recover: SIGKILL consumer after "
          f"{kill_after} chunks, restart from checkpoint")
     ckpt_plan = dict(plan, chunked_prefill=True, transport="tcp",
                      consumer_ckpt_every=2, lease_s=max(plan["lease_s"], 2.0))
@@ -415,13 +422,55 @@ def check_consumer_kill_recover(root: str, plan: dict,
         f"consumer resume paid unexpected retraces: {unexpected}"
     )
     consumer_recover_s = round(max(wall - stream_wall, 0.0), 3)
-    echo(f"7/7 ok: consumer SIGKILLed at {kill_after}, resumed from "
+    echo(f"7/8 ok: consumer SIGKILLed at {kill_after}, resumed from "
          f"watermark of {resumes[0].get('chunks')} chunk(s), bit-exact "
          f"(consumer_recover_s={consumer_recover_s})")
     return {"wall_s": round(wall, 3),
             "watermark_chunks": resumes[0].get("chunks"),
             "consumer_exit_codes": exits,
             "consumer_recover_s": consumer_recover_s}
+
+
+def check_quant_encoder(root: str, plan: dict) -> dict:
+    """Check 8: the REAL quantized tile encoder behind the ``encode``
+    seam (ROADMAP item 3 meeting item 4) — the plan's
+    ``encoder: "quant_vit"`` makes every worker build the registry ViT
+    with the int8 quantized-Dense tier (params seeded from the plan,
+    placed per the ``tile_encoder`` stagemesh entry). Asserted: an
+    in-process encode of the first chunk matches the fleet-assembled
+    rows BIT-exactly (the seam really ran the quantized encoder, and it
+    is deterministic across processes), and a kill-recover run is
+    BIT-exact vs the clean quant run."""
+    from gigapath_tpu.dist.pipeline import run_disaggregated
+    from gigapath_tpu.dist.worker import make_encoder
+
+    echo("8/8 quant_encoder: REAL quantized ViT behind the encode seam")
+    qplan = dict(plan, encoder="quant_vit", quant="int8")
+    t0 = time.monotonic()
+    clean = run_disaggregated(os.path.join(root, "quant"), plan=qplan,
+                              deadline_s=150)
+    wall = time.monotonic() - t0
+    chunk = int(qplan["chunk_tiles"])
+    embeds, _ = make_encoder(qplan)(0, chunk)
+    assert np.array_equal(clean["assembled"][:chunk], embeds), (
+        "fleet-assembled rows diverge from the in-process quantized "
+        "encoder — the seam did not run the real encoder"
+    )
+    kill = run_disaggregated(
+        os.path.join(root, "quant-kill"), plan=qplan,
+        worker_chaos={"w0": "kill_worker@1"}, deadline_s=150,
+    )
+    assert kill["worker_exit_codes"]["w0"] == -9, kill["worker_exit_codes"]
+    assert kill["lost"] == ["w0"] and kill["reassignments"] >= 1, (
+        kill["lost"], kill["reassignments"]
+    )
+    assert np.array_equal(kill["embedding"], clean["embedding"]), (
+        "quant-encoder kill-recover is NOT bit-exact vs the clean run"
+    )
+    echo(f"8/8 ok: quantized encoder behind the seam, BIT-exact "
+         f"kill-recover in {wall:.1f}s")
+    return {"wall_s": round(wall, 3),
+            "kill_reassignments": kill["reassignments"]}
 
 
 def run(args) -> dict:
@@ -451,6 +500,7 @@ def run(args) -> dict:
     checks["tcp_boundary"] = check_tcp_boundary(root, plan, clean_embedding)
     checks["consumer_kill_recover"] = check_consumer_kill_recover(
         root, plan, stream_embedding, stream["wall_s"])
+    checks["quant_encoder"] = check_quant_encoder(root, plan)
     clean_wall = checks["clean_parity"]["wall_s"]
     return {
         "metric": "dist_smoke",

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Generate tests/fixtures/quant_tile_fixture.npz — the committed
+fixture weights + labeled tiles behind the quant parity harness
+(gigapath_tpu/quant/parity.py, scripts/ab_tile.py, tests/test_quant.py).
+
+Contents (all deterministic from the seeds below — the file is
+committed so the parity bars in tier-1 are pinned to exact bytes, but
+this script regenerates it byte-identically):
+
+- ``param/<flax path>``: weights for the ``vit_tile_enc_test`` arch
+  (img 32 / patch 16 / embed 32 / depth 2 / heads 4 / SwiGLU),
+  generated as a timm-NAMED state dict (realistic scales: LayerScale
+  gammas ~0.05, not the 1e-5 init that would make the blocks
+  near-identity and the parity bars trivially green) and run through
+  the real ``convert_timm_state_dict`` path — so the fixture also
+  exercises the converter naming;
+- ``images``: 256 int8 tiles [32, 32, 3] — noise plus a class-dependent
+  low-rank pattern, so the downstream linear probe has real signal and
+  a 0.5 pt accuracy delta is a meaningful bar;
+- ``labels``: the 2-class labels.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gigapath_tpu.models.tile_encoder import convert_timm_state_dict  # noqa: E402
+
+CFG = dict(img_size=32, patch_size=16, embed_dim=32, depth=2, num_heads=4,
+           mlp_ratio=4.0, swiglu=True)
+N_TILES = 512
+WEIGHT_SEED = 7
+TILE_SEED = 11
+
+
+def make_timm_numpy_state_dict(cfg, seed):
+    """Random timm-NAMED state dict (numpy twin of the torch generator
+    in tests/test_tile_encoder.py)."""
+    rng = np.random.default_rng(seed)
+    D, depth, p = cfg["embed_dim"], cfg["depth"], cfg["patch_size"]
+    n_tok = (cfg["img_size"] // p) ** 2 + 1
+    hidden = int(D * cfg["mlp_ratio"])
+    fc2_in = hidden // 2 if cfg["swiglu"] else hidden
+
+    def t(*shape):
+        return (rng.standard_normal(shape) * 0.05).astype(np.float32)
+
+    sd = {
+        "cls_token": t(1, 1, D),
+        "pos_embed": t(1, n_tok, D),
+        "patch_embed.proj.weight": t(D, 3, p, p),
+        "patch_embed.proj.bias": t(D),
+        "norm.weight": 1.0 + t(D),
+        "norm.bias": t(D),
+    }
+    for i in range(depth):
+        b = f"blocks.{i}."
+        sd.update({
+            b + "norm1.weight": 1.0 + t(D),
+            b + "norm1.bias": t(D),
+            b + "attn.qkv.weight": t(3 * D, D),
+            b + "attn.qkv.bias": t(3 * D),
+            b + "attn.proj.weight": t(D, D),
+            b + "attn.proj.bias": t(D),
+            b + "ls1.gamma": t(D),
+            b + "norm2.weight": 1.0 + t(D),
+            b + "norm2.bias": t(D),
+            b + "mlp.fc1.weight": t(hidden, D),
+            b + "mlp.fc1.bias": t(hidden),
+            b + "mlp.fc2.weight": t(D, fc2_in),
+            b + "mlp.fc2.bias": t(D),
+            b + "ls2.gamma": t(D),
+        })
+    return sd
+
+
+def make_labeled_tiles(cfg, n, seed):
+    rng = np.random.default_rng(seed)
+    img = cfg["img_size"]
+    labels = (np.arange(n) % 2).astype(np.int64)
+    pattern = rng.standard_normal((img, img, 3)).astype(np.float32)
+    tiles = rng.standard_normal((n, img, img, 3)).astype(np.float32) * 25.0
+    tiles += np.where(labels, 1.0, -1.0)[:, None, None, None] * pattern * 32.0
+    return np.clip(tiles, -127, 127).astype(np.int8), labels
+
+
+def main():
+    sd = make_timm_numpy_state_dict(CFG, WEIGHT_SEED)
+    converted = convert_timm_state_dict(sd)
+    images, labels = make_labeled_tiles(CFG, N_TILES, TILE_SEED)
+    out = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tests", "fixtures", "quant_tile_fixture.npz",
+    )
+    arrays = {
+        "param/" + "/".join(path): arr for path, arr in converted.items()
+    }
+    arrays["images"] = images
+    arrays["labels"] = labels
+    with open(out, "wb") as fh:
+        np.savez(fh, **arrays)
+    n_params = sum(int(np.prod(a.shape)) for a in converted.values())
+    print(f"{len(converted)} tensors, {n_params:,} params, "
+          f"{len(images)} tiles -> {out}")
+
+
+if __name__ == "__main__":
+    main()

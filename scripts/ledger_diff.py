@@ -130,6 +130,14 @@ def compare(base_doc: dict, cand_doc: dict, *,
         elif bj and cj:
             pairs = [("jaxpr.eqns_total",
                       bj.get("eqns_total", 0), cj.get("eqns_total", 0))]
+            if "quant" in bj:
+                # the quantized-tier op-mix pin (obs/ledger.py): an
+                # INCREASE in low-precision eqns on a key whose tier
+                # did not change is a mix shift, gated like any other
+                # eqn count (legacy ledgers without the column are not
+                # held to it)
+                pairs.append(("jaxpr.quant",
+                              bj.get("quant", 0), cj.get("quant", 0)))
             bp = bj.get("primitives") or {}
             cp = cj.get("primitives") or {}
             for prim in sorted(set(bp) | set(cp)):

@@ -32,7 +32,11 @@
 #   - the gigalint GL015 selftest: the seeded raw-socket fixture must
 #     fire (socket/socketserver outside the sanctioned dist/transport.py,
 #     and blocking recv/accept/connect with no configured deadline —
-#     flagged even inside the sanctioned module).
+#     flagged even inside the sanctioned module);
+#   - the gigalint GL016 selftest: the seeded low-precision-cast fixture
+#     must fire (astype/asarray to int8/float8_* in library code outside
+#     the path-sanctioned quant/ module — quantization goes through
+#     gigapath_tpu/quant/qtensor.py's helper set).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 python scripts/obs_report.py --selftest 1>&2
@@ -103,5 +107,18 @@ if [ "$gl015_rc" -ne 1 ]; then
     exit 1
 fi
 echo "gigalint GL015 selftest OK" 1>&2
+
+# GL016 selftest: the seeded low-precision-cast fixture MUST be found
+# (exit 1 = findings; 0 or 2 mean the rule went blind or crashed)
+set +e
+python -m tools.gigalint --no-waivers --select GL016 \
+    tools/gigalint/selftest/fixture/models/lowprec.py 1>&2
+gl016_rc=$?
+set -e
+if [ "$gl016_rc" -ne 1 ]; then
+    echo "GL016 selftest FAILED: expected findings (rc=1), got rc=$gl016_rc" 1>&2
+    exit 1
+fi
+echo "gigalint GL016 selftest OK" 1>&2
 
 exec python -m tools.gigalint gigapath_tpu scripts tests "$@"

@@ -124,6 +124,10 @@ def cmd_ingest(args) -> int:
             history.fold_prefill(doc, _load_json(args.prefill), args.label,
                                  source=os.path.basename(args.prefill),
                                  force=args.force)
+        if args.tile:
+            history.fold_tile(doc, _load_json(args.tile), args.label,
+                              source=os.path.basename(args.tile),
+                              force=args.force)
         for path in args.ledger or []:
             history.fold_ledger(doc, _load_json(path), args.label,
                                 source=os.path.basename(path),
@@ -382,6 +386,41 @@ def selftest() -> int:
               "regression undetected", file=sys.stderr)
         return 1
 
+    # tile|quant folding: same shared staleness policy (a CPU parity
+    # run = stale with keys), a throughput dip flips the gate, and a
+    # cosine-drift GROWTH (quality regression) flips it too
+    history.fold_tile(
+        serve_doc,
+        {"rc": 0, "parsed": {"backend": "cpu", "int8_tiles_per_sec": 5.0,
+                             "cosine_drift": 1e-5}}, "r01")
+    tile_points = serve_doc["entries"]["tile|quant"]["points"]
+    if not tile_points[0].get("stale") or "cosine_drift" not in \
+            tile_points[0]["metrics"]:
+        print("perf_history selftest FAILED: CPU tile point must be "
+              "stale WITH metric keys", file=sys.stderr)
+        return 1
+    history.fold_tile(
+        serve_doc,
+        {"rc": 0, "parsed": {"backend": "tpu", "bf16_tiles_per_sec": 240.0,
+                             "int8_tiles_per_sec": 400.0,
+                             "cosine_drift": 1e-5}}, "r02")
+    history.fold_tile(
+        serve_doc,
+        {"rc": 0, "parsed": {"backend": "tpu", "bf16_tiles_per_sec": 240.0,
+                             "int8_tiles_per_sec": 250.0,
+                             "cosine_drift": 5e-3}}, "r03")
+    tv = history.trend_verdict(serve_doc)
+    missing_tile = [
+        needle for needle in
+        ("tile|quant: cosine_drift 1e-05", "tile|quant: int8_tiles_per_sec")
+        if not any(needle in line for line in tv["decision"]["regressed"])
+    ]
+    if tv["decision"]["ok"] or missing_tile:
+        print(f"perf_history selftest FAILED: tile|quant regressions "
+              f"undetected: {missing_tile}", file=sys.stderr)
+        render(tv, out=sys.stderr)
+        return 1
+
     # append-only: reusing a label without force must refuse
     try:
         history.fold_bench(
@@ -459,6 +498,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                        help="long_context_smoke --stream snapshot JSON "
                        "-> the prefill|stream trend entry "
                        "(streaming-vs-dense memory decision table)")
+    p_ing.add_argument("--tile", default=None,
+                       help="ab_tile snapshot JSON (scripts/ab_tile.py "
+                       "--json output) -> the tile|quant trend entry "
+                       "(quantized tile tier: throughput + drift)")
     p_ing.add_argument("--ledger", action="append", default=None,
                        help="per-run ledger JSON (repeatable)")
     p_ing.add_argument("--force", action="store_true",

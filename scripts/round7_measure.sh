@@ -88,5 +88,17 @@ tail -3 /tmp/r7_dist.log
 timeout 1200 python scripts/long_context_smoke.py --stream \
   --json PREFILL_SMOKE.json 16384 > /tmp/r7_prefill.log 2>&1
 tail -3 /tmp/r7_prefill.log
+
+# 10. quantized tile tier (ROADMAP item 3): bf16 vs int8 at the
+#     flagship tile shape — tiles/s per variant, drift vs the f32
+#     oracle on the committed fixture weights, and the adopt_quant_tile
+#     decision table (parity gates + the >=3% speed gate that only an
+#     on-chip row can pass). The ingest lands the tile|quant trend
+#     entry next to the others.
+timeout 2400 python scripts/ab_tile.py --variants bf16,int8 \
+  --arch gigapath_tile_enc --batch 128 --pallas \
+  --json AB_TILE.json > /tmp/r7_tile.log 2>&1
+tail -4 /tmp/r7_tile.log
 python scripts/perf_history.py ingest --label r07 --serve SERVE_SMOKE.json \
-  --dist DIST_SMOKE.json --prefill PREFILL_SMOKE.json || true
+  --dist DIST_SMOKE.json --prefill PREFILL_SMOKE.json \
+  --tile AB_TILE.json || true

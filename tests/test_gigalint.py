@@ -51,7 +51,7 @@ def test_fixture_tree_fires_every_rule_class():
     fired = {f.rule for f in result.findings}
     expected = {"GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
                 "GL007", "GL008", "GL009", "GL010", "GL011", "GL012",
-                "GL013", "GL014", "GL015"}
+                "GL013", "GL014", "GL015", "GL016"}
     assert fired >= expected, (
         f"missing rule classes: {sorted(expected - fired)}"
     )
@@ -134,6 +134,13 @@ def test_fixture_specific_findings():
         # ...and the deadline discipline fires EVEN inside the
         # sanctioned transport module
         ("GL015", "transport.py", "recv_without_deadline"),
+        # raw low-precision casts outside the sanctioned quant/ package
+        # (the fixture's own quant/qtensor.py twin is the negative
+        # control, as are the bf16/uint8/int32 casts in lowprec.py)
+        ("GL016", "lowprec.py", "cast_weights_by_hand"),
+        ("GL016", "lowprec.py", "pack_activations"),
+        ("GL016", "lowprec.py", "fp8_by_hand"),
+        ("GL016", "lowprec.py", "stage_buffer"),
     }
     assert expected <= got, f"missing: {sorted(expected - got)}"
 

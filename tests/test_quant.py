@@ -1,0 +1,516 @@
+"""Quantized tile-encoder subsystem (gigapath_tpu/quant/) tests.
+
+The acceptance pins (ISSUE 14):
+
+- int8 parity on the committed fixture weights: embedding cosine >=
+  0.999 vs the f32 oracle, PCam-recipe linear-probe accuracy delta <=
+  0.5 pt, asserted here in tier-1;
+- converter round-trip (quantize -> dequantize within per-channel scale
+  bounds, re-quantization bit-exact) and corrupt-artifact refusal via
+  the manifest;
+- flag-on/flag-off are DISTINCT traced programs (distinct jit keys) and
+  the quant tier pays zero unexpected retraces (watchdog-pinned, the
+  PR-12 discipline);
+- the disaggregated dryrun runs the REAL quantized encoder behind
+  ``dist/worker.py``'s ``encode`` seam with kill-recover bit-exactness;
+- the ledger fingerprint's ``quant`` column pins the tier's op mix;
+- one shared bf16 embedding-quantize helper (the dense/streaming/dist
+  dedup) with a parity pin.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gigapath_tpu.quant import parity
+from gigapath_tpu.quant.convert import (
+    CorruptQuantArtifact,
+    dequantize_params,
+    load_quantized,
+    quantize_params,
+    save_quantized,
+)
+from gigapath_tpu.quant.qtensor import (
+    QTensor,
+    bf16_round_trip,
+    dequantize,
+    normalize_mode,
+    quantize_per_channel,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def fixture_data():
+    return parity.load_fixture()
+
+
+# ---------------------------------------------------------------------------
+# qtensor: the sanctioned helper set
+# ---------------------------------------------------------------------------
+
+class TestQTensor:
+    def test_int8_dequant_within_per_channel_scale_bounds(self):
+        rng = np.random.default_rng(0)
+        w = rng.standard_normal((64, 32)).astype(np.float32)
+        qt = quantize_per_channel(w, "int8")
+        err = np.abs(np.asarray(dequantize(qt)) - w)
+        # rounding to the per-channel grid: error <= scale/2 per element
+        bound = np.broadcast_to(np.asarray(qt.scale) / 2 + 1e-7, w.shape)
+        assert (err <= bound).all()
+
+    @pytest.mark.parametrize("mode", ["int8", "fp8_e4m3"])
+    def test_requantization_is_idempotent(self, mode):
+        """quantize(dequantize(q)) == q bit-exactly — the converter's
+        no-drift guarantee."""
+        rng = np.random.default_rng(1)
+        w = rng.standard_normal((32, 16)).astype(np.float32)
+        qt = quantize_per_channel(w, mode)
+        qt2 = quantize_per_channel(np.asarray(dequantize(qt)), mode)
+        assert np.array_equal(
+            np.asarray(qt.data).view(np.uint8),
+            np.asarray(qt2.data).view(np.uint8),
+        )
+        assert np.array_equal(np.asarray(qt.scale), np.asarray(qt2.scale))
+
+    def test_zero_channel_stays_exact_zero(self):
+        w = np.zeros((8, 4), np.float32)
+        w[:, 1] = 3.0
+        qt = quantize_per_channel(w, "int8")
+        deq = np.asarray(dequantize(qt))
+        assert (deq[:, 0] == 0).all() and np.isfinite(deq).all()
+
+    def test_normalize_mode(self):
+        assert normalize_mode("") == ""
+        assert normalize_mode("1") == "int8"
+        assert normalize_mode("INT8") == "int8"
+        assert normalize_mode("fp8") == "fp8_e4m3"
+        assert normalize_mode("int8+attn") == "int8+attn"
+        with pytest.raises(ValueError):
+            normalize_mode("int4")
+
+    def test_bf16_round_trip_is_the_dense_entry_quantization(self):
+        """The shared helper == the dense slide entry's inline bf16
+        cast (the dedup pin: dense, streaming and dist paths all feed
+        the slide encoder bit-identical inputs)."""
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((16, 8)).astype(np.float32)
+        inline = np.asarray(jnp.asarray(x, jnp.bfloat16).astype(jnp.float32))
+        assert np.array_equal(bf16_round_trip(x), inline)
+        # idempotent: already-rounded values pass through bit-exactly
+        assert np.array_equal(bf16_round_trip(bf16_round_trip(x)),
+                              bf16_round_trip(x))
+
+
+# ---------------------------------------------------------------------------
+# qmatmul / qflash tiers
+# ---------------------------------------------------------------------------
+
+class TestQMatmul:
+    def test_reference_close_to_f32(self):
+        from gigapath_tpu.quant.qmatmul import q_matmul
+
+        rng = np.random.default_rng(3)
+        w = rng.standard_normal((128, 64)).astype(np.float32)
+        x = rng.standard_normal((4, 128)).astype(np.float32)
+        qt = quantize_per_channel(w, "int8")
+        y = np.asarray(q_matmul(jnp.asarray(x), qt))
+        ref = x @ w
+        assert np.abs(y - ref).max() <= 0.02 * np.abs(ref).max()
+
+    def test_pallas_tier_matches_reference(self):
+        from gigapath_tpu.quant.qmatmul import q_matmul_pallas, q_matmul_reference
+
+        rng = np.random.default_rng(4)
+        w = rng.standard_normal((256, 128)).astype(np.float32)
+        x = rng.standard_normal((8, 256)).astype(np.float32)
+        qt = quantize_per_channel(w, "int8")
+        ref = np.asarray(q_matmul_reference(jnp.asarray(x), qt))
+        pal = np.asarray(q_matmul_pallas(jnp.asarray(x), qt, interpret=True))
+        np.testing.assert_allclose(pal, ref, atol=1e-5, rtol=1e-5)
+
+    def test_quant_dense_param_surface_matches_nn_dense(self):
+        """QuantDense declares the exact nn.Dense param names/shapes, so
+        checkpoints and the sharding-rule name lists are oblivious."""
+        from flax import linen as nn
+
+        from gigapath_tpu.quant.qmatmul import QuantDense
+
+        x = jnp.ones((2, 16))
+        dense = nn.Dense(8, name="fc1")
+        qdense = QuantDense(8, mode="int8", name="fc1")
+        p1 = dense.init(jax.random.PRNGKey(0), x)["params"]
+        p2 = qdense.init(jax.random.PRNGKey(0), x)["params"]
+        assert set(p1) == set(p2) == {"kernel", "bias"}
+        assert all(p1[k].shape == p2[k].shape for k in p1)
+        # and an nn.Dense param tree applies straight through
+        out = qdense.apply({"params": p1}, x)
+        assert out.shape == (2, 8)
+
+
+class TestQFlash:
+    def test_reference_close_to_f32_oracle(self):
+        from gigapath_tpu.ops.attention import attention_with_lse
+        from gigapath_tpu.quant.qflash import q_flash_attention_reference
+
+        rng = np.random.default_rng(5)
+        q, k, v = (
+            jnp.asarray(rng.standard_normal((2, 64, 4, 16)), jnp.float32)
+            for _ in range(3)
+        )
+        out_q, lse_q = q_flash_attention_reference(q, k, v)
+        out_f, lse_f = attention_with_lse(q, k, v)
+        assert parity.mean_cosine(
+            np.asarray(out_q).reshape(-1, 16),
+            np.asarray(out_f).reshape(-1, 16),
+        ) >= 0.999
+        np.testing.assert_allclose(
+            np.asarray(lse_q), np.asarray(lse_f), atol=0.05
+        )
+
+    def test_pallas_tier_matches_reference(self):
+        from gigapath_tpu.quant.qflash import (
+            q_flash_attention_pallas,
+            q_flash_attention_reference,
+        )
+
+        rng = np.random.default_rng(6)
+        q, k, v = (
+            jnp.asarray(rng.standard_normal((1, 128, 2, 32)), jnp.float32)
+            for _ in range(3)
+        )
+        out_r, lse_r = q_flash_attention_reference(q, k, v)
+        out_p, lse_p = q_flash_attention_pallas(q, k, v, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out_p), np.asarray(out_r), atol=5e-3
+        )
+        np.testing.assert_allclose(
+            np.asarray(lse_p), np.asarray(lse_r), atol=1e-4
+        )
+
+
+# ---------------------------------------------------------------------------
+# converter + artifact
+# ---------------------------------------------------------------------------
+
+class TestConverter:
+    def test_quantize_params_targets_dense_kernels_only(self, fixture_data):
+        params, _, _ = fixture_data
+        qparams = quantize_params(params, "int8")
+        qkv = qparams["blocks_0"]["attn"]["qkv"]["kernel"]
+        assert isinstance(qkv, QTensor) and qkv.data.dtype == np.int8
+        # conv patch embed (4-D) and biases stay full precision
+        assert not isinstance(
+            qparams["patch_embed"]["proj"]["kernel"], QTensor
+        )
+        assert not isinstance(
+            qparams["blocks_0"]["attn"]["qkv"]["bias"], QTensor
+        )
+
+    @pytest.mark.parametrize("mode", ["int8", "fp8_e4m3"])
+    def test_artifact_roundtrip_bitexact(self, tmp_path, mode, fixture_data):
+        params, _, _ = fixture_data
+        qparams = quantize_params(params, mode)
+        path = save_quantized(
+            str(tmp_path / "artifact"), qparams, meta={"arch": "test"}
+        )
+        loaded, meta = load_quantized(path)
+        assert meta["mode"] == mode and meta["arch"] == "test"
+        assert meta["n_quantized"] > 0
+        flat_a = dict(_walk_pairs(qparams))
+        flat_b = dict(_walk_pairs(loaded))
+        assert set(flat_a) == set(flat_b)
+        for key, leaf in flat_a.items():
+            other = flat_b[key]
+            if isinstance(leaf, QTensor):
+                assert np.array_equal(
+                    np.asarray(leaf.data).view(np.uint8),
+                    np.asarray(other.data).view(np.uint8),
+                )
+                assert np.array_equal(leaf.scale, other.scale)
+            else:
+                assert np.array_equal(leaf, other)
+
+    def test_corrupt_artifact_refused(self, tmp_path, fixture_data):
+        params, _, _ = fixture_data
+        qparams = quantize_params(params, "int8")
+        path = save_quantized(str(tmp_path / "artifact"), qparams)
+        # flip one byte of the array payload: the manifest re-hash must
+        # refuse the load — never silently-wrong scales
+        target = os.path.join(path, "arrays.npz")
+        blob = bytearray(open(target, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        with open(target, "wb") as fh:
+            fh.write(bytes(blob))
+        with pytest.raises(CorruptQuantArtifact):
+            load_quantized(path)
+
+    def test_unexpected_extra_file_refused(self, tmp_path, fixture_data):
+        """An extra file the manifest never hashed is a refused load
+        too (the checkpointer's exact-tree discipline); verify=False is
+        the explicit opt-out."""
+        params, _, _ = fixture_data
+        path = save_quantized(
+            str(tmp_path / "artifact"), quantize_params(params, "int8")
+        )
+        with open(os.path.join(path, "stray.bin"), "wb") as fh:
+            fh.write(b"not in the manifest")
+        with pytest.raises(CorruptQuantArtifact):
+            load_quantized(path)
+        load_quantized(path, verify=False)
+
+    def test_missing_file_refused(self, tmp_path, fixture_data):
+        params, _, _ = fixture_data
+        path = save_quantized(
+            str(tmp_path / "artifact"), quantize_params(params, "int8")
+        )
+        os.remove(os.path.join(path, "meta.json"))
+        with pytest.raises(CorruptQuantArtifact):
+            load_quantized(path)
+
+    def test_create_tile_encoder_loads_artifact(self, tmp_path, fixture_data):
+        from gigapath_tpu.models.tile_encoder import create_tile_encoder
+
+        params, images, _ = fixture_data
+        path = save_quantized(
+            str(tmp_path / "artifact"), quantize_params(params, "int8")
+        )
+        model, loaded = create_tile_encoder(path, "vit_tile_enc_test")
+        ref = parity.encode(model, dequantize_params(
+            quantize_params(params, "int8")), images[:4])
+        got = parity.encode(model, loaded, images[:4])
+        np.testing.assert_array_equal(got, ref)
+
+
+def _walk_pairs(tree, prefix=()):
+    for key in sorted(tree):
+        value = tree[key]
+        if isinstance(value, dict):
+            yield from _walk_pairs(value, prefix + (key,))
+        else:
+            yield "/".join(prefix + (key,)), value
+
+
+# ---------------------------------------------------------------------------
+# the acceptance: parity on the committed fixture weights
+# ---------------------------------------------------------------------------
+
+class TestParityAcceptance:
+    @pytest.fixture(scope="class")
+    def report(self, fixture_data):
+        params, images, labels = fixture_data
+        return parity.parity_report(
+            params, images, labels,
+            variants=("bf16", "int8", "fp8_e4m3", "int8+attn"),
+        )
+
+    def test_int8_cosine_and_probe_delta(self, report):
+        """THE acceptance bars: cosine >= 0.999 vs the f32 oracle and
+        |probe delta| <= 0.5 pt, on CPU, in tier-1."""
+        int8 = report["variants"]["int8"]
+        assert int8["cosine"] >= parity.COSINE_BAR, int8
+        assert abs(int8["probe_delta_pt"]) <= parity.PROBE_DELTA_BAR_PT, int8
+
+    def test_fp8_and_attn_riders_hold_parity(self, report):
+        for name in ("fp8_e4m3", "int8+attn"):
+            var = report["variants"][name]
+            assert var["cosine"] >= parity.COSINE_BAR, (name, var)
+
+    def test_probe_has_signal(self, report):
+        # a probe at chance would make the delta bar vacuous
+        assert report["oracle"]["probe_acc"] >= 0.9
+
+    def test_decision_table_gates(self, report):
+        # parity-only (CPU): never adopts, but parity_ok is visible
+        cpu_row = parity.decision_table(report)
+        assert cpu_row["parity_ok"] is True
+        assert cpu_row["adopt_quant_tile"] is False
+        # with a measured >=3% win: adopts
+        fast = parity.decision_table(
+            report, {"bf16": 0.010, "int8": 0.008})
+        assert fast["adopt_quant_tile"] is True
+        # with a measured loss: refuses
+        slow = parity.decision_table(
+            report, {"bf16": 0.010, "int8": 0.011})
+        assert slow["adopt_quant_tile"] is False and slow["parity_ok"]
+
+
+# ---------------------------------------------------------------------------
+# flag routing, jit keys, retraces, ledger column
+# ---------------------------------------------------------------------------
+
+class TestFlagRouting:
+    def test_snapshot_reads_quant_flags(self, monkeypatch):
+        from gigapath_tpu.ops.pallas_dilated import snapshot_flags
+
+        monkeypatch.delenv("GIGAPATH_QUANT_TILE", raising=False)
+        monkeypatch.delenv("GIGAPATH_QUANT_PALLAS", raising=False)
+        flags = snapshot_flags()
+        assert flags.quant_tile == "" and flags.quant_pallas is False
+        monkeypatch.setenv("GIGAPATH_QUANT_TILE", "int8")
+        monkeypatch.setenv("GIGAPATH_QUANT_PALLAS", "1")
+        flags = snapshot_flags()
+        assert flags.quant_tile == "int8" and flags.quant_pallas is True
+
+    def test_flag_on_off_are_distinct_traced_programs(self, fixture_data):
+        """Quant on/off must land in distinct jit cache entries — the
+        flag changes WHICH program is built (model config), so there is
+        no jit-cache staleness hazard to begin with."""
+        params, images, _ = fixture_data
+        x = jnp.asarray(images[:2])
+        off = parity.build_variant(parity.FIXTURE_ARCH)
+        on = parity.build_variant(parity.FIXTURE_ARCH, quant="int8")
+        jx_off = jax.make_jaxpr(
+            lambda p, x: off.apply({"params": p}, x))(params, x)
+        jx_on = jax.make_jaxpr(
+            lambda p, x: on.apply({"params": p}, x))(params, x)
+        assert str(jx_off) != str(jx_on)
+
+    def test_ledger_quant_column_pins_the_op_mix(self, fixture_data):
+        """quant-on programs must SHOW low-precision eqns; quant-off
+        must show zero — the fingerprint column that makes a silently-
+        f32 'quant' tier a ledger regression."""
+        from gigapath_tpu.obs.ledger import jaxpr_fingerprint
+
+        params, images, _ = fixture_data
+        x = jnp.asarray(images[:2])
+        off = parity.build_variant(parity.FIXTURE_ARCH)
+        on = parity.build_variant(parity.FIXTURE_ARCH, quant="int8")
+        fp_off = jaxpr_fingerprint(
+            lambda p, x: off.apply({"params": p}, x), params, x)
+        fp_on = jaxpr_fingerprint(
+            lambda p, x: on.apply({"params": p}, x), params, x)
+        assert fp_off["quant"] == 0
+        assert fp_on["quant"] > 0
+        # the column is NOT a primitive and never feeds eqns_total
+        assert "quant" not in fp_on["primitives"]
+
+    def test_quant_tier_zero_unexpected_retraces(self, tmp_path,
+                                                 fixture_data):
+        """Watchdog-pinned (the PR-12 seed-sharding discipline): a
+        batch loop over the quant tier compiles ONCE and every later
+        batch hits the same entry."""
+        from gigapath_tpu.obs.runlog import RunLog
+        from gigapath_tpu.obs.watchdog import CompileWatchdog
+
+        params, images, _ = fixture_data
+        model = parity.build_variant(parity.FIXTURE_ARCH, quant="int8")
+
+        @jax.jit
+        def encode(p, x):
+            return model.apply({"params": p}, x)
+
+        log = RunLog(str(tmp_path / "run.jsonl"), driver="t", echo=False)
+        watchdog = CompileWatchdog("quant.encode", log)
+        wrapped = watchdog.wrap(encode)
+        for start in (0, 8, 16):
+            wrapped(params, jnp.asarray(images[start:start + 8]))
+        assert encode._cache_size() == 1, "the quant tier retraced"
+        log.close()
+
+
+# ---------------------------------------------------------------------------
+# dist: the REAL quantized encoder behind the encode seam
+# ---------------------------------------------------------------------------
+
+class TestDistQuantEncoder:
+    def _plan(self, **kw):
+        from gigapath_tpu.dist.pipeline import default_plan
+
+        return default_plan(
+            n_tiles=32, chunk_tiles=8, dim_in=16, dim_out=8,
+            lease_s=1.5, credits=4, retransmit_s=0.5,
+            encoder="quant_vit", quant="int8", **kw,
+        )
+
+    def test_make_encoder_is_deterministic_and_bf16_rounded(self):
+        from gigapath_tpu.dist.worker import make_encoder
+
+        plan = self._plan()
+        a, coords_a = make_encoder(plan)(0, 8)
+        b, coords_b = make_encoder(plan)(0, 8)
+        assert np.array_equal(a, b) and np.array_equal(coords_a, coords_b)
+        assert a.shape == (8, 8) and a.dtype == np.float32
+        # the shared bf16 helper ran: the payload is already on the
+        # bf16 grid (the dense/streaming/dist input-parity contract)
+        assert np.array_equal(a, bf16_round_trip(a))
+
+    def test_make_encoder_handles_ragged_tail_chunk(self):
+        """n_tiles not a chunk multiple: the tail shape is warmed too
+        and encodes fine (the mid-lease-compile hazard class)."""
+        from gigapath_tpu.dist.worker import make_encoder
+
+        plan = self._plan()
+        plan["n_tiles"] = 28  # chunks of 8 -> ragged tail of 4
+        embeds, coords = make_encoder(plan)(24, 28)
+        assert embeds.shape == (4, 8) and coords.shape == (4, 2)
+
+    def test_make_encoder_rejects_unknown_encoder(self):
+        from gigapath_tpu.dist.worker import make_encoder
+
+        plan = self._plan()
+        plan["encoder"] = "quantvit"  # typo must be LOUD, never dryrun
+        with pytest.raises(ValueError):
+            make_encoder(plan)
+
+    def test_dryrun_runs_real_quant_encoder_with_kill_recover(self, tmp_path):
+        """THE dist acceptance: one disaggregated dryrun (two real
+        worker processes) with the quant_vit encoder and a SIGKILLed
+        worker — the full assembled embedding matrix must equal the
+        in-process quantized encoder's output BIT-exactly (the seam ran
+        the real encoder; reassignment re-encoded the dead worker's
+        chunks to identical bits)."""
+        from gigapath_tpu.dist.pipeline import run_disaggregated
+        from gigapath_tpu.dist.worker import make_encoder, plan_chunks
+
+        plan = self._plan()
+        result = run_disaggregated(
+            str(tmp_path / "dryrun"), plan=plan,
+            worker_chaos={"w0": "kill_worker@1"}, deadline_s=150,
+        )
+        assert result["worker_exit_codes"]["w0"] == -9, (
+            result["worker_exit_codes"]
+        )
+        assert result["lost"] == ["w0"] and result["reassignments"] >= 1
+        encode = make_encoder(plan)
+        expected = np.concatenate([
+            encode(start, stop)[0]
+            for _, start, stop in plan_chunks(plan["n_tiles"],
+                                              plan["chunk_tiles"])
+        ])
+        assert np.array_equal(result["assembled"], expected), (
+            "kill-recover assembly diverges from the in-process "
+            "quantized encoder"
+        )
+
+
+# ---------------------------------------------------------------------------
+# perf-history fold
+# ---------------------------------------------------------------------------
+
+class TestTileQuantTrend:
+    def test_fold_tile_stale_with_keys_on_cpu(self):
+        from gigapath_tpu.obs import history
+
+        doc = history.new_history()
+        point = history.fold_tile(
+            doc,
+            {"rc": 0, "parsed": {"backend": "cpu",
+                                 "int8_tiles_per_sec": 10.0,
+                                 "cosine_drift": 1e-5,
+                                 "probe_delta_pt": 0.0}},
+            "r01",
+        )
+        assert point["stale"] and "cosine_drift" in point["metrics"]
+        assert "tile|quant" in doc["entries"]
+
+    def test_direction_rules(self):
+        from gigapath_tpu.obs.history import metric_direction
+
+        assert metric_direction("int8_tiles_per_sec") == "up"
+        assert metric_direction("cosine_drift") == "down"
+        assert metric_direction("probe_delta_pt") == "down"

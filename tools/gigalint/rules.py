@@ -1122,6 +1122,130 @@ def check_socket_hygiene(project: Project) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# GL016 — raw low-precision casts outside the quant module
+# ---------------------------------------------------------------------------
+
+# A raw astype/asarray to int8 or a float8_* dtype in library code is a
+# second, unaudited quantization: no scale contract, no per-channel
+# calibration, no round-trip guarantee — exactly the drift class the
+# quant subsystem's parity harness exists to pin. Low-precision casts
+# are sanctioned only inside the ``quant/`` package (matched by path
+# SEGMENT so the fixture tree can carry its own quant/ twin as a
+# negative control), where qtensor.py's helpers own the scale/clip/
+# dequant contract. uint8 is NOT this rule's business (images are
+# uint8); neither are bf16/f16 casts (activation dtypes, not storage
+# quantization).
+_GL016_CAST_CALLS = frozenset({
+    "asarray", "array", "full", "zeros", "ones", "empty",
+})
+_GL016_ARRAY_MODULES = ("numpy", "jax.numpy")
+_GL016_SANCTIONED_SEGMENT = "quant"
+_GL016_EXEMPT_SEGMENTS = frozenset({"scripts", "tests", "demo"})
+
+
+def _gl016_lowprec_name(node) -> Optional[str]:
+    """Resolve a dtype operand to a low-precision name, or None:
+    attribute/name forms (``jnp.int8``, ``np.float8_e4m3fn``, a bare
+    ``int8`` after a from-import) and string literals ('int8',
+    'float8_e4m3fn')."""
+    if isinstance(node, (ast.Attribute, ast.Name)):
+        name = dotted_name(node)
+        if name:
+            tail = name.rsplit(".", 1)[-1]
+            if tail == "int8" or tail.startswith("float8"):
+                return tail
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        value = node.value.strip().lower()
+        if value == "int8" or value.startswith("float8"):
+            return value
+    return None
+
+
+@register(
+    "GL016",
+    "raw low-precision cast (astype/asarray to int8/float8_*) in library "
+    "code outside the sanctioned quant/ module — quantization must go "
+    "through gigapath_tpu/quant/qtensor.py's helpers, which own the "
+    "scale/clip/dequant contract; scripts, tests and demos exempt",
+)
+def check_lowprec_casts(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.modules.values():
+        segments = mod.path.split("/")[:-1]
+        if mod.is_test_file or any(
+            s in _GL016_EXEMPT_SEGMENTS for s in segments
+        ):
+            continue
+        if _GL016_SANCTIONED_SEGMENT in segments:
+            continue  # the quant package may quantize
+        spans = sorted(
+            (
+                (fn.lineno, getattr(fn.node, "end_lineno", fn.lineno), fn)
+                for fn in mod.functions.values()
+            ),
+            key=lambda t: t[1] - t[0],
+        )
+
+        def symbol_at(lineno: int) -> str:
+            for lo, hi, fn in spans:
+                if lo <= lineno <= hi:
+                    return fn.qualname
+            return "<module>"
+
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            lowprec = None
+            how = ""
+            # .astype on ANY receiver (a dotted name resolves for the
+            # message; an expression receiver — (w / s).astype(int8) —
+            # is the same cast and must not slip through)
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "astype"
+                and node.args
+            ):
+                lowprec = _gl016_lowprec_name(node.args[0])
+                how = f"{dotted_name(node.func) or '<expr>.astype'}()"
+            name = dotted_name(node.func)
+            if lowprec is None and not name:
+                continue
+            if lowprec is None:
+                head, sep, rest = name.partition(".")
+                target = mod.imports.get(head)
+                resolved = (
+                    (f"{target}.{rest}" if sep else target)
+                    if target else name
+                )
+                mod_name, _, func = resolved.rpartition(".")
+                if (
+                    func in _GL016_CAST_CALLS
+                    and mod_name in _GL016_ARRAY_MODULES
+                ):
+                    candidates = [
+                        kw.value for kw in node.keywords if kw.arg == "dtype"
+                    ]
+                    if len(node.args) >= 2:
+                        candidates.append(node.args[1])
+                    for cand in candidates:
+                        lowprec = _gl016_lowprec_name(cand)
+                        if lowprec:
+                            how = f"{resolved}(dtype={lowprec})"
+                            break
+            if lowprec is None:
+                continue
+            findings.append(Finding(
+                "GL016", mod.path, node.lineno, symbol_at(node.lineno),
+                f"raw low-precision cast {how or lowprec} in library "
+                "code: an unaudited quantization with no scale contract "
+                "— route it through gigapath_tpu/quant/qtensor.py "
+                "(quantize_per_channel / dequantize / QTensor), the ONE "
+                "sanctioned quantize/dequantize helper set",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # GL004 — forbidden APIs
 # ---------------------------------------------------------------------------
 

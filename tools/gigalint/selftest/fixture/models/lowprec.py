@@ -1,0 +1,43 @@
+"""Seeded GL016 violations: raw low-precision casts in library code
+outside the sanctioned quant/ package (the fixture's own quant/ twin is
+the negative control). Never 'fix' these — each is load-bearing for a
+self-test."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def cast_weights_by_hand(w):
+    # GL016: hand-rolled int8 quantization with an ad-hoc scale
+    scale = np.abs(w).max() / 127.0
+    return (w / scale).astype(np.int8), scale
+
+
+def pack_activations(x):
+    # GL016: asarray with a low-precision dtype operand
+    return jnp.asarray(x, jnp.int8)
+
+
+def fp8_by_hand(x):
+    # GL016: float8 storage cast outside quant/
+    return x.astype(jnp.float8_e4m3fn)
+
+
+def stage_buffer(n):
+    # GL016: allocation in a low-precision dtype via keyword
+    return np.zeros((n, 128), dtype="int8")
+
+
+def negative_control_float_cast(x):
+    # bf16/f32 casts are activation dtypes, not storage quantization
+    return x.astype(jnp.bfloat16).astype(jnp.float32)
+
+
+def negative_control_uint8_image(img):
+    # images are uint8 — not this rule's business
+    return np.asarray(img, np.uint8)
+
+
+def negative_control_int_cast(idx):
+    # int32/int64 index casts are not quantization either
+    return np.asarray(idx, np.int64).astype(np.int32)

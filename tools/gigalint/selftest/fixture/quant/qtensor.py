@@ -1,0 +1,15 @@
+"""Negative control for GL016: this file's path carries a ``quant``
+segment, so its low-precision casts are sanctioned — the twin of the
+real gigapath_tpu/quant/qtensor.py, exactly like the fixture's
+obs/spans.py (GL010) and dist/transport.py (GL015) twins."""
+
+import jax.numpy as jnp
+
+
+def negative_control_sanctioned_quantize(w, scale):
+    # sanctioned: the quant package owns the scale/clip/dequant contract
+    return jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+
+
+def negative_control_sanctioned_fp8(w, scale):
+    return (w / scale).astype(jnp.float8_e4m3fn)
