@@ -459,6 +459,7 @@ def create_tile_encoder(
     model_arch: str = "gigapath_tile_enc",
     *,
     rng: Optional[jax.Array] = None,
+    flags=None,
     **kwargs,
 ):
     """Build the tile encoder and optionally load a timm torch checkpoint.
@@ -466,8 +467,33 @@ def create_tile_encoder(
     Returns ``(module, params)``; non-strict load with missing/unexpected key
     reporting, matching the slide-encoder factory and the reference's timm
     ``checkpoint_path`` loading (``gigapath/pipeline.py:126``).
+
+    Quant-tier routing rides the plan seam: when the caller passes no
+    explicit ``quant``/``quant_pallas`` kwargs, the tier is resolved
+    ONCE through :func:`gigapath_tpu.plan.resolve_plan` at the arch's
+    canonical image geometry — ``GIGAPATH_QUANT_TILE`` /
+    ``GIGAPATH_QUANT_PALLAS`` where set, the registry's blessed
+    ``tile_encoder.<arch>`` plan where not. An explicit kwarg (or a
+    caller-held ``flags`` snapshot) pins the tier regardless; with no
+    env, no plan and no kwarg the result is the byte-identical f32/bf16
+    program, exactly as before the plan refactor.
     """
     model = create_model_from_registry(model_arch, **kwargs)
+    if "quant" not in kwargs and "quant_pallas" not in kwargs:
+        from gigapath_tpu.plan import resolve_plan
+
+        shape = jax.ShapeDtypeStruct(
+            (1, model.img_size, model.img_size, 3), jnp.float32
+        )
+        resolved = resolve_plan(f"tile_encoder.{model_arch}", (shape,), flags)
+        if resolved.quant_tile:
+            # rebuild with the resolved tier (module construction is a
+            # frozen dataclass — params are untouched); the common
+            # no-tier path keeps the one construction above
+            model = create_model_from_registry(
+                model_arch, quant=resolved.quant_tile,
+                quant_pallas=resolved.quant_pallas, **kwargs,
+            )
     params = init_params(model, rng=rng)
     if pretrained and os.path.isdir(pretrained) and os.path.exists(
         os.path.join(pretrained, "manifest.json")

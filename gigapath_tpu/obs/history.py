@@ -64,6 +64,12 @@ _DIRECTION_RULES: Tuple[Tuple[str, str], ...] = (
     # oracle and the downstream probe delta are down-good
     ("cosine_drift", "down"),
     ("probe_delta_pt", "down"),
+    # execution-plan autotuner (plan|autotune entry, scripts/autotune.py
+    # via perf_history ingest --plan): the best blessed variant's
+    # walltime rides the wall_s rule below; registry coverage of the
+    # resolved geometries is up-good (a DROP means dispatch silently
+    # fell back to flag/defaults on geometries that used to be planned)
+    ("plan_hit_rate", "up"),
     # streaming-prefill decision-table rows (prefill|stream entry):
     # executable arg/temp/peak megabytes and stream-vs-dense ratios,
     # smaller is better
@@ -349,6 +355,29 @@ def fold_tile(doc: dict, snapshot: dict, label: str,
     return _fold_serve_snapshot(
         doc, snapshot, label, key="tile|quant",
         metric_keys=_TILE_METRICS, source=source, force=force,
+    )
+
+
+# autotune payload fields worth trending (scripts/autotune.py's JSON):
+# the best variant's walltime next to the default's (the A/B the sweep
+# exists for), registry hit rate over the geometries the sweep resolved,
+# and the sweep's own coverage counters
+_PLAN_METRICS = (
+    "best_wall_s", "default_wall_s", "plan_hit_rate",
+    "candidates", "gates_passed", "blessed",
+)
+
+
+def fold_plan(doc: dict, snapshot: dict, label: str,
+              source: Optional[str] = None, force: bool = False) -> dict:
+    """One ``autotune`` JSON -> one point under ``plan|autotune`` (the
+    execution-plan autotuner's trend entry — same shared
+    CPU-stale-with-keys policy as the serve/dist/prefill/tile entries:
+    a CPU sweep carries the metric KEYS — and may bless memory-motivated
+    plans — but only an on-chip sweep's walltimes move the trend)."""
+    return _fold_serve_snapshot(
+        doc, snapshot, label, key="plan|autotune",
+        metric_keys=_PLAN_METRICS, source=source, force=force,
     )
 
 

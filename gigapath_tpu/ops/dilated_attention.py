@@ -505,7 +505,7 @@ def dilated_attention_fused(
     *,
     is_causal: bool = False,
     valid_len=None,
-    streaming_fusion: bool = False,
+    streaming_fusion: Optional[bool] = None,
     interpret: bool = False,
     flags=None,
 ) -> jnp.ndarray:
@@ -513,8 +513,10 @@ def dilated_attention_fused(
     [B, L, E] activations (see :mod:`gigapath_tpu.ops.pallas_dilated`).
 
     ``flags``: one :class:`~gigapath_tpu.ops.pallas_dilated.PipelineFlags`
-    snapshot shared by every branch of this op (None: snapshot the
-    environment here, once). ``flags.stream_fusion``
+    snapshot shared by every branch of this op (None: resolve the
+    dispatch here, once, through the plan seam —
+    :func:`gigapath_tpu.plan.resolve_plan` — env flags where set, this
+    geometry's blessed registry plan where not). ``flags.stream_fusion``
     (``GIGAPATH_STREAM_FUSION``) routes the whole op through the
     streaming fusion epilogue: branch results stay in the packed
     phase-major layout end to end and one epilogue kernel chain emits the
@@ -523,7 +525,9 @@ def dilated_attention_fused(
     below remains the fallback and the parity oracle.
 
     ``streaming_fusion``: fold each branch's (out, lse) into running
-    (acc, m, l) instead of stacking all branch outputs — each branch's
+    (acc, m, l) instead of stacking all branch outputs (None — the
+    default — inherits the resolved ``flags.streaming_fusion``; an
+    explicit bool pins the choice) — each branch's
     packed temporaries AND its dense output die before the next branch
     computes, the peak-memory requirement for long-context forwards. All
     streaming state is 128-lane-clean here ([B, L, E] fp32 acc, [B, H, L]
@@ -541,19 +545,23 @@ def dilated_attention_fused(
         dilated_attention_stream_fused,
         dilated_branch_attention,
         plan_stream_fusion,
-        snapshot_flags,
     )
 
     B, L, H, Dh = q.shape
     E = H * Dh
     if flags is None:
-        flags = snapshot_flags()
+        from gigapath_tpu.plan import resolve_plan
+
+        flags = resolve_plan("dilated_fused", (q, k, v))
+    if streaming_fusion is None:
+        streaming_fusion = flags.streaming_fusion
     qE, kE, vE = (x.reshape(B, L, E) for x in (q, k, v))
     real_len, valid_dyn = _normalize_valid_len(valid_len, B, L)
 
     if flags.stream_fusion and len(segment_lengths) > 1:
         plan = plan_stream_fusion(
             L, E, H, segment_lengths, dilated_ratios, interpret=interpret,
+            flags=flags,
         )
         if plan is not None:
             out = dilated_attention_stream_fused(
@@ -1056,6 +1064,16 @@ def dilated_attention(
         )
     B, L, H, Dh = q.shape
 
+    # ONE dispatch resolution per public call (the plan seam): env flags
+    # where set, this geometry's blessed registry plan where not. Every
+    # branch of this op — fused, head-major, gathered, ring — shares the
+    # resolved snapshot, so branches can never observe different
+    # dispatch decisions (the same invariant the flag snapshot held).
+    if flags is None:
+        from gigapath_tpu.plan import resolve_plan
+
+        flags = resolve_plan("dilated_attention", (q, k, v))
+
     # ONE eligibility gate for the compiled-kernel paths (the single-device
     # fast path below and the seq-parallel fused-local routing further
     # down): no custom attn_fn, no dropout, no decoding offset, self-
@@ -1094,18 +1112,15 @@ def dilated_attention(
             # ride it (traced counts live in the kernels' SMEM tables). The
             # head-major path remains for streaming branch fusion
             # (long-context memory) and ratios not dividing the heads.
-            # GIGAPATH_STREAMING_FUSION=1: fold branches into running
-            # (acc, m, l) instead of stacking all branch outputs — lower
-            # peak HBM, the enabler for the 1M-token operating point.
-            # GIGAPATH_STREAM_FUSION=1 rides the PipelineFlags snapshot
-            # (one consistent host-side read per op, shared by every
-            # branch) and engages the packed streaming fusion epilogue
-            # inside dilated_attention_fused.
-            from gigapath_tpu.ops.pallas_dilated import snapshot_flags
-
-            streaming = _env_flag("GIGAPATH_STREAMING_FUSION")
-            if flags is None:
-                flags = snapshot_flags()
+            # flags.streaming_fusion (GIGAPATH_STREAMING_FUSION): fold
+            # branches into running (acc, m, l) instead of stacking all
+            # branch outputs — lower peak HBM, the enabler for the
+            # 1M-token operating point. flags.stream_fusion
+            # (GIGAPATH_STREAM_FUSION) engages the packed streaming
+            # fusion epilogue inside dilated_attention_fused. Both ride
+            # the ONE resolved snapshot taken at the top of this call
+            # (plan seam) — no env read happens here (gigalint GL017).
+            streaming = flags.streaming_fusion
             fused_ok = all(
                 H % int(rr) == 0 and (H * Dh) % int(rr) == 0
                 for rr in dilated_ratios
@@ -1168,15 +1183,11 @@ def dilated_attention(
     # exactly as on a single device, and gathered branches combine the
     # all-gathered per-rank counts below (_dilated_branch).
     seq_active = seq_axis_name is not None and seq_axis_size > 1
+    # the resolved snapshot from the top of this call serves the
+    # fused-local routing AND the ring dispatch below (same invariant as
+    # the single-device dispatch above: branches of one op must never
+    # observe different dispatch decisions)
     sp_flags = flags
-    if seq_active and sp_flags is None:
-        # ONE flag snapshot shared by every branch of this op — fused-local
-        # routing AND the ring dispatch below (same invariant as the
-        # single-device dispatch above: branches of one op must never
-        # observe different env flag values)
-        from gigapath_tpu.ops.pallas_dilated import snapshot_flags
-
-        sp_flags = snapshot_flags()
     fused_local = (
         kernels_eligible
         and seq_active

@@ -91,17 +91,15 @@ def load_tile_slide_encoder(
     """Load both encoders; returns ``((tile_model, tile_params),
     (slide_model, slide_params))`` (reference ``pipeline.py:118-137``).
 
-    The tile encoder honors the ``GIGAPATH_QUANT_TILE`` kernel tier via
-    one host-side ``PipelineFlags`` snapshot (the same convention every
-    kernel flag follows): quant off builds the byte-identical f32/bf16
-    program, quant on builds the quantized-Dense tier — a distinct
-    traced program, so the jit cache can never serve the wrong tier."""
-    from gigapath_tpu.ops.pallas_dilated import snapshot_flags
-
-    flags = snapshot_flags()
+    The tile encoder's quant tier resolves through the plan seam inside
+    the factory (``GIGAPATH_QUANT_TILE`` where set, the plan registry's
+    blessed ``tile_encoder.<arch>`` entry where not — one host-side
+    resolution, the convention every kernel flag follows): quant off
+    builds the byte-identical f32/bf16 program, quant on builds the
+    quantized-Dense tier — a distinct traced program, so the jit cache
+    can never serve the wrong tier."""
     tile_model, tile_params = tile_encoder_lib.create_tile_encoder(
         pretrained=local_tile_encoder_path, dtype=jnp.bfloat16,
-        quant=flags.quant_tile, quant_pallas=flags.quant_pallas,
     )
     n_tile = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(tile_params))
     console(f"Tile encoder param # {n_tile}")

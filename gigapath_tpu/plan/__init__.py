@@ -1,0 +1,55 @@
+"""Geometry-keyed ExecutionPlan dispatch (ROADMAP item 5).
+
+``resolve_plan(name, shapes, flags)`` is the one seam every dispatch
+site routes through: it snapshots the ``GIGAPATH_*`` kernel flags once,
+looks the call's geometry key (the ledger's ``name|shape-signature``)
+up in the persistent registry of blessed plans, and overlays the plan
+wherever the environment is silent — env flags win where set, plans
+fill the rest, and with an empty registry the result is bit-identical
+to ``snapshot_flags()``. ``scripts/autotune.py`` sweeps variants and
+block sizes per geometry and writes the winners.
+"""
+
+from gigapath_tpu.plan.executionplan import (
+    BRANCH_VARIANTS,
+    FUSION_CLASSES,
+    ExecutionPlan,
+    apply_plan,
+    geometry_key,
+    lookup_plan,
+    plan_enabled,
+    plan_registry_signature,
+    plan_stats,
+    reset_plan_state,
+    resolve_plan,
+)
+from gigapath_tpu.plan.registry import (
+    REGISTRY_SCHEMA_VERSION,
+    CorruptPlanRegistry,
+    bless_plan,
+    load_registry,
+    new_registry,
+    registry_path,
+    save_registry,
+)
+
+__all__ = [
+    "BRANCH_VARIANTS",
+    "FUSION_CLASSES",
+    "ExecutionPlan",
+    "apply_plan",
+    "geometry_key",
+    "lookup_plan",
+    "plan_enabled",
+    "plan_registry_signature",
+    "plan_stats",
+    "reset_plan_state",
+    "resolve_plan",
+    "REGISTRY_SCHEMA_VERSION",
+    "CorruptPlanRegistry",
+    "bless_plan",
+    "load_registry",
+    "new_registry",
+    "registry_path",
+    "save_registry",
+]

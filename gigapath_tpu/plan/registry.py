@@ -1,0 +1,133 @@
+"""Persistent registry of blessed execution plans.
+
+One JSON document at ``GIGAPATH_PLAN_REGISTRY`` (default:
+``PLAN_REGISTRY.json`` at the repo root), keyed by the ledger's
+``name|shape-signature`` geometry key, holding one serialized
+:class:`~gigapath_tpu.plan.executionplan.ExecutionPlan` per geometry.
+The file follows the same two disciplines as ``quant/convert.py``'s
+artifact:
+
+- **atomic writes**: every save lands in a ``.tmp-*`` sibling and is
+  renamed into place — a SIGKILL mid-write leaves a stale tmp file,
+  never a torn registry;
+- **verified loads**: the document embeds a sha256 over the canonical
+  serialization of its entries; any mismatch (bit rot, a hand edit, a
+  truncated copy) is a refused load (:class:`CorruptPlanRegistry`) —
+  ``resolve_plan`` catches it, warns once, and falls back to defaults,
+  so a corrupt registry can degrade dispatch to the flag/default
+  behavior but can never silently mis-dispatch.
+
+Pure stdlib on purpose (mirrors ``obs/history.py``): the registry must
+load on a workstation far from any chip, and the autotuner edits it
+from plain scripts.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, Optional
+
+REGISTRY_SCHEMA_VERSION = 1
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+DEFAULT_REGISTRY_BASENAME = "PLAN_REGISTRY.json"
+
+
+class CorruptPlanRegistry(ValueError):
+    """A plan registry whose digest verification failed."""
+
+
+def registry_path() -> str:
+    """The active registry path: ``GIGAPATH_PLAN_REGISTRY`` when set,
+    else ``PLAN_REGISTRY.json`` at the repo root. A host-side read (this
+    module is the sanctioned plan-resolution read point — gigalint
+    GL017 keeps dispatch-flag env reads out of everywhere else)."""
+    override = os.environ.get("GIGAPATH_PLAN_REGISTRY", "").strip()
+    if override:
+        return os.path.abspath(override)
+    return os.path.join(_REPO_ROOT, DEFAULT_REGISTRY_BASENAME)
+
+
+def _canonical_entries(entries: Dict[str, Any]) -> str:
+    """The byte-stable serialization the digest covers (sorted keys, no
+    whitespace drift, no NaN — the ledger writer's invariants)."""
+    return json.dumps(entries, sort_keys=True, separators=(",", ":"),
+                      allow_nan=False)
+
+
+def _digest(entries: Dict[str, Any]) -> str:
+    return hashlib.sha256(_canonical_entries(entries).encode()).hexdigest()
+
+
+def new_registry() -> dict:
+    return {"v": REGISTRY_SCHEMA_VERSION, "entries": {}}
+
+
+def load_registry(path: Optional[str] = None) -> dict:
+    """Verified load: recompute the entries digest and refuse on any
+    mismatch. A missing file is an EMPTY registry (defaults), not an
+    error — only a present-but-unverifiable file is corrupt."""
+    path = path or registry_path()
+    if not os.path.exists(path):
+        return new_registry()
+    try:
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError) as e:
+        raise CorruptPlanRegistry(
+            f"{path}: unreadable plan registry ({type(e).__name__}: {e})"
+        ) from None
+    if not isinstance(doc, dict) or not isinstance(doc.get("entries"), dict):
+        raise CorruptPlanRegistry(f"{path}: no 'entries' object")
+    if doc.get("v") != REGISTRY_SCHEMA_VERSION:
+        raise CorruptPlanRegistry(
+            f"{path}: schema v{doc.get('v')!r} != {REGISTRY_SCHEMA_VERSION}"
+        )
+    expected = doc.get("sha256")
+    actual = _digest(doc["entries"])
+    if expected != actual:
+        raise CorruptPlanRegistry(
+            f"{path}: entries digest mismatch (manifest {str(expected)[:12]}"
+            f"..., actual {actual[:12]}...) — refusing the registry; delete "
+            "or regenerate it (dispatch falls back to flag/defaults)"
+        )
+    return doc
+
+
+def save_registry(doc: dict, path: Optional[str] = None) -> str:
+    """Atomic verified save: digest stamped, ``.tmp-*`` staging, rename
+    as the commit point."""
+    path = path or registry_path()
+    doc = {
+        "v": REGISTRY_SCHEMA_VERSION,
+        "entries": doc.get("entries", {}),
+        "sha256": _digest(doc.get("entries", {})),
+    }
+    parent = os.path.dirname(os.path.abspath(path))
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    tmp = os.path.join(parent, f".tmp-{os.path.basename(path)}-{os.getpid()}")
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True, allow_nan=False)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def bless_plan(key: str, plan_doc: Dict[str, Any], *,
+               path: Optional[str] = None,
+               provenance: Optional[dict] = None) -> str:
+    """Read-modify-write one blessed plan into the registry (strict
+    load first: a corrupt registry is refused, never silently
+    overwritten — delete it explicitly to start over)."""
+    path = path or registry_path()
+    doc = load_registry(path)
+    entry = dict(plan_doc)
+    if provenance:
+        entry["provenance"] = dict(provenance)
+    doc["entries"][key] = entry
+    return save_registry(doc, path)

@@ -36,7 +36,15 @@
 #   - the gigalint GL016 selftest: the seeded low-precision-cast fixture
 #     must fire (astype/asarray to int8/float8_* in library code outside
 #     the path-sanctioned quant/ module — quantization goes through
-#     gigapath_tpu/quant/qtensor.py's helper set).
+#     gigapath_tpu/quant/qtensor.py's helper set);
+#   - the gigalint GL017 selftest: the seeded kernel-dispatch-env-read
+#     fixture must fire (GIGAPATH_* variant/block flag reads in library
+#     code outside snapshot_flags / the path-sanctioned plan/ module —
+#     dispatch resolves once through gigapath_tpu/plan/resolve_plan);
+#   - the autotune selftest (scripts/autotune.py --selftest): a blessed
+#     plan must change dispatch with zero env flags set (distinct jit
+#     cache entry + ledger fingerprint), env flags must beat the plan,
+#     and a corrupt registry must be refused into default dispatch.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 python scripts/obs_report.py --selftest 1>&2
@@ -120,5 +128,22 @@ if [ "$gl016_rc" -ne 1 ]; then
     exit 1
 fi
 echo "gigalint GL016 selftest OK" 1>&2
+
+# GL017 selftest: the seeded kernel-dispatch-env-read fixture MUST be
+# found (exit 1 = findings; 0 or 2 mean the rule went blind or crashed)
+set +e
+python -m tools.gigalint --no-waivers --select GL017 \
+    tools/gigalint/selftest/fixture/models/dispatch.py 1>&2
+gl017_rc=$?
+set -e
+if [ "$gl017_rc" -ne 1 ]; then
+    echo "GL017 selftest FAILED: expected findings (rc=1), got rc=$gl017_rc" 1>&2
+    exit 1
+fi
+echo "gigalint GL017 selftest OK" 1>&2
+
+# autotune selftest: blessed-plan dispatch, env precedence, corrupt
+# registry refusal — the plan half of the dispatch refactor
+JAX_PLATFORMS=cpu python scripts/autotune.py --selftest 1>&2
 
 exec python -m tools.gigalint gigapath_tpu scripts tests "$@"

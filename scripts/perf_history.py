@@ -128,6 +128,10 @@ def cmd_ingest(args) -> int:
             history.fold_tile(doc, _load_json(args.tile), args.label,
                               source=os.path.basename(args.tile),
                               force=args.force)
+        if args.plan:
+            history.fold_plan(doc, _load_json(args.plan), args.label,
+                              source=os.path.basename(args.plan),
+                              force=args.force)
         for path in args.ledger or []:
             history.fold_ledger(doc, _load_json(path), args.label,
                                 source=os.path.basename(path),
@@ -421,6 +425,41 @@ def selftest() -> int:
         render(tv, out=sys.stderr)
         return 1
 
+    # plan|autotune folding: same shared staleness policy (a CPU sweep =
+    # stale with keys), a best-variant walltime regression flips the
+    # gate, and a plan-hit-rate DROP (registry coverage lost) flips too
+    history.fold_plan(
+        serve_doc,
+        {"rc": 0, "parsed": {"backend": "cpu", "best_wall_s": 0.5,
+                             "plan_hit_rate": 1.0}}, "r01")
+    plan_points = serve_doc["entries"]["plan|autotune"]["points"]
+    if not plan_points[0].get("stale") or "best_wall_s" not in \
+            plan_points[0]["metrics"]:
+        print("perf_history selftest FAILED: CPU plan point must be "
+              "stale WITH metric keys", file=sys.stderr)
+        return 1
+    history.fold_plan(
+        serve_doc,
+        {"rc": 0, "parsed": {"backend": "tpu", "best_wall_s": 0.4,
+                             "default_wall_s": 0.5,
+                             "plan_hit_rate": 1.0}}, "r02")
+    history.fold_plan(
+        serve_doc,
+        {"rc": 0, "parsed": {"backend": "tpu", "best_wall_s": 0.6,
+                             "default_wall_s": 0.5,
+                             "plan_hit_rate": 0.5}}, "r03")
+    plv = history.trend_verdict(serve_doc)
+    missing_plan = [
+        needle for needle in
+        ("plan|autotune: best_wall_s 0.4", "plan|autotune: plan_hit_rate 1.0")
+        if not any(needle in line for line in plv["decision"]["regressed"])
+    ]
+    if plv["decision"]["ok"] or missing_plan:
+        print(f"perf_history selftest FAILED: plan|autotune regressions "
+              f"undetected: {missing_plan}", file=sys.stderr)
+        render(plv, out=sys.stderr)
+        return 1
+
     # append-only: reusing a label without force must refuse
     try:
         history.fold_bench(
@@ -502,6 +541,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                        help="ab_tile snapshot JSON (scripts/ab_tile.py "
                        "--json output) -> the tile|quant trend entry "
                        "(quantized tile tier: throughput + drift)")
+    p_ing.add_argument("--plan", default=None,
+                       help="autotune snapshot JSON (scripts/autotune.py "
+                       "--json output) -> the plan|autotune trend entry "
+                       "(best-variant walltime + plan hit rate)")
     p_ing.add_argument("--ledger", action="append", default=None,
                        help="per-run ledger JSON (repeatable)")
     p_ing.add_argument("--force", action="store_true",

@@ -51,7 +51,7 @@ def test_fixture_tree_fires_every_rule_class():
     fired = {f.rule for f in result.findings}
     expected = {"GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
                 "GL007", "GL008", "GL009", "GL010", "GL011", "GL012",
-                "GL013", "GL014", "GL015", "GL016"}
+                "GL013", "GL014", "GL015", "GL016", "GL017"}
     assert fired >= expected, (
         f"missing rule classes: {sorted(expected - fired)}"
     )
@@ -62,6 +62,11 @@ def test_fixture_negative_controls_stay_clean():
     for f in result.findings:
         assert "negative_control" not in f.symbol, f.text()
         assert "test_fixture_fast_without_features" not in f.symbol, f.text()
+        # GL017's function-name sanction: the fixture's snapshot_flags
+        # twin reads a dispatch flag and must stay clean
+        assert not (f.rule == "GL017" and "snapshot_flags" in f.symbol), (
+            f.text()
+        )
 
 
 def test_fixture_specific_findings():
@@ -141,6 +146,15 @@ def test_fixture_specific_findings():
         ("GL016", "lowprec.py", "pack_activations"),
         ("GL016", "lowprec.py", "fp8_by_hand"),
         ("GL016", "lowprec.py", "stage_buffer"),
+        # kernel-dispatch flag reads outside snapshot_flags / the plan
+        # package (the fixture's own plan/resolve.py twin is the
+        # path-segment negative control; dispatch.py::snapshot_flags is
+        # the function-name negative control; host flags + dynamic
+        # names stay out of scope)
+        ("GL017", "dispatch.py", "read_variant_flag_by_hand"),
+        ("GL017", "dispatch.py", "block_override_by_hand"),
+        ("GL017", "dispatch.py", "helper_env_flag_read"),
+        ("GL017", "dispatch.py", "subscript_read"),
     }
     assert expected <= got, f"missing: {sorted(expected - got)}"
 

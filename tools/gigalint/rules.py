@@ -1246,6 +1246,123 @@ def check_lowprec_casts(project: Project) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# GL017 — kernel-dispatch env reads outside the plan-resolution seam
+# ---------------------------------------------------------------------------
+
+# A GIGAPATH_* variant/block flag read anywhere else in library code is
+# a second, unaudited dispatch decision: it bypasses the ONE resolution
+# the plan refactor established (env flags where set, the geometry's
+# blessed registry plan where not), so a blessed plan silently loses to
+# a stray read nobody sees — exactly the hand-rolled A/B matrix the
+# ExecutionPlan registry replaced. Reads are sanctioned only inside
+# ``snapshot_flags`` (the one flag-VALUE read, threaded everywhere as a
+# PipelineFlags snapshot) and the ``plan/`` package (the resolution
+# module itself — matched by path SEGMENT so the fixture tree can carry
+# its own plan/ twin as a negative control). Host-side flags
+# (GIGAPATH_OBS, GIGAPATH_SERVE_*, ...) are not this rule's business —
+# only the kernel-dispatch set below.
+_GL017_FLAGS = frozenset({
+    "GIGAPATH_PIPELINED_ATTN", "GIGAPATH_PIPELINED_BWD",
+    "GIGAPATH_PIPE_BLOCK_K", "GIGAPATH_PIPE_BWD_BLOCK_K",
+    "GIGAPATH_PACK_DIRECT", "GIGAPATH_STREAM_FUSION",
+    "GIGAPATH_STREAMING_FUSION", "GIGAPATH_RING_ATTN",
+    "GIGAPATH_CHUNKED_PREFILL", "GIGAPATH_QUANT_TILE",
+    "GIGAPATH_QUANT_PALLAS", "GIGAPATH_PLAN", "GIGAPATH_PLAN_REGISTRY",
+})
+_GL017_SANCTIONED_FUNC = "snapshot_flags"
+_GL017_SANCTIONED_SEGMENT = "plan"
+_GL017_EXEMPT_SEGMENTS = frozenset({"scripts", "tests", "demo"})
+
+
+def _gl017_read_flag(node: ast.Call) -> Optional[str]:
+    """The dispatch-flag name a call reads, or None: os.environ.get /
+    os.getenv / environ.setdefault under any alias, and the shared
+    env_flag helper (any alias ending in env_flag), with a literal
+    first argument from the dispatch set."""
+    fn = dotted_name(node.func)
+    if not fn:
+        return None
+    reader = (
+        "environ" in fn and fn.rsplit(".", 1)[-1] in ("get", "setdefault")
+    ) or fn.endswith("getenv") or fn.endswith("env_flag")
+    if not reader or not node.args:
+        return None
+    arg = node.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+            and arg.value in _GL017_FLAGS:
+        return arg.value
+    return None
+
+
+@register(
+    "GL017",
+    "kernel-dispatch GIGAPATH_* variant/block flag read in library code "
+    "outside snapshot_flags / the plan-resolution module — dispatch is "
+    "resolved ONCE per call through gigapath_tpu/plan/resolve_plan (env "
+    "flags where set, the blessed registry plan where not); a stray read "
+    "silently bypasses blessed plans; scripts, tests and demos exempt",
+)
+def check_dispatch_env_reads(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.modules.values():
+        segments = mod.path.split("/")[:-1]
+        if mod.is_test_file or any(
+            s in _GL017_EXEMPT_SEGMENTS for s in segments
+        ):
+            continue
+        if _GL017_SANCTIONED_SEGMENT in segments:
+            continue  # the plan-resolution package may read its flags
+        spans = sorted(
+            (
+                (fn.lineno, getattr(fn.node, "end_lineno", fn.lineno), fn)
+                for fn in mod.functions.values()
+            ),
+            key=lambda t: t[1] - t[0],
+        )
+
+        def symbol_at(lineno: int) -> str:
+            for lo, hi, fn in spans:
+                if lo <= lineno <= hi:
+                    return fn.qualname
+            return "<module>"
+
+        for node in ast.walk(mod.tree):
+            flag = None
+            how = ""
+            if isinstance(node, ast.Call):
+                flag = _gl017_read_flag(node)
+                how = f"{dotted_name(node.func)}({flag!r})" if flag else ""
+            elif isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, ast.Load
+            ):
+                base = dotted_name(node.value)
+                sl = node.slice
+                if (
+                    base and base.endswith("environ")
+                    and isinstance(sl, ast.Constant)
+                    and isinstance(sl.value, str)
+                    and sl.value in _GL017_FLAGS
+                ):
+                    flag = sl.value
+                    how = f"{base}[{flag!r}]"
+            if flag is None:
+                continue
+            symbol = symbol_at(node.lineno)
+            if symbol.rsplit(".", 1)[-1] == _GL017_SANCTIONED_FUNC:
+                continue  # the one sanctioned flag-VALUE read point
+            findings.append(Finding(
+                "GL017", mod.path, node.lineno, symbol,
+                f"kernel-dispatch env read {how} in library code: this "
+                "flag is resolved ONCE per public call through "
+                "gigapath_tpu/plan/resolve_plan (env where set, the "
+                "blessed registry plan where not) — take a PipelineFlags "
+                "snapshot / resolved plan from the caller instead of "
+                "re-reading the environment",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # GL004 — forbidden APIs
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,55 @@
+"""Seeded GL017 violations: kernel-dispatch GIGAPATH_* flag reads in
+library code outside ``snapshot_flags`` / the plan-resolution module
+(the fixture's own plan/resolve.py twin is the negative control).
+Never 'fix' these — each is load-bearing for a self-test."""
+
+import os
+
+
+def env_flag(name):
+    # fixture-local twin of ops/common.env_flag; the read here is
+    # non-literal, so the rule (conservatively) cannot match it — its
+    # CALL SITES with literal dispatch flags are the violations
+    return os.environ.get(name, "").strip().lower() in ("1", "true", "yes")
+
+
+def read_variant_flag_by_hand():
+    # GL017: a variant flag read that bypasses the plan resolution —
+    # a blessed plan for this geometry silently loses to this read
+    return os.environ.get("GIGAPATH_PIPELINED_ATTN", "") == "1"
+
+
+def block_override_by_hand():
+    # GL017: a block flag via os.getenv
+    return int(os.getenv("GIGAPATH_PIPE_BLOCK_K", "0") or 0)
+
+
+def helper_env_flag_read():
+    # GL017: the shared env_flag helper on a dispatch flag, outside the
+    # sanctioned snapshot
+    return env_flag("GIGAPATH_STREAM_FUSION")
+
+
+def subscript_read():
+    # GL017: a raw environ subscript on the quant-tier flag
+    return os.environ["GIGAPATH_QUANT_TILE"]
+
+
+def snapshot_flags():
+    # negative control by FUNCTION NAME: the one sanctioned flag-VALUE
+    # read point (the fixture twin of pallas_dilated.snapshot_flags)
+    return {
+        "pack_direct": os.environ.get("GIGAPATH_PACK_DIRECT", "") == "1",
+    }
+
+
+def negative_control_host_flag_read():
+    # host-side flags (obs, serving config, ...) are NOT this rule's
+    # business — only the kernel-dispatch variant/block set
+    return os.environ.get("GIGAPATH_FIXTURE_DOCUMENTED", "")
+
+
+def negative_control_dynamic_name(name):
+    # a non-literal read cannot be matched to the dispatch set; the
+    # rule stays conservative rather than guessing
+    return os.environ.get(name, "")

@@ -1,0 +1,21 @@
+"""Negative control for GL017: this file's path carries a ``plan``
+segment, so its dispatch-flag reads are sanctioned — the twin of the
+real gigapath_tpu/plan/executionplan.py, exactly like the fixture's
+quant/qtensor.py (GL016) and dist/transport.py (GL015) twins."""
+
+import os
+
+
+def negative_control_sanctioned_registry_path():
+    # sanctioned: the plan-resolution module owns the registry/env seam
+    return os.environ.get("GIGAPATH_PLAN_REGISTRY", "")
+
+
+def negative_control_sanctioned_plan_gate():
+    return os.environ.get("GIGAPATH_PLAN", "").strip().lower() != "off"
+
+
+def negative_control_sanctioned_presence_probe():
+    # resolution needs PRESENCE of the dispatch flags (env wins where
+    # set) — a read the rule must keep sanctioned here
+    return bool(os.environ.get("GIGAPATH_STREAM_FUSION", "").strip())
