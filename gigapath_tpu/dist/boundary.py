@@ -45,6 +45,8 @@ import time
 import zipfile
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from gigapath_tpu.obs.locktrace import make_condition
+
 import numpy as np
 
 from gigapath_tpu.obs.runlog import env_number
@@ -218,7 +220,7 @@ def _emit_backpressure(runlog, *, channel: str, seq: int, queue_depth: int,
     """One schema'd ``backpressure`` event per producer blocking episode
     (runlog optional — bare-channel users stay silent)."""
     if runlog is not None:
-        runlog.event(
+        runlog.event(  # gigarace: calls RunLog.event
             "backpressure", channel=channel, seq=seq, credits=0,
             queue_depth=queue_depth, capacity=capacity,
         )
@@ -240,9 +242,9 @@ class MemoryChannel:
                  runlog=None, name: str = "memory"):
         self.cfg = config or BoundaryConfig()
         self.name = name
-        self._runlog = runlog
+        self._runlog = runlog  # gigarace: type gigapath_tpu.obs.runlog.RunLog
         self.stats = ChannelStats()
-        self._cond = threading.Condition()
+        self._cond = make_condition("gigapath_tpu.dist.boundary.MemoryChannel._cond")
         self._queue: List[EmbeddingChunk] = []
         self._unacked: Dict[int, EmbeddingChunk] = {}
         self._delivered: set = set()
@@ -375,7 +377,7 @@ class DirChannelProducer:
         os.makedirs(self.dir, exist_ok=True)
         self.producer = producer
         self.name = name
-        self._runlog = runlog
+        self._runlog = runlog  # gigarace: type gigapath_tpu.obs.runlog.RunLog
         self._chaos = chaos
         self.stats = ChannelStats()
         self._sent_at: Dict[int, float] = {}      # seq -> last send time
@@ -496,7 +498,7 @@ class DirChannelConsumer:
         self.dir = os.path.join(root, "channel")
         os.makedirs(self.dir, exist_ok=True)
         self.name = name
-        self._runlog = runlog
+        self._runlog = runlog  # gigarace: type gigapath_tpu.obs.runlog.RunLog
         self.stats = ChannelStats()
         self._delivered: set = set(
             int(s) for s in delivered) if delivered else set()

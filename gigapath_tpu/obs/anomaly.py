@@ -83,6 +83,8 @@ import os
 import threading
 from typing import Deque, Dict, Optional
 
+from gigapath_tpu.obs.locktrace import make_rlock
+
 from gigapath_tpu.obs.flight import FlightRecorder, register_signal_dump
 
 DETECTORS = (
@@ -131,12 +133,12 @@ class NullAnomalyEngine:
 class AnomalyEngine(NullAnomalyEngine):
     def __init__(self, runlog, config: Optional[AnomalyConfig] = None,
                  flight: Optional[FlightRecorder] = None):
-        self.runlog = runlog
+        self.runlog = runlog  # gigarace: type gigapath_tpu.obs.runlog.RunLog
         self.cfg = config or AnomalyConfig()
         self.flight = flight
         self.anomalies: list = []      # emitted anomaly records
         self.trace_dirs: list = []     # profiler capture directories
-        self._lock = threading.RLock()  # re-entrant: firing emits events
+        self._lock = make_rlock("gigapath_tpu.obs.anomaly.AnomalyEngine._lock")  # re-entrant: firing emits events
         # rolling state
         self._step_events = 0
         self._last_step: Optional[int] = None
@@ -171,17 +173,17 @@ class AnomalyEngine(NullAnomalyEngine):
     def _obs_dir(self) -> str:
         return os.path.dirname(os.path.abspath(self.runlog.path))
 
-    def _cooled(self, detector: str) -> bool:
+    def _cooled_locked(self, detector: str) -> bool:
         last = self._last_fired.get(detector)
         return last is None or (
             self._step_events - last >= self.cfg.cooldown_steps
         )
 
-    def _fire(self, detector: str, **info) -> bool:
+    def _fire_locked(self, detector: str, **info) -> bool:
         """One detector verdict -> anomaly event + flight dump + armed
         profiler capture. Caller holds the lock. Returns whether the
         anomaly was actually emitted (False = cooldown suppressed it)."""
-        if not self._cooled(detector):
+        if not self._cooled_locked(detector):
             return False
         self._last_fired[detector] = self._step_events
         flight_path = None
@@ -190,7 +192,7 @@ class AnomalyEngine(NullAnomalyEngine):
         trace_dir = None
         if self._capture_armed is None and self._capture_left > 0:
             self._capture_armed = detector
-            trace_dir = self._capture_dir = self._next_trace_dir(detector)
+            trace_dir = self._capture_dir = self._next_trace_dir_locked(detector)
             # the advertised path must exist even if the run never lands
             # another step (a hung run's stall capture never starts):
             # an empty trace dir reads as "capture armed, no steps
@@ -221,15 +223,18 @@ class AnomalyEngine(NullAnomalyEngine):
     def compile_share(self) -> Optional[float]:
         """Observed compile seconds over the run's event-time span so
         far — the 'how much of this run went to XLA' context attached
-        to every anomaly event."""
-        if self._first_t is None or self._last_event_t is None:
-            return None
-        span = self._last_event_t - self._first_t
-        if span <= 0:
-            return None
-        return round(min(self._compile_seconds / span, 1.0), 4)
+        to every anomaly event. Takes the engine lock (re-entrant, so
+        the under-lock ``_fire_locked`` path can call it too): callers
+        outside the observer thread get a consistent read."""
+        with self._lock:
+            if self._first_t is None or self._last_event_t is None:
+                return None
+            span = self._last_event_t - self._first_t
+            if span <= 0:
+                return None
+            return round(min(self._compile_seconds / span, 1.0), 4)
 
-    def _next_trace_dir(self, reason: str) -> str:
+    def _next_trace_dir_locked(self, reason: str) -> str:
         self._capture_seq += 1
         # keyed by the run FILE's stem (carries the per-process suffix
         # under a shared GIGAPATH_OBS_RUN_ID) so concurrent ranks never
@@ -248,23 +253,23 @@ class AnomalyEngine(NullAnomalyEngine):
         including step 1's XLA compile, the most profile-worthy work of
         the run — instead of starting one step late."""
         with self._lock:
-            self._maybe_start_capture()
+            self._maybe_start_capture_locked()
 
-    def _maybe_start_capture(self) -> None:
+    def _maybe_start_capture_locked(self) -> None:
         """Start/advance/stop the triggered capture. Runs on the thread
         emitting ``step`` events (the driver loop), so start/stop always
         happen on the thread that owns the device work."""
         if self._tracing:
             self._trace_steps_left -= 1
             if self._trace_steps_left <= 0:
-                self._stop_capture()
+                self._stop_capture_locked()
             return
         if self._capture_armed is None or self._capture_left <= 0:
             return
         reason = self._capture_armed
         if reason == "profile_flag":
             steps = self.cfg.profile_first
-            trace_dir = self._next_trace_dir(reason)
+            trace_dir = self._next_trace_dir_locked(reason)
             self.runlog.echo(
                 f"[profile] GIGAPATH_PROFILE: capturing next {steps} "
                 f"step(s) -> {trace_dir}"
@@ -293,7 +298,7 @@ class AnomalyEngine(NullAnomalyEngine):
         self._trace_steps_left = max(int(steps), 1)
         self.trace_dirs.append(trace_dir)
 
-    def _stop_capture(self) -> None:
+    def _stop_capture_locked(self) -> None:
         if not self._tracing:
             return
         self._tracing = False
@@ -323,13 +328,13 @@ class AnomalyEngine(NullAnomalyEngine):
                 if record.get("seconds") is not None:
                     self._compile_seconds += float(record["seconds"])
             if kind == "stall":
-                self._fire(
+                self._fire_locked(
                     "stall",
                     value=record.get("since_progress_s"),
                     threshold=record.get("deadline_s"),
                 )
             elif kind == "compile" and record.get("unexpected"):
-                self._fire(
+                self._fire_locked(
                     "unexpected_retrace",
                     fn=record.get("fn"), key=record.get("key"),
                     compile_count=record.get("count"),
@@ -339,7 +344,7 @@ class AnomalyEngine(NullAnomalyEngine):
                 # the SloTracker's burning TRANSITION (terminal status
                 # events are marked final and never fire — a run that
                 # ends while burning already fired at entry)
-                self._fire(
+                self._fire_locked(
                     "slo_burn",
                     value=record.get("burn_short"),
                     baseline=record.get("threshold"),
@@ -354,7 +359,7 @@ class AnomalyEngine(NullAnomalyEngine):
                 # multi-worker cascade still dumps flight context for
                 # the FIRST loss — every loss keeps its own
                 # ``worker_lost`` event regardless
-                self._fire(
+                self._fire_locked(
                     "worker_lost",
                     worker=record.get("worker"),
                     stage=record.get("stage"),
@@ -365,7 +370,7 @@ class AnomalyEngine(NullAnomalyEngine):
                 # consumer found its predecessor's mid-slide
                 # checkpoint): flight context for the post-mortem, the
                 # ``recovery action="consumer_resume"`` event follows
-                self._fire(
+                self._fire_locked(
                     "consumer_lost",
                     stage=record.get("stage"),
                     reason=record.get("reason"),
@@ -376,13 +381,13 @@ class AnomalyEngine(NullAnomalyEngine):
                 if self.flight is not None:
                     self.flight.dump("error", where=record.get("where"))
             elif kind == "run_end":
-                self._stop_capture()
+                self._stop_capture_locked()
             elif kind == "step":
-                self._on_step(record)
+                self._on_step_locked(record)
             if kind in ("step", "heartbeat"):
-                self._check_watermark(record)
+                self._check_watermark_locked(record)
 
-    def _on_step(self, record: dict) -> None:
+    def _on_step_locked(self, record: dict) -> None:
         cfg = self.cfg
         self._step_events += 1
         if record.get("step") is not None:
@@ -400,7 +405,7 @@ class AnomalyEngine(NullAnomalyEngine):
         # non-finite regime from emitting one anomaly per step (the
         # guard's own recovery events still record every skip)
         if record.get("nonfinite"):
-            self._fire(
+            self._fire_locked(
                 "nonfinite_step",
                 value=record.get("loss"),
                 consecutive=record.get("consecutive"),
@@ -436,7 +441,7 @@ class AnomalyEngine(NullAnomalyEngine):
                     # produces back-to-back slow gaps
                     self._dip_streak += 1
                     if self._dip_streak >= 2:
-                        self._fire(
+                        self._fire_locked(
                             "throughput_dip",
                             value=round(1.0 / self._gap_ewma, 6),
                             baseline=round(1.0 / self._baseline_gap, 6),
@@ -479,16 +484,16 @@ class AnomalyEngine(NullAnomalyEngine):
                     )
                     if bucket:
                         info["bucket"] = bucket
-                    self._fire("step_time_spike", **info)
+                    self._fire_locked("step_time_spike", **info)
             walls_seen.append(wall)
             stats["ewma"] = (
                 wall if ewma is None
                 else (1 - cfg.ewma_alpha) * ewma + cfg.ewma_alpha * wall
             )
 
-        self._maybe_start_capture()
+        self._maybe_start_capture_locked()
 
-    def _check_watermark(self, record: dict) -> None:
+    def _check_watermark_locked(self, record: dict) -> None:
         peak = record.get("mem_peak_bytes")
         if peak is None:
             return
@@ -501,7 +506,7 @@ class AnomalyEngine(NullAnomalyEngine):
             peak > self.cfg.watermark_factor * self._mem_baseline
             and grown >= self.cfg.watermark_min_delta
         ):
-            fired = self._fire(
+            fired = self._fire_locked(
                 "memory_watermark",
                 value=peak, baseline=self._mem_baseline,
                 grown_bytes=grown,
@@ -516,7 +521,7 @@ class AnomalyEngine(NullAnomalyEngine):
 
     def close(self) -> None:
         with self._lock:
-            self._stop_capture()
+            self._stop_capture_locked()
         if self.flight is not None:
             from gigapath_tpu.obs.flight import unregister_signal_dump
 

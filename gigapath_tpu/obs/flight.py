@@ -34,6 +34,8 @@ import threading
 import time
 from typing import Deque, Optional, Tuple
 
+from gigapath_tpu.obs.locktrace import make_lock
+
 
 class FlightRecorder:
     """Ring buffer of obs records with budgeted append-only dumps."""
@@ -56,7 +58,7 @@ class FlightRecorder:
         )
         self._seq = 0
         self._last_dumped_seq = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("gigapath_tpu.obs.flight.FlightRecorder._lock")
 
     # -- tap (registered as a RunLog observer) ----------------------------
     def on_event(self, record: dict) -> None:
@@ -139,7 +141,7 @@ _SIGNAL_FLIGHTS: list = []
 _SIGNAL_CALLBACKS: list = []
 _PREV_SIGTERM = None
 _SIGNAL_INSTALLED = False
-_SIGNAL_LOCK = threading.Lock()
+_SIGNAL_LOCK = make_lock("gigapath_tpu.obs.flight._SIGNAL_LOCK")
 
 
 def _on_sigterm(signum, frame):

@@ -30,6 +30,8 @@ import threading
 import time
 from typing import Dict, Optional
 
+from gigapath_tpu.obs.locktrace import make_lock
+
 
 def env_seconds(name: str, default: float) -> float:
     """Host-side env override for the heartbeat deadlines (read once, at
@@ -76,7 +78,7 @@ def memory_watermarks() -> Dict[str, float]:
 class Heartbeat:
     def __init__(self, runlog, *, interval_s: Optional[float] = None,
                  stall_after_s: Optional[float] = None, name: str = "train"):
-        self.runlog = runlog
+        self.runlog = runlog  # gigarace: type gigapath_tpu.obs.runlog.RunLog
         # env-tunable defaults so EVERY driver's deadlines can be bent
         # without a CLI surface (a forced-stall repro, a tight CI run);
         # explicit arguments win
@@ -91,7 +93,7 @@ class Heartbeat:
         self._last_beat = time.time()
         self._last_step: Optional[int] = None
         self._stalled = False
-        self._lock = threading.Lock()
+        self._lock = make_lock("gigapath_tpu.obs.heartbeat.Heartbeat._lock")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -99,7 +101,10 @@ class Heartbeat:
     def start(self) -> "Heartbeat":
         if self._thread is not None:
             return self
-        self._last_beat = time.time()
+        # under the lock even though the monitor thread does not exist
+        # yet: restarts race a stop()ing monitor's final read
+        with self._lock:
+            self._last_beat = time.time()
         self._thread = threading.Thread(
             target=self._run, daemon=True, name=f"obs-heartbeat-{self.name}"
         )

@@ -1,0 +1,408 @@
+"""Runtime lock-order sanitizer: gigarace's dynamic twin.
+
+The static analyzer (:mod:`tools.gigarace`) proves properties of the
+lock-acquisition ORDER it can see in the AST; this module observes the
+orders that actually happen. Every lock in the library is constructed
+through the factories here —
+
+    self._lock = make_lock("gigapath_tpu.obs.runlog.RunLog._lock")
+    self._cond = make_condition("gigapath_tpu.serve.queue.RequestQueue._cond")
+
+— with the lock's CANONICAL name (the same ``pkg.mod.Cls._attr`` string
+the static model derives) passed as a literal, so the two sides speak
+identical identities and ``python -m tools.gigarace --validate`` can
+assert that every edge observed at runtime is an edge the static graph
+predicted.
+
+Gating (the obs-off discipline): ``GIGAPATH_LOCKTRACE`` is read ONCE,
+host-side, at import. Off — the default — every factory returns the
+plain ``threading`` primitive: no wrapper object, no per-acquire
+bookkeeping, no extra files, and nothing jax-visible (the sanitizer is
+pure host threading, so traced-program HLO is byte-identical either
+way; ``tests/test_locktrace.py`` pins the off path). On
+(``GIGAPATH_LOCKTRACE=1``) each primitive is wrapped and the process
+accumulates, per thread, the stack of held locks, and globally:
+
+- the acquisition-order edge set: on every acquire, one edge from each
+  DISTINCT currently-held lock to the new one (exactly the static
+  model's edge rule);
+- violations: acquiring a non-reentrant lock an instance of which this
+  thread already holds (self-deadlock — recorded BEFORE the attempt so
+  the artifact survives the hang), and an order inversion (edge A->B
+  observed when B->A was already recorded: a 2-cycle no static-clean
+  tree may produce);
+- contention counts (a non-blocking try precedes every blocking
+  acquire; failure of the try is one contention event) and per-lock
+  hold-time samples for the ``== locks ==`` report section.
+
+Artifacts: ``GIGAPATH_LOCKTRACE_OUT=<path>`` (read once, host-side)
+appends one JSON line ``{"kind": "locktrace", ...}`` at process exit;
+:func:`attach_locktrace` registers a runlog closer that lands the same
+payload as a ``locktrace`` event in the run JSONL, where
+``scripts/obs_report.py`` renders it. Both shapes are what
+``tools.gigarace --validate`` consumes.
+
+Signal safety: the aggregate state is guarded by an internal (never
+traced) lock taken with a short try-acquire — a traced acquisition from
+a signal handler (``pending_from_signal``) must never block on state
+the interrupted thread holds; on contention the observation is dropped,
+never the caller's acquire.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+from time import monotonic
+from typing import Dict, List, Optional, Tuple
+
+# host-side gates, read once at import (GL001/GL007 discipline)
+_ENABLED = os.environ.get("GIGAPATH_LOCKTRACE", "") == "1"
+_OUT_PATH = os.environ.get("GIGAPATH_LOCKTRACE_OUT", "") or None
+
+_MAX_HOLD_SAMPLES = 65536   # per lock; count/total stay exact past it
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+class _LockTraceState:
+    """Process-global aggregates + per-thread held stacks."""
+
+    def __init__(self):
+        self.lock = threading.Lock()   # internal, never traced
+        self.names: set = set()
+        self.edges: Dict[Tuple[str, str], int] = {}
+        self.violations: List[str] = []
+        self.contention: Dict[str, int] = {}
+        self.hold_samples: Dict[str, List[float]] = {}
+        self.hold_counts: Dict[str, int] = {}
+        self.hold_totals: Dict[str, float] = {}
+        self.tls = threading.local()
+
+    # -- per-thread stack of (name, instance id, t_acquired) -------------
+    def _stack(self) -> List[Tuple[str, int, float]]:
+        stack = getattr(self.tls, "stack", None)
+        if stack is None:
+            stack = []
+            self.tls.stack = stack
+        return stack
+
+    # -- recording hooks (called by the wrappers) -------------------------
+    def note_name(self, name: str) -> None:
+        if not self.lock.acquire(timeout=0.5):
+            return
+        try:
+            self.names.add(name)
+        finally:
+            self.lock.release()
+
+    def note_contention(self, name: str) -> None:
+        if not self.lock.acquire(timeout=0.5):
+            return
+        try:
+            self.contention[name] = self.contention.get(name, 0) + 1
+        finally:
+            self.lock.release()
+
+    def pre_acquire(
+        self, name: str, inst: int, kind: str, bounded: bool = False,
+    ) -> None:
+        """Self-deadlock check, BEFORE the acquire attempt: if this
+        thread already holds this very instance and it is not reentrant,
+        an INDEFINITE acquire will hang — get the violation into the
+        record first so the artifact explains the hang. A ``bounded``
+        attempt (``blocking=False`` or a finite timeout) on a held lock
+        is NOT a violation: it self-resolves by failing, which is
+        exactly the sanctioned ``*_from_signal`` try-acquire degradation
+        (the handler may run ON the thread that holds the lock)."""
+        if kind == "rlock" or bounded:
+            return
+        if any(i == inst for _, i, _ in self._stack()):
+            self._violate(
+                f"re-acquire of non-reentrant '{name}' already held by "
+                "this thread: self-deadlock")
+
+    def on_acquired(self, name: str, inst: int, kind: str) -> None:
+        stack = self._stack()
+        reentrant = any(i == inst for _, i, _ in stack)
+        if not reentrant:
+            held = {n for n, _, _ in stack if n != name}
+            if held and not self.lock.acquire(timeout=0.5):
+                held = set()   # drop the observation, never the caller
+            elif held:
+                try:
+                    for h in sorted(held):
+                        if (name, h) in self.edges:
+                            self._violate_locked(
+                                f"order inversion: {h} -> {name} here "
+                                f"but {name} -> {h} observed earlier")
+                        key = (h, name)
+                        self.edges[key] = self.edges.get(key, 0) + 1
+                finally:
+                    self.lock.release()
+        stack.append((name, inst, monotonic()))
+
+    def on_release(self, name: str, inst: int) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][1] == inst:
+                held_s = monotonic() - stack[i][2]
+                del stack[i]
+                self._note_hold(name, held_s)
+                return
+        self._violate(f"release of '{name}' not held by this thread")
+
+    def _note_hold(self, name: str, seconds: float) -> None:
+        if not self.lock.acquire(timeout=0.5):
+            return
+        try:
+            self.hold_counts[name] = self.hold_counts.get(name, 0) + 1
+            self.hold_totals[name] = self.hold_totals.get(name, 0.0) + seconds
+            samples = self.hold_samples.setdefault(name, [])
+            if len(samples) < _MAX_HOLD_SAMPLES:
+                samples.append(seconds)
+        finally:
+            self.lock.release()
+
+    def _violate(self, msg: str) -> None:
+        if not self.lock.acquire(timeout=0.5):
+            return
+        try:
+            self._violate_locked(msg)
+        finally:
+            self.lock.release()
+
+    def _violate_locked(self, msg: str) -> None:
+        if msg not in self.violations:
+            self.violations.append(msg)
+
+    # -- reporting ---------------------------------------------------------
+    def summary(self) -> dict:
+        with self.lock:
+            holds = {}
+            for name in sorted(self.hold_counts):
+                samples = sorted(self.hold_samples.get(name, ()))
+                n = self.hold_counts[name]
+                holds[name] = {
+                    "count": n,
+                    "total_ms": round(self.hold_totals[name] * 1e3, 3),
+                    "p50_ms": _pct_ms(samples, 0.50),
+                    "p99_ms": _pct_ms(samples, 0.99),
+                }
+            return {
+                "kind": "locktrace",
+                "locks": sorted(self.names),
+                "edges": sorted([a, b] for (a, b) in self.edges),
+                "edge_counts": {
+                    f"{a} -> {b}": c
+                    for (a, b), c in sorted(self.edges.items())
+                },
+                "violations": list(self.violations),
+                "contention": dict(sorted(self.contention.items())),
+                "holds": holds,
+            }
+
+    def reset(self) -> None:
+        with self.lock:
+            self.names.clear()
+            self.edges.clear()
+            self.violations.clear()
+            self.contention.clear()
+            self.hold_samples.clear()
+            self.hold_counts.clear()
+            self.hold_totals.clear()
+
+
+def _pct_ms(sorted_samples: List[float], q: float) -> float:
+    if not sorted_samples:
+        return 0.0
+    idx = min(len(sorted_samples) - 1,
+              max(0, int(round(q * (len(sorted_samples) - 1)))))
+    return round(sorted_samples[idx] * 1e3, 3)
+
+
+class _TracedLock:
+    """threading.Lock/RLock twin that reports to the global state."""
+
+    def __init__(self, name: str, inner, kind: str):
+        self._name = name
+        self._inner = inner
+        self._kind = kind
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        _STATE.pre_acquire(self._name, id(self._inner), self._kind,
+                           bounded=(not blocking) or timeout >= 0)
+        got = self._inner.acquire(False)
+        if not got:
+            if not blocking:
+                return False
+            _STATE.note_contention(self._name)
+            got = self._inner.acquire(True, timeout)
+            if not got:
+                return False
+        _STATE.on_acquired(self._name, id(self._inner), self._kind)
+        return True
+
+    def release(self):
+        _STATE.on_release(self._name, id(self._inner))
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<TracedLock {self._name!r} kind={self._kind}>"
+
+
+class _TracedCondition:
+    """threading.Condition twin; ``wait`` re-reports the re-acquire."""
+
+    def __init__(self, name: str, inner):
+        self._name = name
+        self._inner = inner
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        _STATE.pre_acquire(self._name, id(self._inner), "condition",
+                           bounded=(not blocking) or timeout >= 0)
+        got = self._inner.acquire(False)
+        if not got:
+            if not blocking:
+                return False
+            _STATE.note_contention(self._name)
+            got = self._inner.acquire(True, timeout)
+            if not got:
+                return False
+        _STATE.on_acquired(self._name, id(self._inner), "condition")
+        return True
+
+    def release(self):
+        _STATE.on_release(self._name, id(self._inner))
+        self._inner.release()
+
+    def wait(self, timeout: Optional[float] = None):
+        # the inner wait releases and re-acquires the underlying lock:
+        # mirror both transitions so hold times stop at the park and the
+        # re-acquire records fresh order edges
+        _STATE.on_release(self._name, id(self._inner))
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            _STATE.on_acquired(self._name, id(self._inner), "condition")
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        _STATE.on_release(self._name, id(self._inner))
+        try:
+            return self._inner.wait_for(predicate, timeout)
+        finally:
+            _STATE.on_acquired(self._name, id(self._inner), "condition")
+
+    def notify(self, n: int = 1):
+        return self._inner.notify(n)
+
+    def notify_all(self):
+        return self._inner.notify_all()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<TracedCondition {self._name!r}>"
+
+
+# ---------------------------------------------------------------------------
+# the factories: the library's ONLY lock constructors
+# ---------------------------------------------------------------------------
+
+def make_lock(name: str):
+    """A ``threading.Lock`` (plain when tracing is off) with a canonical
+    name matching the static model's derivation for its declaration."""
+    if not _ENABLED:
+        return threading.Lock()
+    _STATE.note_name(name)
+    return _TracedLock(name, threading.Lock(), "lock")
+
+
+def make_rlock(name: str):
+    if not _ENABLED:
+        return threading.RLock()
+    _STATE.note_name(name)
+    return _TracedLock(name, threading.RLock(), "rlock")
+
+
+def make_condition(name: str, lock=None):
+    if not _ENABLED:
+        return threading.Condition(lock)
+    _STATE.note_name(name)
+    inner = threading.Condition(getattr(lock, "_inner", lock))
+    return _TracedCondition(name, inner)
+
+
+# ---------------------------------------------------------------------------
+# reporting surface
+# ---------------------------------------------------------------------------
+
+def summary() -> Optional[dict]:
+    """The current aggregate payload, or None when tracing is off."""
+    if not _ENABLED:
+        return None
+    return _STATE.summary()
+
+
+def reset() -> None:
+    """Test hook: clear every aggregate (per-thread stacks excluded —
+    callers reset between scenarios with no locks held)."""
+    if _ENABLED:
+        _STATE.reset()
+
+
+def dump(path: str) -> None:
+    """Append the summary as one JSON line (the --validate input)."""
+    if not _ENABLED:
+        return
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write(json.dumps(_STATE.summary(), sort_keys=True) + "\n")
+
+
+def attach_locktrace(runlog) -> None:
+    """Land the summary as a ``locktrace`` event when the run closes
+    (called by ``get_run_log`` for recording runs; no-op when off)."""
+    if not _ENABLED:
+        return
+
+    def _close() -> None:
+        payload = _STATE.summary()
+        payload.pop("kind", None)
+        runlog.event("locktrace", **payload)
+
+    runlog.add_closer(_close)
+
+
+def _dump_at_exit() -> None:
+    if _OUT_PATH:
+        dump(_OUT_PATH)
+
+
+_STATE = _LockTraceState() if _ENABLED else None
+
+if _ENABLED and _OUT_PATH:
+    atexit.register(_dump_at_exit)

@@ -61,6 +61,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from gigapath_tpu.obs.locktrace import make_lock
+
 METRICS_SCHEMA_VERSION = 1
 
 # default latency ladder: 0.1 ms x 2^i for 24 rungs (~839 s top rung) —
@@ -259,10 +261,10 @@ class MetricsRegistry(NullMetricsRegistry):
 
     def __init__(self, *, runlog=None, interval_s: float = 60.0,
                  textfile: Optional[str] = None):
-        self.runlog = runlog
+        self.runlog = runlog  # gigarace: type gigapath_tpu.obs.runlog.RunLog
         self.interval_s = float(interval_s)
         self.textfile = textfile or None
-        self._lock = threading.Lock()
+        self._lock = make_lock("gigapath_tpu.obs.metrics.MetricsRegistry._lock")
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
@@ -274,7 +276,7 @@ class MetricsRegistry(NullMetricsRegistry):
         with self._lock:
             inst = self._counters.get(name)
             if inst is None:
-                self._check_free(name, self._counters)
+                self._check_free_locked(name, self._counters)
                 inst = self._counters[name] = Counter(name, self._lock)
             return inst
 
@@ -282,7 +284,7 @@ class MetricsRegistry(NullMetricsRegistry):
         with self._lock:
             inst = self._gauges.get(name)
             if inst is None:
-                self._check_free(name, self._gauges)
+                self._check_free_locked(name, self._gauges)
                 inst = self._gauges[name] = Gauge(name, self._lock)
             return inst
 
@@ -291,13 +293,13 @@ class MetricsRegistry(NullMetricsRegistry):
         with self._lock:
             inst = self._histograms.get(name)
             if inst is None:
-                self._check_free(name, self._histograms)
+                self._check_free_locked(name, self._histograms)
                 inst = self._histograms[name] = Histogram(
                     name, self._lock, bounds
                 )
             return inst
 
-    def _check_free(self, name: str, own: dict) -> None:
+    def _check_free_locked(self, name: str, own: dict) -> None:
         for kind in (self._counters, self._gauges, self._histograms):
             if kind is not own and name in kind:
                 raise ValueError(
@@ -531,8 +533,8 @@ class SloTracker(NullSloTracker):
         self.long_window_s = float(long_window_s)
         self.burn_threshold = float(burn_threshold)
         self.min_events = int(min_events)
-        self.runlog = runlog
-        self._lock = threading.Lock()
+        self.runlog = runlog  # gigarace: type gigapath_tpu.obs.runlog.RunLog
+        self._lock = make_lock("gigapath_tpu.obs.metrics.SloTracker._lock")
         # 1-second time bins (sec -> [events, slow]) pruned to the LONG
         # window: per-observe cost and memory are O(window seconds), not
         # O(requests in window) — a deque of every request would walk
@@ -546,7 +548,7 @@ class SloTracker(NullSloTracker):
         self.violations = 0
         self.burn_entries = 0
 
-    def _prune(self, now: float) -> None:
+    def _prune_locked(self, now: float) -> None:
         horizon = now - self.long_window_s
         while self._bins:
             first = next(iter(self._bins))
@@ -554,7 +556,7 @@ class SloTracker(NullSloTracker):
                 break
             del self._bins[first]
 
-    def _burn(self, now: float, window_s: float) -> Tuple[float, int]:
+    def _burn_locked(self, now: float, window_s: float) -> Tuple[float, int]:
         horizon = now - window_s
         n = bad = 0
         for sec in reversed(self._bins):
@@ -592,11 +594,11 @@ class SloTracker(NullSloTracker):
                 slot = self._bins[int(now)] = [0, 0]
             slot[0] += 1
             slot[1] += slow
-            self._prune(now)
+            self._prune_locked(now)
             self.total += 1
             self.violations += slow
-            burn_short, n_short = self._burn(now, self.short_window_s)
-            burn_long, n_long = self._burn(now, self.long_window_s)
+            burn_short, n_short = self._burn_locked(now, self.short_window_s)
+            burn_long, n_long = self._burn_locked(now, self.long_window_s)
             burning_now = (
                 n_long >= self.min_events
                 and burn_short >= self.burn_threshold
@@ -626,8 +628,8 @@ class SloTracker(NullSloTracker):
     def status(self, now: Optional[float] = None) -> dict:
         now = time.monotonic() if now is None else now
         with self._lock:
-            burn_short, n_short = self._burn(now, self.short_window_s)
-            burn_long, n_long = self._burn(now, self.long_window_s)
+            burn_short, n_short = self._burn_locked(now, self.short_window_s)
+            burn_long, n_long = self._burn_locked(now, self.long_window_s)
             return dict(
                 name=self.name, burning=self.burning,
                 target_s=self.target_s, budget=self.budget,

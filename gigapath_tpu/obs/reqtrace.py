@@ -46,6 +46,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from gigapath_tpu.obs.locktrace import make_lock
+
 TRACE_FILE_SUFFIX = ".trace.json"
 
 
@@ -143,14 +145,14 @@ class NullTraceCollector:
 
 class TraceCollector(NullTraceCollector):
     def __init__(self, runlog, *, max_traces: int = 4096):
-        self.runlog = runlog
+        self.runlog = runlog  # gigarace: type gigapath_tpu.obs.runlog.RunLog
         self.max_traces = int(max_traces)
         # export next to the run JSONL, named by the run FILE's stem so
         # shared-run-id ranks never clobber each other's trace file
         stem = os.path.splitext(os.path.abspath(runlog.path))[0]
         self.path = stem + TRACE_FILE_SUFFIX
         self._t0 = time.monotonic()
-        self._lock = threading.Lock()
+        self._lock = make_lock("gigapath_tpu.obs.reqtrace.TraceCollector._lock")
         self._traces: List[RequestTrace] = []
         self._next = 0
         self.dropped = 0

@@ -81,6 +81,8 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+from gigapath_tpu.obs.locktrace import attach_locktrace, make_lock
+
 SCHEMA_VERSION = 1
 
 EVENT_KINDS = (
@@ -199,7 +201,7 @@ class RunLog(NullRunLog):
         if parent:
             os.makedirs(parent, exist_ok=True)
         self._fh = open(path, "a", encoding="utf-8")
-        self._lock = threading.Lock()
+        self._lock = make_lock("gigapath_tpu.obs.runlog.RunLog._lock")
         self._closed = False
         self._observers: list = []
         self._closers: list = []
@@ -236,7 +238,7 @@ class RunLog(NullRunLog):
             self._fh.flush()
         for observer in list(self._observers):
             try:
-                observer(record)
+                observer(record)  # gigarace: calls AnomalyEngine.on_event, FlightRecorder.on_event
             except Exception:  # observers must never take a run down
                 pass
         return record
@@ -482,6 +484,11 @@ def get_run_log(driver: str, out_dir: Optional[str] = None, *,
         attach_anomaly_engine(log)
     except Exception:
         pass
+    # the lock-order sanitizer's summary rides the same stream: one
+    # ``locktrace`` event at close when GIGAPATH_LOCKTRACE=1 (no-op
+    # otherwise), rendered by obs_report's ``== locks ==`` section and
+    # consumed by ``python -m tools.gigarace --validate``
+    attach_locktrace(log)
     if run_start:
         log.run_start(config=config, probe_devices=probe_devices)
     return log

@@ -22,6 +22,8 @@ import threading
 from collections import OrderedDict
 from typing import Any, Dict, Optional
 
+from gigapath_tpu.obs.locktrace import make_lock
+
 import numpy as np
 
 
@@ -59,7 +61,7 @@ class EmbeddingCache:
         self.budget_bytes = int(budget_bytes)
         self._entries: "OrderedDict[str, Any]" = OrderedDict()
         self._sizes: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("gigapath_tpu.serve.cache.EmbeddingCache._lock")
         self.bytes = 0
         self.hits = 0
         self.misses = 0

@@ -24,6 +24,8 @@ import time
 from concurrent.futures import Future
 from typing import Callable, Dict, List, Optional
 
+from gigapath_tpu.obs.locktrace import make_condition
+
 import numpy as np
 
 
@@ -76,7 +78,7 @@ class RequestQueue:
         # EVERY submit precisely when the queue is deepest, so summing
         # the lanes there would make overloaded submits O(queue depth)
         self._pending_tokens = 0
-        self._cond = threading.Condition()
+        self._cond = make_condition("gigapath_tpu.serve.queue.RequestQueue._cond")
 
     def capacity(self, bucket_n: int) -> int:
         if self._capacity_for is None:
@@ -95,6 +97,18 @@ class RequestQueue:
         with self._cond:
             return sum(len(lane) for lane in self._lanes.values())
 
+    def pending_from_signal(self) -> Optional[int]:
+        """Pending count for the SIGTERM drain callback: the signal may
+        have interrupted a thread INSIDE a ``with self._cond:`` region,
+        so a blocking acquire here self-deadlocks the shutdown —
+        try-acquire and report None on contention (GL020 discipline)."""
+        if not self._cond.acquire(timeout=0.2):
+            return None
+        try:
+            return sum(len(lane) for lane in self._lanes.values())
+        finally:
+            self._cond.release()
+
     def pending_tokens(self) -> int:
         """Total PADDED tiles queued (each request costs its bucket's
         rung, not its raw tile count — padded tiles are what the device
@@ -104,7 +118,7 @@ class RequestQueue:
         with self._cond:
             return self._pending_tokens
 
-    def _oldest_head(self) -> Optional[SlideRequest]:
+    def _oldest_head_locked(self) -> Optional[SlideRequest]:
         heads = [lane[0] for lane in self._lanes.values() if lane]
         return min(heads, key=lambda r: r.t_submit) if heads else None
 
@@ -114,7 +128,7 @@ class RequestQueue:
         rule); None when the queue is idle."""
         now = time.monotonic() if now is None else now
         with self._cond:
-            head = self._oldest_head()
+            head = self._oldest_head_locked()
         if head is None:
             return None
         return (head.t_submit + self.max_wait_s) - now
@@ -134,7 +148,7 @@ class RequestQueue:
         now = time.monotonic() if now is None else now
         with self._cond:
             pick: Optional[SlideRequest] = None
-            head = self._oldest_head()
+            head = self._oldest_head_locked()
             if head is not None and (
                 drain or now - head.t_submit >= self.max_wait_s
             ):
@@ -175,7 +189,7 @@ class RequestQueue:
             for lane in self._lanes.values():
                 if lane and len(lane) >= self.capacity(lane[0].bucket_n):
                     return
-            head = self._oldest_head()
+            head = self._oldest_head_locked()
             if head is not None and now - head.t_submit >= self.max_wait_s:
                 return
             self._cond.wait(timeout=timeout)

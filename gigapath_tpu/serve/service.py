@@ -68,6 +68,8 @@ import time
 from dataclasses import dataclass, replace
 from typing import Any, Callable, Dict, List, Optional
 
+from gigapath_tpu.obs.locktrace import make_lock
+
 import numpy as np
 
 from gigapath_tpu.obs import (
@@ -229,7 +231,7 @@ class SlideService:
                     "identity": identity,
                 },
             )
-        self.runlog = runlog
+        self.runlog = runlog  # gigarace: type gigapath_tpu.obs.runlog.RunLog
         self.ladder = BucketLadder(
             n_min=self.config.bucket_min, growth=self.config.bucket_growth,
             n_max=self.config.bucket_max, align=self.config.bucket_align,
@@ -258,18 +260,18 @@ class SlideService:
         # both are true no-ops against a NullRunLog (obs off). The
         # instruments are resolved here once so the dispatch hot path
         # pays a bisect + scalar updates, not name lookups
-        self.metrics = get_metrics(runlog)
-        self.tracer = get_tracer(runlog)
-        self._m_submits = self.metrics.counter("serve.submits")
-        self._m_hits = self.metrics.counter("serve.cache_hits")
-        self._m_joins = self.metrics.counter("serve.inflight_joins")
-        self._m_shed = self.metrics.counter("serve.shed")
-        self._m_dispatches = self.metrics.counter("serve.dispatches")
-        self._m_slides = self.metrics.counter("serve.slides")
-        self._g_queued_tokens = self.metrics.gauge("serve.queued_tokens")
-        self._h_queue_wait = self.metrics.histogram("serve.queue_wait_s")
-        self._h_dispatch = self.metrics.histogram("serve.dispatch_s")
-        self._h_e2e = self.metrics.histogram("serve.e2e_s")
+        self.metrics = get_metrics(runlog)  # gigarace: type gigapath_tpu.obs.metrics.MetricsRegistry
+        self.tracer = get_tracer(runlog)  # gigarace: type gigapath_tpu.obs.reqtrace.TraceCollector
+        self._m_submits = self.metrics.counter("serve.submits")  # gigarace: type gigapath_tpu.obs.metrics.Counter
+        self._m_hits = self.metrics.counter("serve.cache_hits")  # gigarace: type gigapath_tpu.obs.metrics.Counter
+        self._m_joins = self.metrics.counter("serve.inflight_joins")  # gigarace: type gigapath_tpu.obs.metrics.Counter
+        self._m_shed = self.metrics.counter("serve.shed")  # gigarace: type gigapath_tpu.obs.metrics.Counter
+        self._m_dispatches = self.metrics.counter("serve.dispatches")  # gigarace: type gigapath_tpu.obs.metrics.Counter
+        self._m_slides = self.metrics.counter("serve.slides")  # gigarace: type gigapath_tpu.obs.metrics.Counter
+        self._g_queued_tokens = self.metrics.gauge("serve.queued_tokens")  # gigarace: type gigapath_tpu.obs.metrics.Gauge
+        self._h_queue_wait = self.metrics.histogram("serve.queue_wait_s")  # gigarace: type gigapath_tpu.obs.metrics.Histogram
+        self._h_dispatch = self.metrics.histogram("serve.dispatch_s")  # gigarace: type gigapath_tpu.obs.metrics.Histogram
+        self._h_e2e = self.metrics.histogram("serve.e2e_s")  # gigarace: type gigapath_tpu.obs.metrics.Histogram
         # latency SLO: multi-window error-budget burn feeding the
         # anomaly engine's slo_burn detector via `slo` events; the
         # terminal status rides the runlog's closers so clean runs still
@@ -300,7 +302,7 @@ class SlideService:
         self._draining = False
         self._sigterm_cb = None
         self._pending: Dict[str, SlideRequest] = {}  # in-flight by content
-        self._lock = threading.Lock()
+        self._lock = make_lock("gigapath_tpu.serve.service.SlideService._lock")
         self._worker: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._closed = False
@@ -344,7 +346,7 @@ class SlideService:
             return
 
         def _drain(signum) -> bool:
-            if self._draining or self._closed:
+            if self._draining or self._closed:  # gigalint: waive GL019 -- signal context cannot block on the lock; a stale read only re-runs the drain claim, which is idempotent
                 # already draining (or dead): a REPEAT SIGTERM is the
                 # operator escalating past a drain that isn't finishing
                 # (hung dispatch) — don't re-claim graceful, let the
@@ -355,14 +357,18 @@ class SlideService:
             # INSIDE runlog.event() holding its write lock — the
             # *_from_signal paths try-acquire and drop on contention
             # instead of self-deadlocking the shutdown
-            pending = self.queue.pending()
+            # try-acquire count (None on contention): the blocking
+            # queue.pending() here was gigarace GL020's first real catch
+            # — the signal can interrupt a thread holding the queue cond
+            pending = self.queue.pending_from_signal()
             self.runlog.event_from_signal(
                 "recovery", action="drain", signal=int(signum),
                 pending=pending,
             )
             self.runlog.echo_from_signal(
                 "[serve] SIGTERM: draining — new submits rejected, "
-                f"{pending} request(s) still dispatching"
+                f"{pending if pending is not None else '?'} request(s) "
+                "still dispatching"
             )
             return True  # graceful claim: don't re-raise process death
 
@@ -384,7 +390,7 @@ class SlideService:
         forward output's row for this slide (host numpy pytree).
         Cache hits and in-flight duplicates resolve without a forward
         pass (``cache_hit`` event either way)."""
-        if self._closed:
+        if self._closed:  # gigalint: waive GL019 -- racy fast-path reject; re-checked under the lock before the request is enqueued
             raise RuntimeError("SlideService is closed")
         feats = np.asarray(feats, np.float32)
         if feats.ndim != 2:
@@ -751,11 +757,16 @@ class SlideService:
     # -- summaries --------------------------------------------------------
     def stats(self) -> Dict[str, Any]:
         cache = self.cache.stats()
+        with self._lock:
+            # the submit-side counters are lock-guarded (N submitter
+            # threads); the dispatch-side ones are worker-thread-owned
+            inflight_joins = self.inflight_joins
+            shed_count = self.shed_count
         return {
             "dispatches": self.dispatch_count,
             "slides_served": self.slides_served,
-            "inflight_joins": self.inflight_joins,
-            "shed": self.shed_count,
+            "inflight_joins": inflight_joins,
+            "shed": shed_count,
             "deadline_failures": self.deadline_failures,
             "bisections": self.bisections,
             "poisoned_requests": self.poisoned_requests,
@@ -775,7 +786,7 @@ class SlideService:
         }
 
     def close(self, status: str = "ok") -> None:
-        if self._closed:
+        if self._closed:  # gigalint: waive GL019 -- racy idempotence fast-path; the flag is flipped under the lock below and a duplicate close() is harmless
             return
         if self._sigterm_cb is not None:
             from gigapath_tpu.obs.flight import unregister_signal_callback

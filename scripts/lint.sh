@@ -2,148 +2,119 @@
 # One-shot gigalint entry point for pre-commit / CI.
 #
 #   bash scripts/lint.sh            # lint the tree, exit nonzero on findings
-#   bash scripts/lint.sh --json     # machine-readable (extra args pass through)
+#   bash scripts/lint.sh --json     # ONE machine-readable verdict line
+#                                   # (other extra args pass through)
 #
 # Scans gigapath_tpu/ + scripts/ + tests/ — the same scope
 # tests/test_gigalint.py enforces on every tier-1 run — honoring the
-# GIGALINT_WAIVERS file at the repo root. Also runs:
-#   - the obs selftest (scripts/obs_report.py --selftest): RunLog ->
-#     watchdog -> spans -> forced stall -> anomaly engine (spike ->
-#     anomaly event + flight dump) -> rendered report (incl. the
-#     per-rank merge path), so a broken telemetry pipeline fails lint;
-#   - the ledger-diff selftest (scripts/ledger_diff.py --selftest): the
-#     perf regression verdict must flip on injected regressions;
-#   - the perf-history selftest (scripts/perf_history.py --selftest):
-#     the cross-round trend gate must flip on throughput dips, memory
-#     growth and lost donations, and stay blind to stale rounds;
-#   - the gigalint GL008 selftest: the seeded timing-hygiene fixture
-#     must fire (and only on the seeded violations — the negative
-#     controls are covered by tests/test_gigalint.py);
-#   - the gigalint GL012 selftest: the seeded ad-hoc-latency-aggregation
-#     fixture must fire (hand-rolled perf_counter list-append-then-sort
-#     outside obs/ — the pattern obs/metrics.py's Histogram/percentile
-#     replace);
-#   - the gigalint GL013 selftest: the seeded unbounded-channel fixture
-#     must fire (queue.Queue()/bare deque() as an inter-thread channel
-#     outside the sanctioned serve/queue.py + dist/boundary.py paths);
-#   - the gigalint GL014 selftest: the seeded chunk-reassembly fixture
-#     must fire (jnp.concatenate/stack over the chunk axis inside a
-#     streaming-sanctioned module, outside the *dense_fallback* oracle);
-#   - the gigalint GL015 selftest: the seeded raw-socket fixture must
-#     fire (socket/socketserver outside the sanctioned dist/transport.py,
-#     and blocking recv/accept/connect with no configured deadline —
-#     flagged even inside the sanctioned module);
-#   - the gigalint GL016 selftest: the seeded low-precision-cast fixture
-#     must fire (astype/asarray to int8/float8_* in library code outside
-#     the path-sanctioned quant/ module — quantization goes through
-#     gigapath_tpu/quant/qtensor.py's helper set);
-#   - the gigalint GL017 selftest: the seeded kernel-dispatch-env-read
-#     fixture must fire (GIGAPATH_* variant/block flag reads in library
-#     code outside snapshot_flags / the path-sanctioned plan/ module —
-#     dispatch resolves once through gigapath_tpu/plan/resolve_plan);
-#   - the autotune selftest (scripts/autotune.py --selftest): a blessed
-#     plan must change dispatch with zero env flags set (distinct jit
-#     cache entry + ledger fingerprint), env flags must beat the plan,
-#     and a corrupt registry must be refused into default dispatch.
+# GIGALINT_WAIVERS file at the repo root. Also runs a battery of
+# selftests, each of which must land on its expected exit code:
+#   - obs       (scripts/obs_report.py --selftest): RunLog -> watchdog ->
+#               spans -> forced stall -> anomaly engine -> flight dump ->
+#               rendered report incl. the per-rank merge and the
+#               locktrace-fed "== locks ==" section;
+#   - ledger_diff / perf_history: the perf regression + trend verdicts
+#               must flip on injected regressions;
+#   - GL008/GL012/GL013/GL014/GL015/GL016/GL017: each seeded gigalint
+#               fixture must fire (rc=1; 0 or 2 mean the rule went blind
+#               or crashed) — negative controls are covered by
+#               tests/test_gigalint.py;
+#   - GL018     (gigarace): the seeded lock-order-cycle + self-deadlock
+#               fixture must fire;
+#   - GL019     (gigarace): the seeded guarded-field-race fixture must
+#               fire (reads/writes of a lock-guarded attribute outside
+#               the lock);
+#   - GL020     (gigarace): the seeded signal-path fixture must fire
+#               (blocking acquire / print reachable from a signal
+#               handler instead of the *_from_signal try-acquire
+#               surface);
+#   - GL021     (gigarace): the seeded blocking-under-lock fixture must
+#               fire (join/wait/sleep while holding a lock);
+#   - autotune  (scripts/autotune.py --selftest): blessed-plan dispatch,
+#               env precedence, corrupt-registry refusal.
+#
+# Default mode fails fast on the first broken selftest. --json mode runs
+# EVERYTHING, then emits a single {"metric": "lint", ..., "decision":
+# {...}} line (scripts/lint_json.py) whose decision.ok folds lint
+# cleanliness and every selftest together; exit mirrors decision.ok.
 set -euo pipefail
 cd "$(dirname "$0")/.."
-python scripts/obs_report.py --selftest 1>&2
-python scripts/ledger_diff.py --selftest 1>&2
-python scripts/perf_history.py --selftest 1>&2
 
-# GL008 selftest: the seeded fixture violations MUST be found (exit 1 =
-# findings; 0 or 2 mean the rule went blind or crashed)
-set +e
-python -m tools.gigalint --no-waivers --select GL008 \
-    tools/gigalint/selftest/fixture/models/timing.py 1>&2
-gl008_rc=$?
-set -e
-if [ "$gl008_rc" -ne 1 ]; then
-    echo "GL008 selftest FAILED: expected findings (rc=1), got rc=$gl008_rc" 1>&2
-    exit 1
-fi
-echo "gigalint GL008 selftest OK" 1>&2
+JSON=0
+PASS_ARGS=()
+for a in "$@"; do
+    if [ "$a" = "--json" ]; then
+        JSON=1
+    else
+        PASS_ARGS+=("$a")
+    fi
+done
 
-# GL012 selftest: the seeded latency-aggregation fixture MUST be found
-# (exit 1 = findings; 0 or 2 mean the rule went blind or crashed)
-set +e
-python -m tools.gigalint --no-waivers --select GL012 \
-    tools/gigalint/selftest/fixture/models/latency.py 1>&2
-gl012_rc=$?
-set -e
-if [ "$gl012_rc" -ne 1 ]; then
-    echo "GL012 selftest FAILED: expected findings (rc=1), got rc=$gl012_rc" 1>&2
-    exit 1
-fi
-echo "gigalint GL012 selftest OK" 1>&2
+SELFTEST_ARGS=()
+run_selftest() {  # <name> <expected-rc> <cmd...>
+    local name="$1" expect="$2" rc
+    shift 2
+    set +e
+    "$@" 1>&2
+    rc=$?
+    set -e
+    if [ "$rc" -eq "$expect" ]; then
+        SELFTEST_ARGS+=(--selftest "$name=pass")
+        echo "lint.sh selftest $name OK" 1>&2
+    else
+        SELFTEST_ARGS+=(--selftest "$name=fail")
+        echo "lint.sh selftest $name FAILED: expected rc=$expect, got rc=$rc" 1>&2
+        if [ "$JSON" -eq 0 ]; then
+            exit 1
+        fi
+    fi
+}
 
-# GL013 selftest: the seeded unbounded-channel fixture MUST be found
-# (exit 1 = findings; 0 or 2 mean the rule went blind or crashed)
-set +e
-python -m tools.gigalint --no-waivers --select GL013 \
-    tools/gigalint/selftest/fixture/models/channels.py 1>&2
-gl013_rc=$?
-set -e
-if [ "$gl013_rc" -ne 1 ]; then
-    echo "GL013 selftest FAILED: expected findings (rc=1), got rc=$gl013_rc" 1>&2
-    exit 1
-fi
-echo "gigalint GL013 selftest OK" 1>&2
+run_selftest obs 0 python scripts/obs_report.py --selftest
+run_selftest ledger_diff 0 python scripts/ledger_diff.py --selftest
+run_selftest perf_history 0 python scripts/perf_history.py --selftest
 
-# GL014 selftest: the seeded chunk-reassembly fixture MUST be found
-# (exit 1 = findings; 0 or 2 mean the rule went blind or crashed)
-set +e
-python -m tools.gigalint --no-waivers --select GL014 \
-    tools/gigalint/selftest/fixture/ops/streaming_prefill.py 1>&2
-gl014_rc=$?
-set -e
-if [ "$gl014_rc" -ne 1 ]; then
-    echo "GL014 selftest FAILED: expected findings (rc=1), got rc=$gl014_rc" 1>&2
-    exit 1
-fi
-echo "gigalint GL014 selftest OK" 1>&2
+# Seeded-fixture selftests: rc=1 (findings) is the ONLY pass — 0 means
+# the rule went blind, 2 means it crashed.
+run_selftest GL008 1 python -m tools.gigalint --no-waivers --select GL008 \
+    tools/gigalint/selftest/fixture/models/timing.py
+run_selftest GL012 1 python -m tools.gigalint --no-waivers --select GL012 \
+    tools/gigalint/selftest/fixture/models/latency.py
+run_selftest GL013 1 python -m tools.gigalint --no-waivers --select GL013 \
+    tools/gigalint/selftest/fixture/models/channels.py
+run_selftest GL014 1 python -m tools.gigalint --no-waivers --select GL014 \
+    tools/gigalint/selftest/fixture/ops/streaming_prefill.py
+run_selftest GL015 1 python -m tools.gigalint --no-waivers --select GL015 \
+    tools/gigalint/selftest/fixture/models/sockets.py
+run_selftest GL016 1 python -m tools.gigalint --no-waivers --select GL016 \
+    tools/gigalint/selftest/fixture/models/lowprec.py
+run_selftest GL017 1 python -m tools.gigalint --no-waivers --select GL017 \
+    tools/gigalint/selftest/fixture/models/dispatch.py
 
-# GL015 selftest: the seeded raw-socket fixture MUST be found
-# (exit 1 = findings; 0 or 2 mean the rule went blind or crashed)
-set +e
-python -m tools.gigalint --no-waivers --select GL015 \
-    tools/gigalint/selftest/fixture/models/sockets.py 1>&2
-gl015_rc=$?
-set -e
-if [ "$gl015_rc" -ne 1 ]; then
-    echo "GL015 selftest FAILED: expected findings (rc=1), got rc=$gl015_rc" 1>&2
-    exit 1
-fi
-echo "gigalint GL015 selftest OK" 1>&2
-
-# GL016 selftest: the seeded low-precision-cast fixture MUST be found
-# (exit 1 = findings; 0 or 2 mean the rule went blind or crashed)
-set +e
-python -m tools.gigalint --no-waivers --select GL016 \
-    tools/gigalint/selftest/fixture/models/lowprec.py 1>&2
-gl016_rc=$?
-set -e
-if [ "$gl016_rc" -ne 1 ]; then
-    echo "GL016 selftest FAILED: expected findings (rc=1), got rc=$gl016_rc" 1>&2
-    exit 1
-fi
-echo "gigalint GL016 selftest OK" 1>&2
-
-# GL017 selftest: the seeded kernel-dispatch-env-read fixture MUST be
-# found (exit 1 = findings; 0 or 2 mean the rule went blind or crashed)
-set +e
-python -m tools.gigalint --no-waivers --select GL017 \
-    tools/gigalint/selftest/fixture/models/dispatch.py 1>&2
-gl017_rc=$?
-set -e
-if [ "$gl017_rc" -ne 1 ]; then
-    echo "GL017 selftest FAILED: expected findings (rc=1), got rc=$gl017_rc" 1>&2
-    exit 1
-fi
-echo "gigalint GL017 selftest OK" 1>&2
+# gigarace (lock-discipline) seeded fixtures — same rc=1 contract
+run_selftest GL018 1 python -m tools.gigalint --no-waivers --select GL018 \
+    tools/gigarace/selftest/fixture/deadlock.py
+run_selftest GL019 1 python -m tools.gigalint --no-waivers --select GL019 \
+    tools/gigarace/selftest/fixture/races.py
+run_selftest GL020 1 python -m tools.gigalint --no-waivers --select GL020 \
+    tools/gigarace/selftest/fixture/sigpath.py
+run_selftest GL021 1 python -m tools.gigalint --no-waivers --select GL021 \
+    tools/gigarace/selftest/fixture/joinwait.py
 
 # autotune selftest: blessed-plan dispatch, env precedence, corrupt
 # registry refusal — the plan half of the dispatch refactor
-JAX_PLATFORMS=cpu python scripts/autotune.py --selftest 1>&2
+run_selftest autotune 0 env JAX_PLATFORMS=cpu python scripts/autotune.py --selftest
 
-exec python -m tools.gigalint gigapath_tpu scripts tests "$@"
+if [ "$JSON" -eq 1 ]; then
+    LINT_OUT="$(mktemp)"
+    trap 'rm -f "$LINT_OUT"' EXIT
+    set +e
+    python -m tools.gigalint --json --strict-waivers \
+        gigapath_tpu scripts tests \
+        ${PASS_ARGS[@]+"${PASS_ARGS[@]}"} > "$LINT_OUT"
+    set -e
+    exec python scripts/lint_json.py "${SELFTEST_ARGS[@]}" < "$LINT_OUT"
+fi
+
+exec python -m tools.gigalint --strict-waivers gigapath_tpu scripts tests \
+    ${PASS_ARGS[@]+"${PASS_ARGS[@]}"}

@@ -1,0 +1,83 @@
+"""Fold ``gigalint --json`` output + selftest verdicts into one line.
+
+    python -m tools.gigalint --json ... > /tmp/lint.json
+    python scripts/lint_json.py --selftest obs=pass --selftest GL008=pass \
+        < /tmp/lint.json
+
+Emits a single machine-readable line in the same shape as bench.py /
+ab_dilated verdicts — a ``metric`` tag, flat data fields, and a
+``decision`` object of booleans — so CI can grep one line instead of
+parsing multi-line reports:
+
+    {"metric": "lint", "scanned_files": 187, "findings": 0, ...,
+     "per_rule": {}, "selftests": {"obs": true, ...},
+     "decision": {"lint_clean": true, "selftests_pass": true, "ok": true}}
+
+Exit 0 iff ``decision.ok`` (lint clean AND every selftest passed).
+``scripts/lint.sh --json`` is the driver: it runs every selftest in
+record-don't-abort mode, then pipes the full-tree gigalint JSON here.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import sys
+from typing import List, Optional
+
+
+def verdict(lint: dict, selftests: "collections.OrderedDict") -> dict:
+    per_rule: dict = collections.Counter(
+        f["rule"] for f in lint.get("findings", ()))
+    lint_clean = lint.get("exit_code", 2) == 0
+    selftests_pass = all(selftests.values()) and bool(selftests)
+    return {
+        "metric": "lint",
+        "scanned_files": lint.get("scanned_files", 0),
+        "findings": len(lint.get("findings", ())),
+        "waived": len(lint.get("waived", ())),
+        "errors": len(lint.get("errors", ())),
+        "per_rule": dict(sorted(per_rule.items())),
+        "selftests": dict(selftests),
+        "decision": {
+            "lint_clean": lint_clean,
+            "selftests_pass": selftests_pass,
+            "ok": lint_clean and selftests_pass,
+        },
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python scripts/lint_json.py",
+        description="one-line lint verdict (reads gigalint --json on stdin)",
+    )
+    ap.add_argument("--selftest", action="append", default=[],
+                    metavar="NAME=pass|fail",
+                    help="record one selftest result (repeatable)")
+    args = ap.parse_args(argv)
+
+    selftests: "collections.OrderedDict[str, bool]" = collections.OrderedDict()
+    for item in args.selftest:
+        name, _, state = item.partition("=")
+        if not name or state not in ("pass", "fail"):
+            print(f"error: bad --selftest {item!r} (want NAME=pass|fail)",
+                  file=sys.stderr)
+            return 2
+        selftests[name] = state == "pass"
+
+    try:
+        lint = json.load(sys.stdin)
+    except json.JSONDecodeError as e:
+        print(f"error: stdin is not gigalint --json output: {e}",
+              file=sys.stderr)
+        return 2
+
+    payload = verdict(lint, selftests)
+    print(json.dumps(payload))
+    return 0 if payload["decision"]["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -20,7 +20,11 @@ cross-stage boundary: backpressure episodes per channel with queue
 depth/capacity, lost workers with lease-expiry context), latency (the typed
 metrics registry's last ``metrics`` snapshot: per-histogram
 p50/p90/p99/max plus counters and gauges), slo (burn-rate transitions
-and the terminal error-budget status from the ``SloTracker``), traces
+and the terminal error-budget status from the ``SloTracker``), locks
+(the ``GIGAPATH_LOCKTRACE=1`` sanitizer's dumps: per-lock hold-time
+p50/p99, contention counts, the observed acquisition-order edges, and
+any order violations — cross-check against the static graph with
+``python -m tools.gigarace --validate``), traces
 (the per-run Perfetto-loadable request-trace export: trace/span
 totals + path), eval history, timeline
 (heartbeats, stalls, silent gaps between consecutive events). Passing a flight recorder dump
@@ -506,6 +510,61 @@ def render(events: List[dict], out=None) -> int:
                 )
         w("\n")
 
+    # -- locks (obs/locktrace.py: lock-order sanitizer dumps) --------------
+    lock_events = by_kind.get("locktrace", [])
+    if lock_events:
+        w("== locks ==\n")
+        locks: set = set()
+        edges: Dict[str, int] = {}
+        violations: List[str] = []
+        contention: Dict[str, int] = {}
+        # holds can't be merged exactly across processes (percentiles
+        # don't compose) — counts/totals sum, p50/p99 take the worst
+        # process, which is the one a human chases anyway
+        holds: Dict[str, dict] = {}
+        for ev in lock_events:
+            locks.update(str(x) for x in ev.get("locks", ()))
+            for cnt_key, n in (ev.get("edge_counts") or {}).items():
+                edges[str(cnt_key)] = edges.get(str(cnt_key), 0) + int(n)
+            violations.extend(str(v) for v in ev.get("violations", ()))
+            for name, n in (ev.get("contention") or {}).items():
+                contention[str(name)] = contention.get(str(name), 0) + int(n)
+            for name, h in (ev.get("holds") or {}).items():
+                agg = holds.setdefault(
+                    str(name),
+                    {"count": 0, "total_ms": 0.0, "p50_ms": 0.0,
+                     "p99_ms": 0.0},
+                )
+                agg["count"] += int(h.get("count", 0))
+                agg["total_ms"] += float(h.get("total_ms", 0.0))
+                agg["p50_ms"] = max(agg["p50_ms"], float(h.get("p50_ms", 0)))
+                agg["p99_ms"] = max(agg["p99_ms"], float(h.get("p99_ms", 0)))
+        w(f"sanitizer dumps: {len(lock_events)}, locks observed: "
+          f"{len(locks)}, order edges: {len(edges)}, violations: "
+          f"{len(violations)}\n")
+        if holds:
+            w("hold times (count-summed; p50/p99 from the worst process):\n")
+            for name in sorted(holds):
+                h = holds[name]
+                w(
+                    f"  {name}: n={h['count']} total {h['total_ms']:.3f}ms "
+                    f"p50 {h['p50_ms']:.3f}ms p99 {h['p99_ms']:.3f}ms"
+                    + (f" contention x{contention[name]}"
+                       if contention.get(name) else "")
+                    + "\n"
+                )
+        if edges:
+            w("acquisition order observed:\n")
+            for cnt_key in sorted(edges):
+                w(f"  {cnt_key} x{edges[cnt_key]}\n")
+        for v in violations:
+            w(f"  VIOLATION: {v}\n")
+        if violations:
+            w(f"WARNING: {len(violations)} lock-order/self-deadlock "
+              f"violation(s) — run python -m tools.gigarace --validate "
+              f"on this file\n")
+        w("\n")
+
     # -- traces (obs/reqtrace.py: per-run Chrome-trace export) -------------
     trace_events = by_kind.get("trace", [])
     if trace_events:
@@ -656,6 +715,33 @@ def selftest() -> int:
             "dist.reconnects": 1, "dist.frame_errors": 2,
             "dist.bytes_sent": 65536,
         }, gauges={}, histograms={})
+        # lock-sanitizer telemetry (gigapath_tpu.obs.locktrace): the
+        # exact payload attach_locktrace's closer emits when the run
+        # executes under GIGAPATH_LOCKTRACE=1 — synthesized here because
+        # locktrace reads its env flag once at import (the off-path must
+        # stay plain threading primitives, pinned by test_locktrace.py)
+        log.event(
+            "locktrace",
+            locks=["gigapath_tpu.serve.service.SlideService._lock",
+                   "gigapath_tpu.obs.metrics.MetricsRegistry._lock"],
+            edges=[["gigapath_tpu.serve.service.SlideService._lock",
+                    "gigapath_tpu.obs.metrics.MetricsRegistry._lock"]],
+            edge_counts={
+                "gigapath_tpu.serve.service.SlideService._lock -> "
+                "gigapath_tpu.obs.metrics.MetricsRegistry._lock": 12,
+            },
+            violations=[],
+            contention={
+                "gigapath_tpu.obs.metrics.MetricsRegistry._lock": 3},
+            holds={
+                "gigapath_tpu.serve.service.SlideService._lock": {
+                    "count": 40, "total_ms": 8.4,
+                    "p50_ms": 0.12, "p99_ms": 1.75},
+                "gigapath_tpu.obs.metrics.MetricsRegistry._lock": {
+                    "count": 52, "total_ms": 2.6,
+                    "p50_ms": 0.03, "p99_ms": 0.4},
+            },
+        )
 
         # -- a REAL traced smoke: submit -> dispatch -> resolve through
         # the serving RequestQueue, with request traces, latency
@@ -780,6 +866,15 @@ def selftest() -> int:
                 "RESUME at", "past 1 corrupt checkpoint(s)",
                 "DATA_RETRY at", "sample 3, after 3 attempt(s)",
                 "SHED at", "4096 queued tokens vs budget 4096",
+                "== locks ==",
+                "sanitizer dumps: 1, locks observed: 2, order edges: 1, "
+                "violations: 0",
+                "SlideService._lock: n=40 total 8.400ms "
+                "p50 0.120ms p99 1.750ms",
+                "MetricsRegistry._lock: n=52 total 2.600ms "
+                "p50 0.030ms p99 0.400ms contention x3",
+                "acquisition order observed:",
+                "MetricsRegistry._lock x12",
                 "== dist ==", "backpressure episodes: 2",
                 "channel 'dir': 2 episode(s), capacity 4, "
                 "max queue depth 4",

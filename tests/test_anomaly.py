@@ -339,7 +339,7 @@ class TestFlight:
         stem = os.path.splitext(os.path.basename(log.path))[0]
         assert f"-p{os.getpid()}" in stem
         assert os.path.basename(log.flight.path) == f"flight-{stem}.jsonl"
-        trace_dir = log.anomaly._next_trace_dir("x")
+        trace_dir = log.anomaly._next_trace_dir_locked("x")
         assert os.path.basename(trace_dir).startswith(f"{stem}-x-")
         log.close()
 

@@ -223,3 +223,105 @@ def test_lint_sh_exists_and_points_at_the_tool():
         body = f.read()
     assert "tools.gigalint" in body
     assert os.access(script, os.X_OK), "lint.sh must be executable"
+
+
+# ---------------------------------------------------------------------------
+# stale waivers: matched-but-unused entries are ERRORS, not warnings
+# ---------------------------------------------------------------------------
+
+STALE_FIXTURE_WAIVERS = "tools/gigalint/selftest/stale_waivers/WAIVERS"
+
+
+def test_stale_waiver_fixture_classifies_all_three_ways():
+    """The committed fixture seeds one USED entry, one STALE entry
+    (glob in scope, suppresses nothing -> error, exit 2), and one
+    OUT-OF-SCOPE entry (warning only)."""
+    result = run_lint(
+        ["tools/gigalint/selftest/fixture/models/timing.py"],
+        root=REPO_ROOT, waiver_file=STALE_FIXTURE_WAIVERS,
+        strict_waivers=True,
+    )
+    assert result.exit_code == 2
+    stale = [e for e in result.errors if "stale waiver" in e]
+    assert len(stale) == 1, result.errors
+    assert "no_such_symbol_seeded_stale" in stale[0]
+    # it names the waiver file line so the purge is one click away
+    assert STALE_FIXTURE_WAIVERS + ":" in stale[0]
+    assert result.unused_waivers == [
+        "GL008 gigapath_tpu/models/no_such_file_seeded.py"
+    ]
+    # the used entry raised no complaint of either kind
+    assert not any("USED" in e for e in result.errors)
+
+
+def test_stale_waiver_silent_under_select():
+    """With --select a waiver's rule may simply not have run — no stale
+    errors, no unused warnings (pruning on partial evidence would break
+    the full run)."""
+    result = run_lint(
+        ["tools/gigalint/selftest/fixture/models/timing.py"],
+        root=REPO_ROOT, waiver_file=STALE_FIXTURE_WAIVERS,
+        select=["GL004"], strict_waivers=True,
+    )
+    assert not any("stale waiver" in e for e in result.errors)
+    assert result.unused_waivers == []
+
+
+def test_repo_waiver_file_has_no_stale_entries():
+    """The purge contract: lint.sh's canonical strict scan must never
+    carry a matched-but-dead suppression at HEAD. (Strict only holds on
+    the FULL scope — reachability rules draw evidence from tests/.)"""
+    result = run_lint(["gigapath_tpu", "scripts", "tests"], root=REPO_ROOT,
+                      strict_waivers=True)
+    stale = [e for e in result.errors if "stale waiver" in e]
+    assert stale == [], "\n".join(stale)
+    assert result.exit_code == 0
+
+
+# ---------------------------------------------------------------------------
+# --jobs: parallel parsing is invisible in the output
+# ---------------------------------------------------------------------------
+
+def _fingerprint(result):
+    return (
+        [(f.rule, f.path, f.lineno, f.symbol, f.message)
+         for f in result.findings],
+        [(f.rule, f.path, f.lineno, f.symbol, f.waived_by)
+         for f in result.waived],
+        result.errors,
+        result.scanned,
+        result.unused_waivers,
+    )
+
+
+def test_jobs_output_is_deterministic():
+    """Findings, waivers, errors and their ORDER are byte-identical at
+    any parallelism — Executor.map pins module order to discovery
+    order, and everything downstream sorts."""
+    serial = run_lint([FIXTURE], root=REPO_ROOT, waiver_file=None, jobs=1)
+    for jobs in (2, 8):
+        parallel = run_lint(
+            [FIXTURE], root=REPO_ROOT, waiver_file=None, jobs=jobs,
+        )
+        assert _fingerprint(parallel) == _fingerprint(serial), (
+            f"jobs={jobs} changed the output"
+        )
+    assert serial.findings, "fixture scan should find the seeded violations"
+
+
+def test_jobs_parse_errors_keep_position(tmp_path):
+    """A syntactically broken file reports the same error at the same
+    list position regardless of which worker hit it."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "a_ok.py").write_text("x = 1\n")
+    (pkg / "broken.py").write_text("def f(:\n")
+    (pkg / "z_ok.py").write_text("y = 2\n")
+    results = [
+        run_lint(["pkg"], root=str(tmp_path), waiver_file=None, jobs=jobs)
+        for jobs in (1, 4)
+    ]
+    for r in results:
+        assert r.scanned == 2
+        assert len(r.errors) == 1 and "syntax error" in r.errors[0]
+    assert results[0].errors == results[1].errors

@@ -1,0 +1,341 @@
+"""gigarace wiring: lock discipline holds at HEAD, and the pass works.
+
+Mirrors tests/test_gigalint.py's contract pair for the four
+lock-discipline rules (GL018 deadlock cycles / self-deadlock, GL019
+guarded-field races, GL020 signal-path blocking, GL021 blocking under
+lock):
+
+1. The library tree is CLEAN — zero unwaived findings — so every rule
+   runs on every ``pytest -q`` and every ``scripts/lint.sh``.
+2. The seeded fixture tree under tools/gigarace/selftest/fixture/
+   fires EXACTLY its seeded violations (counts and line numbers) while
+   the negative controls stay silent — the rules neither go blind nor
+   over-fire.
+
+Plus the model's supporting surfaces: the lock inventory, the static
+order graph, the annotation mechanisms, and the --validate consumer's
+static-vs-runtime drift check.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = "tools/gigarace/selftest/fixture"
+
+sys.path.insert(0, REPO_ROOT)
+
+from tools.gigalint.cli import run_lint  # noqa: E402
+from tools.gigarace.cli import (  # noqa: E402
+    graph_dict,
+    load_model,
+    render_inventory,
+    validate_traces,
+)
+from tools.gigarace.rules import RACE_RULES  # noqa: E402
+
+RACE_SELECT = sorted(RACE_RULES)
+
+
+def _fixture_findings(path):
+    result = run_lint(
+        [f"{FIXTURE}/{path}"], root=REPO_ROOT,
+        waiver_file=None, select=RACE_SELECT,
+    )
+    assert result.errors == []
+    return result.findings
+
+
+# ---------------------------------------------------------------------------
+# contract 1: the library is clean
+# ---------------------------------------------------------------------------
+
+def test_library_is_clean():
+    result = run_lint(
+        ["gigapath_tpu", "scripts", "tests"], root=REPO_ROOT,
+        select=RACE_SELECT,
+    )
+    assert result.errors == []
+    assert result.findings == [], "\n".join(f.text() for f in result.findings)
+
+
+# ---------------------------------------------------------------------------
+# contract 2: the seeded fixtures fire exactly as seeded
+# ---------------------------------------------------------------------------
+
+def test_deadlock_fixture_fires_exactly():
+    findings = _fixture_findings("deadlock.py")
+    got = sorted((f.rule, f.lineno) for f in findings)
+    assert got == [("GL018", 21), ("GL018", 37)], (
+        "\n".join(f.text() for f in findings)
+    )
+    # one cycle finding, one self-deadlock finding
+    texts = "\n".join(f.text() for f in findings)
+    assert "cycle" in texts
+    assert "already held" in texts or "re-acquir" in texts or \
+        "self-deadlock" in texts
+
+
+def test_races_fixture_fires_exactly():
+    findings = _fixture_findings("races.py")
+    got = sorted((f.rule, f.lineno) for f in findings)
+    assert got == [("GL019", 26), ("GL019", 29), ("GL019", 29)], (
+        "\n".join(f.text() for f in findings)
+    )
+
+
+def test_sigpath_fixture_fires_exactly():
+    findings = _fixture_findings("sigpath.py")
+    got = sorted((f.rule, f.lineno) for f in findings)
+    assert got == [("GL020", 33), ("GL020", 36), ("GL020", 57)], (
+        "\n".join(f.text() for f in findings)
+    )
+    texts = "\n".join(f.text() for f in findings)
+    assert "print" in texts          # the buffered-stdio arm
+    assert "_from_signal" in texts   # the prescribed discipline
+
+
+def test_joinwait_fixture_fires_exactly():
+    findings = _fixture_findings("joinwait.py")
+    got = sorted((f.rule, f.lineno) for f in findings)
+    assert got == [("GL021", 22), ("GL021", 26), ("GL021", 43)], (
+        "\n".join(f.text() for f in findings)
+    )
+
+
+def test_fixture_negative_controls_stay_silent():
+    result = run_lint(
+        [FIXTURE], root=REPO_ROOT, waiver_file=None, select=RACE_SELECT,
+    )
+    for f in result.findings:
+        assert "negative_control" not in f.symbol, f.text()
+        assert "OrderedPair" not in f.symbol, f.text()
+
+
+def test_gigalint_fixture_tree_stays_quiet_for_race_rules():
+    """The race rules must not over-fire on gigalint's own (unrelated)
+    seeded-violation tree — rule isolation, both directions."""
+    result = run_lint(
+        ["tools/gigalint/selftest/fixture"], root=REPO_ROOT,
+        waiver_file=None, select=RACE_SELECT,
+    )
+    assert result.findings == [], "\n".join(f.text() for f in result.findings)
+
+
+# ---------------------------------------------------------------------------
+# the model's supporting surfaces
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def library_model():
+    model, errors = load_model(["gigapath_tpu"], root=REPO_ROOT)
+    assert errors == []
+    return model
+
+
+def test_inventory_covers_the_known_lock_set(library_model):
+    table = render_inventory(library_model)
+    for needle in (
+        "gigapath_tpu.serve.service.SlideService._lock",
+        "gigapath_tpu.serve.queue.RequestQueue._cond",
+        "gigapath_tpu.serve.cache.EmbeddingCache._lock",
+        "gigapath_tpu.obs.runlog.RunLog._lock",
+        "gigapath_tpu.obs.metrics.MetricsRegistry._lock",
+        "gigapath_tpu.obs.anomaly.AnomalyEngine._lock",
+        "gigapath_tpu.dist.boundary.MemoryChannel._cond",
+    ):
+        assert needle in table, f"inventory lost {needle}"
+    assert table.splitlines()[0] == (
+        "| lock | kind | declared at | guarded fields |")
+    # the guarded-fields column carries the GL019 resolution
+    assert "`SlideService._pending`" in table
+    assert "`MemoryChannel._queue`" in table
+
+
+def test_inventory_matches_readme(library_model):
+    """The README's "Concurrency discipline" table is generated by
+    --inventory; regen-and-paste, never hand-edit. This pins the two
+    against drift."""
+    table = render_inventory(library_model)
+    with open(os.path.join(REPO_ROOT, "README.md"), encoding="utf-8") as fh:
+        readme = fh.read()
+    assert table in readme, (
+        "README lock table is stale — regenerate with "
+        "`python -m tools.gigarace --inventory` and paste it into the "
+        "'Concurrency discipline' section"
+    )
+
+
+def test_graph_is_acyclic_at_head(library_model):
+    g = graph_dict(library_model)
+    assert g["cycles"] == []
+    assert g["self_deadlocks"] == []
+    edges = {(e["src"], e["dst"]) for e in g["edges"]}
+    # the serving dispatch loop's nesting is the load-bearing order
+    assert ("gigapath_tpu.serve.service.SlideService._lock",
+            "gigapath_tpu.obs.metrics.MetricsRegistry._lock") in edges
+
+
+def test_validate_accepts_covered_trace(library_model, tmp_path):
+    g = graph_dict(library_model)
+    edge = g["edges"][0]
+    trace = tmp_path / "run.jsonl"
+    trace.write_text(json.dumps({
+        "kind": "locktrace",
+        "locks": [edge["src"], edge["dst"]],
+        "edges": [[edge["src"], edge["dst"]]],
+        "violations": [],
+    }) + "\n")
+    problems, stats = validate_traces(library_model, [str(trace)])
+    assert problems == []
+    assert stats["payloads"] == 1
+    assert stats["covered_edges"] == 1 == stats["observed_edges"]
+
+
+def test_validate_flags_drift(library_model, tmp_path):
+    src = "gigapath_tpu.serve.service.SlideService._lock"
+    dst = "gigapath_tpu.obs.runlog.RunLog._lock"
+    trace = tmp_path / "run.jsonl"
+    trace.write_text("\n".join([
+        # unknown lock name: runtime/static naming drift
+        json.dumps({"kind": "locktrace",
+                    "locks": ["no.such.Lock"], "edges": []}),
+        # observed order with no static edge (reversed nesting)
+        json.dumps({"kind": "locktrace", "locks": [src, dst],
+                    "edges": [[dst, src]]}),
+        # a runtime violation is a problem verbatim
+        json.dumps({"kind": "locktrace", "locks": [], "edges": [],
+                    "violations": ["lock order inversion: x vs y"]}),
+        # non-locktrace runlog records are skipped, not misparsed
+        json.dumps({"kind": "step", "t": 0.0}),
+    ]) + "\n")
+    problems, stats = validate_traces(library_model, [str(trace)])
+    assert stats["payloads"] == 3
+    assert any("no.such.Lock" in p for p in problems)
+    assert any("no static edge" in p for p in problems)
+    assert any("inversion" in p for p in problems)
+
+
+def test_validate_empty_file_is_a_problem(library_model, tmp_path):
+    trace = tmp_path / "empty.jsonl"
+    trace.write_text("")
+    problems, stats = validate_traces(library_model, [str(trace)])
+    assert stats["payloads"] == 0
+    assert any("no locktrace payloads" in p for p in problems)
+
+
+# ---------------------------------------------------------------------------
+# annotation mechanisms
+# ---------------------------------------------------------------------------
+
+def _lint_snippet(tmp_path, source, select):
+    mod = tmp_path / "gigapath_tpu" / "snippet.py"
+    mod.parent.mkdir(parents=True, exist_ok=True)
+    mod.write_text(source)
+    result = run_lint(
+        ["gigapath_tpu/snippet.py"], root=str(tmp_path),
+        waiver_file=None, select=select,
+    )
+    assert result.errors == []
+    return result.findings
+
+
+def test_guarded_by_annotation_declares_discipline(tmp_path):
+    findings = _lint_snippet(tmp_path, (
+        "import threading\n"
+        "\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0  # gigarace: guarded-by _lock\n"
+        "\n"
+        "    def peek(self):\n"
+        "        return self._n\n"
+    ), ["GL019"])
+    assert [(f.rule, f.lineno) for f in findings] == [("GL019", 9)]
+
+
+def test_unguarded_annotation_opts_out(tmp_path):
+    findings = _lint_snippet(tmp_path, (
+        "import threading\n"
+        "\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0  # gigarace: unguarded -- monotonic flag\n"
+        "\n"
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self._n += 1\n"
+        "\n"
+        "    def peek(self):\n"
+        "        return self._n\n"
+    ), ["GL019"])
+    assert findings == []
+
+
+def test_calls_hint_feeds_the_order_graph(tmp_path):
+    """# gigarace: calls closes the dynamic-dispatch blind spot: the
+    hinted callee's acquisition shows up as a static edge under the
+    caller's held lock."""
+    mod = tmp_path / "gigapath_tpu" / "obsish.py"
+    mod.parent.mkdir(parents=True, exist_ok=True)
+    mod.write_text(
+        "import threading\n"
+        "\n"
+        "class Sink:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "\n"
+        "    def on_event(self, ev):\n"
+        "        with self._lock:\n"
+        "            return ev\n"
+        "\n"
+        "class Hub:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._observers = []\n"
+        "\n"
+        "    def emit(self, ev):\n"
+        "        with self._lock:\n"
+        "            for obs in self._observers:\n"
+        "                obs(ev)  # gigarace: calls Sink.on_event\n"
+    )
+    model, errors = load_model(["gigapath_tpu"], root=str(tmp_path))
+    assert errors == []
+    edges = set(model.edges)
+    assert ("gigapath_tpu.obsish.Hub._lock",
+            "gigapath_tpu.obsish.Sink._lock") in edges
+
+
+# ---------------------------------------------------------------------------
+# CLI surfaces
+# ---------------------------------------------------------------------------
+
+def test_cli_rule_mode_exit_codes():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.gigarace", "gigapath_tpu"],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.gigarace", "--no-waivers", FIXTURE],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=600,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+
+
+def test_cli_graph_json_shape():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.gigarace", "--graph", "gigapath_tpu"],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr
+    g = json.loads(proc.stdout)
+    assert g["version"] == 1
+    assert g["cycles"] == [] and g["self_deadlocks"] == []
+    assert g["locks"] and g["edges"]

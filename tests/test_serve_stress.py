@@ -1,0 +1,181 @@
+"""Multi-threaded serving stress under the lock-order sanitizer.
+
+The serving stack's full concurrency surface — N submitter threads,
+cache hits, in-flight joins, the background dispatch worker, and a
+forced poisoned-batch bisection — driven in ONE subprocess with
+``GIGAPATH_LOCKTRACE=1``, so every library lock is wrapped and every
+acquisition order recorded. The run must:
+
+1. produce EXACT metric counts (submits / cache hits / joins / slides
+   served / poisoned) — concurrency may reorder work but never lose or
+   double-count it;
+2. record ZERO sanitizer violations (no order inversions, no
+   non-reentrant re-acquires) while all of that interleaves;
+3. dump a locktrace whose observed acquisition orders are fully covered
+   by gigarace's static lock graph (``--validate`` exit 0) — the
+   ISSUE's static-vs-runtime no-drift acceptance, under load rather
+   than a smoke.
+
+The subprocess is required because locktrace reads its env flag once at
+import (the off-path must stay plain primitives; tests/test_locktrace.py
+pins that side).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+# Phase plan (deterministic counts by construction):
+#   A. 4 threads x 3 unique slides, worker NOT started -> 12 queued
+#      requests; then 4 duplicate-content submits -> 4 in-flight joins;
+#      drain() -> 12 slides served.
+#   B. 4 threads resubmit all 12 contents -> 12 cache hits (resolved
+#      futures, no dispatch).
+#   C. chaos poison@bad: 1 poisoned + 2 good slides in one bucket ->
+#      bisection isolates the bad future, 2 more slides served.
+#   D. worker STARTED: 4 threads x 2 new slides race the dispatch
+#      thread -> 8 more slides served through the async path.
+# Totals: submits 39, cache hits 12, joins 4, served 22, poisoned 1.
+_SCRIPT = r"""
+import json, sys
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from gigapath_tpu.obs import locktrace
+assert locktrace.enabled(), "stress run requires GIGAPATH_LOCKTRACE=1"
+
+from gigapath_tpu.serve.service import ServeConfig, SlideService
+
+def forward(params, embeds, coords, pad_mask):
+    m = pad_mask[..., None].astype(embeds.dtype)
+    return (embeds * m).sum(axis=1) / m.sum(axis=1).clip(1.0)
+
+out_dir = sys.argv[1]
+config = ServeConfig(
+    max_batch=4, max_wait_s=0.01, bucket_min=16, bucket_growth=2.0,
+    bucket_max=32, bucket_align=16, feature_dim=8, artifact_dir=None,
+)
+service = SlideService(forward, {}, config=config, out_dir=out_dir,
+                       identity="stress")
+rng = np.random.default_rng(0)
+
+def mk(n):
+    return (rng.normal(size=(n, 8)).astype(np.float32),
+            rng.uniform(0, 1000, (n, 2)).astype(np.float32))
+
+N_THREADS, PER = 4, 3
+uniq = {f"u{t}_{i}": mk(4 + 2 * t + i)
+        for t in range(N_THREADS) for i in range(PER)}
+
+# -- phase A: concurrent unique submits + in-flight joins (no worker) --
+futs = {}
+def submit_batch(t):
+    return [(sid, service.submit(sid, *uniq[sid]))
+            for sid in (f"u{t}_{i}" for i in range(PER))]
+with ThreadPoolExecutor(max_workers=N_THREADS) as pool:
+    for lst in pool.map(submit_batch, range(N_THREADS)):
+        futs.update(dict(lst))
+for t in range(N_THREADS):
+    sid = f"u{t}_0"
+    jf = service.submit(f"dup_{t}", *uniq[sid])
+    assert jf is futs[sid], "duplicate content must join the pending request"
+service.drain()
+results = {sid: f.result(timeout=60) for sid, f in futs.items()}
+assert all(np.isfinite(r).all() for r in results.values())
+
+# -- phase B: every content again -> pure cache hits ------------------
+def hit(sid):
+    f = service.submit("hit_" + sid, *uniq[sid])
+    return np.allclose(f.result(timeout=60), results[sid])
+with ThreadPoolExecutor(max_workers=N_THREADS) as pool:
+    assert all(pool.map(hit, sorted(uniq)))
+
+# -- phase C: forced poisoned-batch bisection --------------------------
+fb = service.submit("bad", *mk(6))
+f1 = service.submit("good1", *mk(7))
+f2 = service.submit("good2", *mk(9))
+service.drain()
+try:
+    fb.result(timeout=60)
+    raise SystemExit("poisoned future must raise")
+except Exception as e:
+    assert "poison" in str(e), f"unexpected failure: {e!r}"
+assert np.isfinite(f1.result(timeout=60)).all()
+assert np.isfinite(f2.result(timeout=60)).all()
+
+# -- phase D: the async worker races 4 submitter threads --------------
+service.start()
+def late(t):
+    return [service.submit(f"late{t}_{i}", *mk(5 + t + i)).result(timeout=60)
+            for i in range(2)]
+with ThreadPoolExecutor(max_workers=N_THREADS) as pool:
+    late_results = [r for lst in pool.map(late, range(N_THREADS))
+                    for r in lst]
+assert all(np.isfinite(r).all() for r in late_results)
+
+stats = service.stats()
+counters = {c: service.metrics.counter(c).value
+            for c in ("serve.submits", "serve.cache_hits",
+                      "serve.inflight_joins", "serve.slides")}
+service.close()
+trace = locktrace.summary()
+print(json.dumps({"stats": stats, "counters": counters,
+                  "violations": trace["violations"],
+                  "observed_edges": trace["edges"]}))
+"""
+
+
+def test_serve_stress_under_locktrace(tmp_path):
+    trace_path = tmp_path / "trace.jsonl"
+    script = tmp_path / "stress.py"
+    script.write_text(_SCRIPT)
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO_ROOT,
+        "GIGAPATH_LOCKTRACE": "1",
+        "GIGAPATH_LOCKTRACE_OUT": str(trace_path),
+        "GIGAPATH_CHAOS": "poison@bad",
+    })
+    proc = subprocess.run(
+        [sys.executable, str(script), str(tmp_path / "obs")],
+        capture_output=True, text=True, cwd=REPO_ROOT, env=env,
+        timeout=540,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+
+    # exact counts: the concurrency changed the order, never the totals
+    assert payload["counters"] == {
+        "serve.submits": 39.0,
+        "serve.cache_hits": 12.0,
+        "serve.inflight_joins": 4.0,
+        "serve.slides": 22.0,
+    }
+    stats = payload["stats"]
+    assert stats["slides_served"] == 22
+    assert stats["inflight_joins"] == 4
+    assert stats["poisoned_requests"] == 1
+    assert stats["bisections"] >= 1, "chaos poison must force a bisection"
+    assert stats["cache"]["hits"] == 12
+    assert stats["unexpected_retraces"] == 0
+
+    # the sanitizer saw the whole interleaving and found nothing
+    assert payload["violations"] == []
+    assert payload["observed_edges"], (
+        "the stress run must actually exercise nested acquisitions"
+    )
+
+    # static-vs-runtime no-drift: every observed order is a static edge
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.gigarace", "--validate",
+         str(trace_path)],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=540,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 runtime violation(s), 0 problem(s)" in proc.stderr

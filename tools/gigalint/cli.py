@@ -10,7 +10,9 @@ Exit codes: 0 clean (all findings waived or none), 1 unwaived findings,
 from __future__ import annotations
 
 import argparse
+import concurrent.futures
 import dataclasses
+import fnmatch
 import json
 import os
 import sys
@@ -20,6 +22,7 @@ from typing import List, Optional, Tuple
 from tools.gigalint import rules as _rules
 from tools.gigalint import pytest_hygiene as _hyg  # noqa: F401
 from tools.gigalint import sharding_coverage as _cov  # noqa: F401
+from tools.gigarace import rules as _race  # noqa: F401
 from tools.gigalint.graph import build_project
 from tools.gigalint.rules import RULES, Finding
 from tools.gigalint.waivers import (
@@ -62,8 +65,11 @@ class LintResult:
     waived: List[Finding]
     errors: List[str]
     scanned: int
-    # waiver entries that matched nothing this run (stale suppressions —
-    # reported as warnings so they get pruned, never silently hoarded)
+    # waiver entries whose file is outside this scan's paths (reported as
+    # warnings: possibly stale, but this run cannot tell). Entries whose
+    # glob DOES match a scanned file yet suppressed nothing are stale for
+    # certain and land in ``errors`` instead — a dead suppression is a
+    # mute button waiting for a regression to hide under.
     unused_waivers: List[str] = dataclasses.field(default_factory=list)
 
     @property
@@ -73,28 +79,58 @@ class LintResult:
         return 1 if self.findings else 0
 
 
+def _parse_one(item: Tuple[str, str, str]):
+    """(ModuleInfo | None, error | None) — worker for the parallel walk."""
+    abspath, rel, modname = item
+    try:
+        return parse_module(abspath, rel, modname), None
+    except SyntaxError as e:
+        return None, f"{rel}:{e.lineno}: GL000 syntax error: {e.msg}"
+    except (ValueError, UnicodeDecodeError, OSError) as e:
+        # ast.parse raises ValueError on null bytes; open() raises
+        # UnicodeDecodeError on non-UTF-8 — report per-file and keep
+        # linting the rest instead of dying with a traceback
+        return None, f"{rel}: GL000 unparseable file: {e}"
+
+
+def parse_modules(
+    discovered: List[Tuple[str, str, str]],
+    jobs: Optional[int] = None,
+) -> Tuple[List[ModuleInfo], List[str]]:
+    """Parse ``_discover`` output into (modules, errors), ``jobs`` wide.
+
+    Output order is pinned to discovery order regardless of ``jobs``:
+    ``Executor.map`` yields results in submission order, so the module
+    list — and therefore every downstream finding list — is byte-for-
+    byte identical at any parallelism (tests/test_gigalint.py pins it).
+    """
+    jobs = jobs if jobs and jobs > 0 else (os.cpu_count() or 1)
+    jobs = min(jobs, max(1, len(discovered)))
+    if jobs == 1:
+        results = [_parse_one(item) for item in discovered]
+    else:
+        with concurrent.futures.ThreadPoolExecutor(max_workers=jobs) as ex:
+            results = list(ex.map(_parse_one, discovered))
+    modules = [m for m, _ in results if m is not None]
+    errors = [e for _, e in results if e is not None]
+    return modules, errors
+
+
 def run_lint(
     paths: List[str],
     root: str = ".",
     waiver_file: Optional[str] = DEFAULT_WAIVER_FILE,
     select: Optional[List[str]] = None,
+    jobs: Optional[int] = None,
+    strict_waivers: bool = False,
 ) -> LintResult:
     """Programmatic entry point (used by tests/test_gigalint.py)."""
     errors: List[str] = []
-    modules: List[ModuleInfo] = []
     discovered = _discover(paths, root)
     if not discovered:
         errors.append(f"no python files under {paths!r} (root={root!r})")
-    for abspath, rel, modname in discovered:
-        try:
-            modules.append(parse_module(abspath, rel, modname))
-        except SyntaxError as e:
-            errors.append(f"{rel}:{e.lineno}: GL000 syntax error: {e.msg}")
-        except (ValueError, UnicodeDecodeError, OSError) as e:
-            # ast.parse raises ValueError on null bytes; open() raises
-            # UnicodeDecodeError on non-UTF-8 — report per-file and keep
-            # linting the rest instead of dying with a traceback
-            errors.append(f"{rel}: GL000 unparseable file: {e}")
+    modules, parse_errors = parse_modules(discovered, jobs=jobs)
+    errors.extend(parse_errors)
     project = build_project(modules, root=os.path.abspath(root))
 
     cfg = WaiverConfig()
@@ -114,13 +150,34 @@ def run_lint(
         findings=active, waived=waived, errors=errors, scanned=len(modules)
     )
     # Unused-waiver reporting is only meaningful on a FULL-rule scan: with
-    # --select (or a path subset) a waiver's rule may simply not have run,
-    # and telling the maintainer to prune it would break the full run.
+    # --select a waiver's rule may simply not have run, and telling the
+    # maintainer to prune it would break the full run. With
+    # ``strict_waivers`` (lint.sh's canonical full-tree scan), an unused
+    # entry whose glob touches a scanned file is stale for CERTAIN and
+    # becomes an ERROR (exit 2) so it gets purged instead of hoarded;
+    # everything else stays a warning. Strict is opt-in because on a
+    # partial scan even an in-scope waiver can be legitimately idle —
+    # reachability-based rules (GL001) draw their evidence from files
+    # OUTSIDE the glob (trace roots live in tests/), so only the full
+    # scope can convict.
     if select is None:
-        result.unused_waivers = [
-            f"{w.rule} {w.path_glob}" + (f"::{w.symbol}" if w.symbol else "")
-            for w in cfg.unused()
-        ]
+        waiver_path = waiver_file or DEFAULT_WAIVER_FILE
+        for w in cfg.unused():
+            label = (f"{w.rule} {w.path_glob}"
+                     + (f"::{w.symbol}" if w.symbol else ""))
+            in_scope = any(
+                fnmatch.fnmatch(m.path, w.path_glob)
+                or m.path.startswith(w.path_glob.rstrip("/") + "/")
+                for m in modules
+            )
+            if strict_waivers and in_scope:
+                errors.append(
+                    f"{waiver_path}:{w.line}: GL000 stale waiver: "
+                    f"'{label}' matched a scanned file but suppressed "
+                    f"nothing — the finding is gone, so delete the entry"
+                )
+            else:
+                result.unused_waivers.append(label)
     return result
 
 
@@ -142,6 +199,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="run only these rules (repeatable)")
     ap.add_argument("--show-waived", action="store_true",
                     help="also list waived findings in text output")
+    ap.add_argument("--jobs", type=int, default=None, metavar="N",
+                    help="parallel file-parse workers "
+                         "(default: os.cpu_count(); output order is "
+                         "deterministic at any value)")
+    ap.add_argument("--strict-waivers", action="store_true",
+                    help="unused waiver entries whose glob matches a "
+                         "scanned file are ERRORS (exit 2) — for the "
+                         "canonical full-tree scan (lint.sh), where an "
+                         "idle in-scope waiver is stale for certain")
     args = ap.parse_args(argv)
 
     result = run_lint(
@@ -149,6 +215,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         root=args.root,
         waiver_file=None if args.no_waivers else args.waivers,
         select=args.select,
+        jobs=args.jobs,
+        strict_waivers=args.strict_waivers,
     )
     if args.no_waivers:
         # re-fold waived findings back in: --no-waivers means "show all"
@@ -175,8 +243,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"error: {err}", file=sys.stderr)
     for stale in result.unused_waivers:
         print(
-            f"warning: unused waiver (stale entry, or the waived file is "
-            f"outside this scan's paths): {stale}",
+            f"warning: unused waiver (the waived file is outside this "
+            f"scan's paths — rerun over it to confirm): {stale}",
             file=sys.stderr,
         )
     for f in result.findings:

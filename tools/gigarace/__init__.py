@@ -1,0 +1,10 @@
+"""gigarace: lock-discipline and signal-safety analysis (GL018-GL021).
+
+Static dataflow analysis over the library AST — built on gigalint's
+walker / graph / waiver machinery — that models every lock the library
+creates, the order in which they are acquired, which fields they guard,
+and what the SIGTERM chain may reach. The runtime twin
+(``gigapath_tpu/obs/locktrace.py``) records *actual* acquisition orders
+under ``GIGAPATH_LOCKTRACE=1``; ``python -m tools.gigarace --validate``
+asserts the observed relation is covered by the static graph.
+"""

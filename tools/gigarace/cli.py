@@ -1,0 +1,263 @@
+"""gigarace CLI: the lock-discipline analyzer's standalone surface.
+
+    python -m tools.gigarace gigapath_tpu            # run GL018-GL021
+    python -m tools.gigarace --inventory             # lock table (README)
+    python -m tools.gigarace --graph                 # static graph as JSON
+    python -m tools.gigarace --validate trace.jsonl  # runtime vs static
+
+The rules themselves live in :mod:`tools.gigarace.rules` and are
+registered into gigalint, so ``scripts/lint.sh`` runs them without this
+entry point. This CLI exists for the model's OTHER consumers:
+
+- ``--inventory`` renders the lock inventory as the markdown table the
+  README's "Concurrency discipline" section embeds — regenerate it
+  there instead of hand-editing;
+- ``--graph`` dumps the static order graph (locks, edges with sites,
+  cycles, self-deadlocks) as JSON for tooling;
+- ``--validate`` replays one or more locktrace artifacts (the JSONL
+  the ``GIGAPATH_LOCKTRACE=1`` sanitizer emits — either raw dump files
+  or run JSONL streams carrying ``locktrace`` events) against the
+  static graph: every observed lock must be statically declared, every
+  observed acquisition-order edge must be a static edge, and the
+  sanitizer itself must have recorded zero violations. Exit 1 on any
+  inconsistency — the static analysis and the runtime never being
+  allowed to drift is the whole point of having both.
+
+Exit codes: 0 clean, 1 findings/inconsistencies, 2 usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from tools.gigalint.cli import _discover, parse_modules, run_lint
+from tools.gigalint.graph import build_project
+from tools.gigarace.lockmodel import LockModel
+from tools.gigarace.rules import (
+    RACE_RULES,
+    model_for,
+    resolved_field_guards,
+)
+
+DEFAULT_PATHS = ["gigapath_tpu"]
+
+
+def load_model(
+    paths: List[str], root: str = ".", jobs: Optional[int] = None,
+) -> Tuple[LockModel, List[str]]:
+    """Build the (exemption-filtered) lock model over ``paths``."""
+    modules, errors = parse_modules(_discover(paths, root), jobs=jobs)
+    project = build_project(modules, root=os.path.abspath(root))
+    return model_for(project), errors
+
+
+# ---------------------------------------------------------------------------
+# --inventory
+# ---------------------------------------------------------------------------
+
+def render_inventory(model: LockModel) -> str:
+    guards: Dict[str, set] = {}
+    for (_, cls, attr), (guard, _) in resolved_field_guards(model).items():
+        guards.setdefault(guard.name, set()).add(f"{cls}.{attr}")
+    rows = ["| lock | kind | declared at | guarded fields |",
+            "|---|---|---|---|"]
+    for name in sorted(model.locks):
+        d = model.locks[name]
+        fields = ", ".join(
+            f"`{f}`" for f in sorted(guards.get(name, ()))) or "—"
+        rows.append(
+            f"| `{name}` | {d.kind} | `{d.path}:{d.lineno}` | {fields} |")
+    return "\n".join(rows)
+
+
+# ---------------------------------------------------------------------------
+# --graph
+# ---------------------------------------------------------------------------
+
+def graph_dict(model: LockModel) -> dict:
+    return {
+        "version": 1,
+        "locks": {
+            name: {"kind": d.kind, "path": d.path, "lineno": d.lineno}
+            for name, d in sorted(model.locks.items())
+        },
+        "edges": [
+            {"src": a, "dst": b, "path": es[0].path,
+             "lineno": es[0].lineno, "note": es[0].note,
+             "sites": len(es)}
+            for (a, b), es in sorted(model.edges.items())
+        ],
+        "cycles": model.cycles(),
+        "self_deadlocks": [
+            {"lock": acq.lock.name, "path": acq.path, "lineno": acq.lineno}
+            for acq in model.self_deadlocks()
+        ],
+    }
+
+
+# ---------------------------------------------------------------------------
+# --validate: runtime locktrace vs the static graph
+# ---------------------------------------------------------------------------
+
+def _iter_trace_records(path: str, errors: List[str]):
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            for lineno, line in enumerate(fh, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    yield json.loads(line)
+                except json.JSONDecodeError as e:
+                    errors.append(f"{path}:{lineno}: not JSON: {e}")
+    except OSError as e:
+        errors.append(f"{path}: unreadable: {e}")
+
+
+def validate_traces(model: LockModel, trace_paths: List[str]) -> Tuple[List[str], dict]:
+    """Check every observed acquisition order against the static graph.
+
+    Accepts raw locktrace dump files (one JSON object with ``edges`` /
+    ``violations`` / ``locks``) and run JSONL streams (records where
+    ``event == "locktrace"`` carry the same payload). Returns
+    (problems, stats).
+    """
+    problems: List[str] = []
+    static_edges = set(model.edges)
+    observed_edges: Dict[Tuple[str, str], str] = {}
+    observed_locks: Dict[str, str] = {}
+    runtime_violations: List[str] = []
+    payloads = 0
+    for path in trace_paths:
+        for rec in _iter_trace_records(path, problems):
+            if not isinstance(rec, dict):
+                continue
+            if "edges" not in rec and rec.get("kind") != "locktrace":
+                continue
+            payloads += 1
+            for name in rec.get("locks", ()):  # observed lock names
+                observed_locks.setdefault(str(name), path)
+            for edge in rec.get("edges", ()):
+                if isinstance(edge, (list, tuple)) and len(edge) >= 2:
+                    observed_edges.setdefault(
+                        (str(edge[0]), str(edge[1])), path)
+            for v in rec.get("violations", ()):
+                runtime_violations.append(f"{path}: {v}")
+    if not payloads:
+        problems.append(
+            "no locktrace payloads found in the given files — was the "
+            "run executed with GIGAPATH_LOCKTRACE=1 and a "
+            "GIGAPATH_LOCKTRACE_OUT path?")
+    for name, src in sorted(observed_locks.items()):
+        if name not in model.locks:
+            problems.append(
+                f"observed lock '{name}' ({src}) is not in the static "
+                "model: the runtime factory name and the static "
+                "declaration have drifted")
+    for (a, b), src in sorted(observed_edges.items()):
+        if a == b:
+            continue
+        if (a, b) not in static_edges:
+            problems.append(
+                f"observed acquisition order {a} -> {b} ({src}) has no "
+                "static edge: the analyzer missed an interleaving (add "
+                "the missing type hint / call resolution) or the "
+                "runtime found a genuinely new path")
+    problems.extend(runtime_violations)
+    stats = {
+        "payloads": payloads,
+        "observed_locks": len(observed_locks),
+        "observed_edges": len(observed_edges),
+        "static_edges": len(static_edges),
+        "covered_edges": sum(
+            1 for e in observed_edges if e in static_edges),
+        "runtime_violations": len(runtime_violations),
+    }
+    return problems, stats
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.gigarace",
+        description="lock-discipline + signal-safety analysis "
+                    "(GL018-GL021) for the gigapath-tpu tree",
+    )
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files or directories (default: {DEFAULT_PATHS})")
+    ap.add_argument("--root", default=".", help="repo root (default: cwd)")
+    ap.add_argument("--inventory", action="store_true",
+                    help="print the lock inventory as a markdown table")
+    ap.add_argument("--graph", action="store_true",
+                    help="print the static lock-order graph as JSON")
+    ap.add_argument("--validate", nargs="+", metavar="TRACE",
+                    help="locktrace JSONL artifact(s) to check against "
+                         "the static graph")
+    ap.add_argument("--no-waivers", action="store_true",
+                    help="(rule mode) ignore waivers")
+    ap.add_argument("--jobs", type=int, default=None, metavar="N",
+                    help="parallel file-parse workers "
+                         "(default: os.cpu_count())")
+    args = ap.parse_args(argv)
+    paths = args.paths or DEFAULT_PATHS
+
+    if sum(map(bool, (args.inventory, args.graph, args.validate))) > 1:
+        print("error: --inventory / --graph / --validate are exclusive",
+              file=sys.stderr)
+        return 2
+
+    if args.inventory or args.graph or args.validate:
+        model, errors = load_model(paths, root=args.root, jobs=args.jobs)
+        for err in errors:
+            print(f"error: {err}", file=sys.stderr)
+        if errors:
+            return 2
+        if args.inventory:
+            print(render_inventory(model))
+            return 0
+        if args.graph:
+            print(json.dumps(graph_dict(model), indent=1, sort_keys=True))
+            return 0
+        problems, stats = validate_traces(model, args.validate)
+        for p in problems:
+            print(f"violation: {p}")
+        print(
+            f"gigarace --validate: {stats['payloads']} payload(s), "
+            f"{stats['observed_edges']} observed edge(s) "
+            f"({stats['covered_edges']} covered by "
+            f"{stats['static_edges']} static), "
+            f"{stats['runtime_violations']} runtime violation(s), "
+            f"{len(problems)} problem(s)",
+            file=sys.stderr,
+        )
+        return 1 if problems else 0
+
+    # rule mode: the four rules through gigalint's runner, so waivers and
+    # exit-code semantics are identical to the lint entry point
+    result = run_lint(
+        paths, root=args.root,
+        waiver_file=None if args.no_waivers else "GIGALINT_WAIVERS",
+        select=sorted(RACE_RULES),
+        jobs=args.jobs,
+    )
+    for err in result.errors:
+        print(f"error: {err}", file=sys.stderr)
+    for f in result.findings:
+        print(f.text())
+    print(
+        f"gigarace: {result.scanned} files, {len(result.findings)} "
+        f"finding(s), {len(result.waived)} waived",
+        file=sys.stderr,
+    )
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

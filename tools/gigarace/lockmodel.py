@@ -1,0 +1,962 @@
+"""The whole-library lock model: declarations, held-sets, order graph.
+
+One pass over gigalint's per-file facts produces, for the whole
+project:
+
+- every lock the library creates (``threading.Lock/RLock/Condition``
+  or the locktrace factories ``make_lock/make_rlock/make_condition``),
+  with a canonical name (``pkg.mod.Cls._lock`` / ``pkg.mod._GLOBAL``)
+  that matches the literal passed to the locktrace factory, so the
+  static graph and the runtime sanitizer speak the same identities;
+- per-function acquisition facts from a held-set walk of each body
+  (``with lock:``, ``lock.acquire()``/``release()``, try-acquire and
+  timeout forms), plus every call made and every ``self.X`` field
+  touched while locks are held;
+- the inter-lock order graph: an edge A -> B for every site that
+  acquires B (directly or through a resolved callee) while holding A;
+- per-class guarded-field classification for the race rule.
+
+Resolution is conservative in gigalint's style — an unresolvable lock
+expression or callee is ignored, never guessed — with three explicit
+ways to teach the model what the AST alone cannot show:
+
+- ``self.x = runlog  # gigarace: type RunLog`` pins an attribute's
+  class when it arrives as an untyped parameter;
+- ``self.f = {}  # gigarace: guarded-by _lock`` declares a field's
+  guard; ``# gigarace: unguarded -- reason`` exempts a field whose
+  cross-thread discipline is ownership transfer, not locking;
+- constructor args that land in a lock-typed ``__init__`` parameter
+  alias the callee's lock attribute to the caller's lock (the metrics
+  instruments all share the registry lock this way).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from tools.gigalint.astutils import dotted_name
+from tools.gigalint.graph import Project
+from tools.gigalint.walker import FunctionInfo, ModuleInfo
+
+# attribute methods that mutate the container in place: a
+# ``self._pending[k] = v`` / ``self._buf.append(x)`` is a WRITE to the
+# field for guarded-field classification even though the attribute
+# itself is only loaded
+_MUTATOR_METHODS = frozenset({
+    "append", "appendleft", "add", "clear", "discard", "extend",
+    "insert", "pop", "popitem", "popleft", "remove", "setdefault",
+    "update",
+})
+
+_TYPE_HINT_RE = re.compile(r"#\s*gigarace:\s*type\s+(?P<names>[\w.,\s]+)")
+_CALLS_RE = re.compile(r"#\s*gigarace:\s*calls\s+(?P<names>[\w.,\s]+)")
+_GUARDED_BY_RE = re.compile(r"#\s*gigarace:\s*guarded-by\s+(?P<attr>\w+)")
+_UNGUARDED_RE = re.compile(r"#\s*gigarace:\s*unguarded\s*--\s*\S")
+
+# methods named *_locked run with the caller already holding the
+# class's lock (the flight-recorder discipline); *_from_signal methods
+# are the sanctioned signal surface and do their own try-acquire
+_CALLER_HOLDS_SUFFIX = "_locked"
+
+
+@dataclasses.dataclass(frozen=True)
+class LockDecl:
+    name: str           # canonical: "pkg.mod.Cls._lock" / "pkg.mod._GLOBAL"
+    kind: str           # "lock" | "rlock" | "condition"
+    modname: str
+    path: str
+    lineno: int
+    class_name: Optional[str]
+    attr: str
+
+
+@dataclasses.dataclass
+class AcqSite:
+    lock: LockDecl
+    path: str
+    lineno: int
+    fn: FunctionInfo
+    blocking: bool                 # False for timeout= / blocking=False
+    held_before: Tuple[LockDecl, ...]
+
+
+@dataclasses.dataclass
+class BlockOp:
+    kind: str      # "thread_join" | "cond_wait" | "socket_recv" | "sleep"
+    detail: str
+    path: str
+    lineno: int
+    held: Tuple[LockDecl, ...]     # locks held at the op (may be empty)
+
+
+@dataclasses.dataclass
+class HeldCall:
+    callee: str
+    path: str
+    lineno: int
+    held: Tuple[LockDecl, ...]
+
+
+@dataclasses.dataclass
+class FieldTouch:
+    attr: str
+    path: str
+    lineno: int
+    fn: FunctionInfo
+    is_write: bool
+    held: Tuple[LockDecl, ...]
+
+
+@dataclasses.dataclass
+class SignalReg:
+    target: str    # dotted handler expression as written
+    path: str
+    lineno: int
+    fn: Optional[FunctionInfo]     # enclosing function of the register call
+
+
+@dataclasses.dataclass
+class FnFacts:
+    acquisitions: List[AcqSite] = dataclasses.field(default_factory=list)
+    block_ops: List[BlockOp] = dataclasses.field(default_factory=list)
+    held_calls: List[HeldCall] = dataclasses.field(default_factory=list)
+    touches: List[FieldTouch] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class Edge:
+    src: str
+    dst: str
+    path: str
+    lineno: int
+    note: str
+
+
+class LockModel:
+    def __init__(self, project: Project):
+        self.project = project
+        self.locks: Dict[str, LockDecl] = {}
+        # (modname, Class) -> {attr: LockDecl}; module locks keyed class=None
+        self.class_locks: Dict[Tuple[str, Optional[str]], Dict[str, LockDecl]] = {}
+        # (modname, Class, attr) -> {(modname2, Class2), ...} candidates
+        self.attr_types: Dict[Tuple[str, str, str], Set[Tuple[str, str]]] = {}
+        # attrs assigned threading.Thread(...): (modname, Class) -> {attr}
+        self.thread_attrs: Dict[Tuple[str, str], Set[str]] = {}
+        # (modname, Class) -> {__init__ param name: attr it lands in}
+        self.lock_params: Dict[Tuple[str, str], Dict[str, str]] = {}
+        # declared field guards: (modname, Class, field) -> guard attr name
+        self.guarded_decls: Dict[Tuple[str, str, str], str] = {}
+        self.unguarded_decls: Set[Tuple[str, str, str]] = set()
+        self.fn_facts: Dict[FunctionInfo, FnFacts] = {}
+        # declared dynamic-dispatch targets: ``# gigarace: calls X.y``
+        # on a call line teaches the model what an indirect call (an
+        # observer list, a stored callback) may invoke
+        self.calls_hints: Dict[FunctionInfo, Set[str]] = {}
+        self.signal_regs: List[SignalReg] = []
+        self.edges: Dict[Tuple[str, str], List[Edge]] = {}
+        self._may_acquire: Dict[FunctionInfo, Set[str]] = {}
+        self._may_block: Dict[FunctionInfo, Dict[str, str]] = {}
+        self._callees: Dict[FunctionInfo, List[FunctionInfo]] = {}
+        self._build()
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+    def _build(self) -> None:
+        mods = list(self.project.modules.values())
+        for mod in mods:
+            self._collect_decls(mod)
+        for mod in mods:
+            self._collect_aliases(mod)
+        self._declare_unaliased_params()
+        for mod in mods:
+            for fn in mod.functions.values():
+                self.fn_facts[fn] = _FnWalker(self, fn).run()
+        self._resolve_callees()
+        self._propagate()
+        self._build_edges()
+
+    # -- pass A: lock declarations, attr types, annotations ----------------
+    def _lock_ctor(self, call: ast.Call, mod: ModuleInfo) -> Optional[Tuple[str, Optional[str]]]:
+        """(kind, literal name) when ``call`` constructs a lock."""
+        fname = dotted_name(call.func)
+        if not fname:
+            return None
+        last = fname.rsplit(".", 1)[-1]
+        factory = {"make_lock": "lock", "make_rlock": "rlock",
+                   "make_condition": "condition"}.get(last)
+        if factory:
+            lit = None
+            if call.args and isinstance(call.args[0], ast.Constant) \
+                    and isinstance(call.args[0].value, str):
+                lit = call.args[0].value
+            return factory, lit
+        kind = {"Lock": "lock", "RLock": "rlock",
+                "Condition": "condition"}.get(last)
+        if kind is None:
+            return None
+        # require a threading provenance: ``threading.Lock()`` or a
+        # ``from threading import Lock`` alias — not any class named Lock
+        if fname == f"threading.{last}":
+            return kind, None
+        target = mod.imports.get(fname)
+        if target == f"threading.{last}":
+            return kind, None
+        head = fname.split(".")[0]
+        if mod.imports.get(head) == "threading":
+            return kind, None
+        return None
+
+    def _line_comment(self, mod: ModuleInfo, lineno: int) -> str:
+        if 1 <= lineno <= len(mod.source_lines):
+            return mod.source_lines[lineno - 1]
+        return ""
+
+    def _declare(self, mod: ModuleInfo, class_name: Optional[str],
+                 attr: str, kind: str, literal: Optional[str],
+                 lineno: int) -> None:
+        derived = (f"{mod.modname}.{class_name}.{attr}" if class_name
+                   else f"{mod.modname}.{attr}")
+        name = literal or derived
+        decl = LockDecl(name=name, kind=kind, modname=mod.modname,
+                        path=mod.path, lineno=lineno,
+                        class_name=class_name, attr=attr)
+        # first declaration wins (re-assignment in reset paths is the
+        # same lock identity)
+        self.locks.setdefault(name, decl)
+        self.class_locks.setdefault((mod.modname, class_name), {}) \
+            .setdefault(attr, self.locks[name])
+
+    def _hint_classes(self, mod: ModuleInfo, names: Iterable[str]) -> Set[Tuple[str, str]]:
+        out: Set[Tuple[str, str]] = set()
+        for raw in names:
+            cname = raw.strip()
+            if not cname:
+                continue
+            hit = self._find_class(mod, cname)
+            if hit:
+                out.add(hit)
+        return out
+
+    def _find_class(self, mod: ModuleInfo, cname: str) -> Optional[Tuple[str, str]]:
+        """Resolve a class name (possibly dotted / imported) to
+        (modname, Class) of a scanned class."""
+        target = mod.imports.get(cname, None)
+        candidates = []
+        if target:
+            candidates.append(target)
+        candidates.append(f"{mod.modname}.{cname}" if "." not in cname else cname)
+        for dotted in candidates:
+            pkg, _, cls = dotted.rpartition(".")
+            m2 = self.project.modules.get(pkg)
+            if m2 and any(q == cls or q.startswith(cls + ".")
+                          for q in m2.functions):
+                return (pkg, cls)
+        # same-module class with methods
+        if any(q.startswith(cname + ".") for q in mod.functions):
+            return (mod.modname, cname)
+        return None
+
+    def _value_classes(self, mod: ModuleInfo, fn: Optional[FunctionInfo],
+                       value: ast.AST, depth: int = 0) -> Set[Tuple[str, str]]:
+        """Classes an assignment's value may be an instance of:
+        constructor calls anywhere in the expression, plus one level of
+        factory-return inference."""
+        out: Set[Tuple[str, str]] = set()
+        for node in _shallow_walk(value, include_root=True):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func)
+            if not fname:
+                continue
+            hit = self._find_class(mod, fname)
+            if hit:
+                out.add(hit)
+                continue
+            if depth == 0:
+                factory = self.project.resolve(mod, fn, fname)
+                if factory is not None:
+                    for sub in _shallow_walk(factory.node):
+                        if isinstance(sub, ast.Call):
+                            out |= self._value_classes(
+                                factory.module, factory, sub, depth=1)
+        return out
+
+    def _collect_decls(self, mod: ModuleInfo) -> None:
+        # module-level locks
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+                ctor = self._lock_ctor(stmt.value, mod)
+                if ctor:
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name):
+                            self._declare(mod, None, tgt.id, ctor[0],
+                                          ctor[1], stmt.lineno)
+        # instance locks / attr types / field annotations, in any method
+        for fn in mod.functions.values():
+            if not fn.class_name:
+                continue
+            cls = fn.class_name
+            for stmt in _shallow_walk(fn.node):
+                value = None
+                targets: List[ast.AST] = []
+                if isinstance(stmt, ast.Assign):
+                    value, targets = stmt.value, stmt.targets
+                elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                    value, targets = stmt.value, [stmt.target]
+                if value is None:
+                    continue
+                self_attrs = [t.attr for t in targets
+                              if isinstance(t, ast.Attribute)
+                              and isinstance(t.value, ast.Name)
+                              and t.value.id == "self"]
+                if not self_attrs:
+                    continue
+                line = self._line_comment(mod, stmt.lineno)
+                m = _GUARDED_BY_RE.search(line)
+                if m:
+                    for attr in self_attrs:
+                        self.guarded_decls[(mod.modname, cls, attr)] = \
+                            m.group("attr")
+                if _UNGUARDED_RE.search(line):
+                    for attr in self_attrs:
+                        self.unguarded_decls.add((mod.modname, cls, attr))
+                if isinstance(value, ast.Call):
+                    ctor = self._lock_ctor(value, mod)
+                    if ctor:
+                        for attr in self_attrs:
+                            self._declare(mod, cls, attr, ctor[0],
+                                          ctor[1], stmt.lineno)
+                        continue
+                    fname = dotted_name(value.func)
+                    if fname and fname in ("threading.Thread", "Thread") and (
+                            fname == "threading.Thread"
+                            or mod.imports.get("Thread") == "threading.Thread"):
+                        for attr in self_attrs:
+                            self.thread_attrs.setdefault(
+                                (mod.modname, cls), set()).add(attr)
+                # annotated __init__ param landing in an attribute:
+                # a lock type feeds the alias pass, any scanned class
+                # feeds attr_types (``flight: Optional[FlightRecorder]``
+                # needs no comment hint)
+                if fn.name == "__init__" and isinstance(value, ast.Name) \
+                        and value.id in fn.params:
+                    ann = _param_annotation(fn.node, value.id)
+                    if ann and ann.rsplit(".", 1)[-1] in (
+                            "Lock", "RLock", "Condition"):
+                        for attr in self_attrs:
+                            self.lock_params.setdefault(
+                                (mod.modname, cls), {})[value.id] = attr
+                    elif ann:
+                        hit = self._find_class(mod, ann)
+                        if hit:
+                            for attr in self_attrs:
+                                self.attr_types.setdefault(
+                                    (mod.modname, cls, attr), set()).add(hit)
+                # attribute class: type hint comment, annotation, ctors
+                hint = _TYPE_HINT_RE.search(line)
+                classes: Set[Tuple[str, str]] = set()
+                if hint:
+                    classes |= self._hint_classes(
+                        mod, hint.group("names").split(","))
+                if isinstance(stmt, ast.AnnAssign):
+                    ann_name = _annotation_name(stmt.annotation)
+                    if ann_name:
+                        classes |= self._hint_classes(mod, [ann_name])
+                classes |= self._value_classes(mod, fn, value)
+                if classes:
+                    for attr in self_attrs:
+                        self.attr_types.setdefault(
+                            (mod.modname, cls, attr), set()).update(classes)
+
+    # -- pass B: alias the lock-typed ctor params to the caller's lock -----
+    def _collect_aliases(self, mod: ModuleInfo) -> None:
+        for fn in mod.functions.values():
+            for node in _shallow_walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = dotted_name(node.func)
+                if not fname:
+                    continue
+                hit = self._find_class(mod, fname)
+                if hit is None or hit not in self.lock_params:
+                    continue
+                init = self.project.modules[hit[0]].functions.get(
+                    f"{hit[1]}.__init__")
+                if init is None:
+                    continue
+                params = [p for p in init.params if p != "self"]
+                for pname, attr in self.lock_params[hit].items():
+                    arg = _call_arg(node, params, pname)
+                    if arg is None:
+                        continue
+                    src = dotted_name(arg)
+                    decl = self._resolve_lock_text(src, mod, fn) if src else None
+                    if decl is not None:
+                        self.class_locks.setdefault(hit, {})[attr] = decl
+
+    def _declare_unaliased_params(self) -> None:
+        # lock-param attrs nobody aliased still need an identity so their
+        # acquisitions resolve (standalone construction in tests/tools).
+        # Runs AFTER every module's alias pass: doing this per-module
+        # would mint a phantom standalone lock for a class whose aliasing
+        # call site simply lives in a later module.
+        for key, params in self.lock_params.items():
+            for attr in params.values():
+                if attr not in self.class_locks.get(key, {}):
+                    m2 = self.project.modules.get(key[0])
+                    if m2 is not None:
+                        self._declare(m2, key[1], attr, "lock", None, 1)
+
+    # -- lock expression resolution ----------------------------------------
+    def _resolve_lock_text(self, text: Optional[str], mod: ModuleInfo,
+                           fn: Optional[FunctionInfo]) -> Optional[LockDecl]:
+        if not text:
+            return None
+        parts = text.split(".")
+        if parts[0] == "self" and fn is not None and fn.class_name:
+            if len(parts) == 2:
+                return self.class_locks.get(
+                    (mod.modname, fn.class_name), {}).get(parts[1])
+            if len(parts) == 3:
+                for owner in self.attr_types.get(
+                        (mod.modname, fn.class_name, parts[1]), ()):
+                    hit = self.class_locks.get(owner, {}).get(parts[2])
+                    if hit:
+                        return hit
+            return None
+        if len(parts) == 1:
+            return self.class_locks.get((mod.modname, None), {}).get(parts[0])
+        return None
+
+    # -- callee resolution (gigalint resolve + attr types) ------------------
+    def resolve_callees(self, fn: FunctionInfo, callee: str) -> List[FunctionInfo]:
+        if callee in self.calls_hints.get(fn, ()):
+            return self._resolve_hint_target(fn.module, callee)
+        hit = self.project.resolve(fn.module, fn, callee)
+        if hit is not None:
+            return [hit]
+        parts = callee.split(".")
+        if parts[0] == "self" and fn.class_name and len(parts) == 3:
+            out = []
+            for (m2, c2) in sorted(self.attr_types.get(
+                    (fn.module.modname, fn.class_name, parts[1]), ())):
+                mod2 = self.project.modules.get(m2)
+                f2 = mod2.functions.get(f"{c2}.{parts[2]}") if mod2 else None
+                if f2 is not None:
+                    out.append(f2)
+            return out
+        return []
+
+    def _resolve_hint_target(self, mod: ModuleInfo, name: str) -> List[FunctionInfo]:
+        """Resolve a ``# gigarace: calls`` target: dotted class paths,
+        imported names, and bare ``Cls.meth`` qualnames anywhere in the
+        scanned tree (hint targets commonly live in modules the hinted
+        module deliberately does NOT import — that indirection is why
+        the call is dynamic in the first place)."""
+        if "." in name:
+            head, meth = name.rsplit(".", 1)
+            hit = self._find_class(mod, head)
+            if hit is not None:
+                m2 = self.project.modules.get(hit[0])
+                f2 = m2.functions.get(f"{hit[1]}.{meth}") if m2 else None
+                return [f2] if f2 is not None else []
+        out = []
+        for modname in sorted(self.project.modules):
+            f2 = self.project.modules[modname].functions.get(name)
+            if f2 is not None:
+                out.append(f2)
+        return out
+
+    def _resolve_callees_cached(self, fn: FunctionInfo) -> List[FunctionInfo]:
+        hit = self._callees.get(fn)
+        if hit is None:
+            hit = []
+            seen = set()
+            for site in fn.calls:
+                for callee in self.resolve_callees(fn, site.callee):
+                    if callee is not fn and id(callee) not in seen:
+                        seen.add(id(callee))
+                        hit.append(callee)
+            for name in sorted(self.calls_hints.get(fn, ())):
+                for callee in self._resolve_hint_target(fn.module, name):
+                    if callee is not fn and id(callee) not in seen:
+                        seen.add(id(callee))
+                        hit.append(callee)
+            self._callees[fn] = hit
+        return hit
+
+    def _resolve_callees(self) -> None:
+        for fn in self.fn_facts:
+            self._resolve_callees_cached(fn)
+
+    # -- transitive may-acquire / may-block ---------------------------------
+    def _propagate(self) -> None:
+        for fn, facts in self.fn_facts.items():
+            self._may_acquire[fn] = {a.lock.name for a in facts.acquisitions}
+            blocks: Dict[str, str] = {}
+            for op in facts.block_ops:
+                blocks.setdefault(op.kind,
+                                  f"{op.detail} at {op.path}:{op.lineno}")
+            self._may_block[fn] = blocks
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.fn_facts:
+                for callee in self._callees.get(fn, ()):
+                    extra = self._may_acquire.get(callee, set()) \
+                        - self._may_acquire[fn]
+                    if extra:
+                        self._may_acquire[fn] |= extra
+                        changed = True
+                    for kind, why in self._may_block.get(callee, {}).items():
+                        if kind not in self._may_block[fn]:
+                            self._may_block[fn][kind] = \
+                                f"via {callee.qualname}: {why}"
+                            changed = True
+
+    def may_acquire(self, fn: FunctionInfo) -> Set[str]:
+        return self._may_acquire.get(fn, set())
+
+    def may_block(self, fn: FunctionInfo) -> Dict[str, str]:
+        return self._may_block.get(fn, {})
+
+    # -- the order graph -----------------------------------------------------
+    def _add_edge(self, src: LockDecl, dst_name: str, path: str,
+                  lineno: int, note: str) -> None:
+        if src.name == dst_name:
+            return  # self-acquisition is GL018's self-deadlock check
+        self.edges.setdefault((src.name, dst_name), []).append(
+            Edge(src.name, dst_name, path, lineno, note))
+
+    def _build_edges(self) -> None:
+        for fn, facts in self.fn_facts.items():
+            for acq in facts.acquisitions:
+                for h in acq.held_before:
+                    self._add_edge(h, acq.lock.name, acq.path, acq.lineno,
+                                   f"acquired in {fn.qualname}")
+            for call in facts.held_calls:
+                for callee in self.resolve_callees(fn, call.callee):
+                    for lname in self._may_acquire.get(callee, ()):
+                        for h in call.held:
+                            self._add_edge(
+                                h, lname, call.path, call.lineno,
+                                f"{fn.qualname} calls {callee.qualname}")
+
+    # -- cycle detection -------------------------------------------------
+    def cycles(self) -> List[List[str]]:
+        """Strongly-connected components of size > 1, sorted."""
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        out: List[List[str]] = []
+        counter = [0]
+
+        def strongconnect(v: str) -> None:
+            # iterative Tarjan: fixture cycles are tiny but recursion
+            # depth must not depend on graph shape
+            work = [(v, iter(sorted(adj[v])))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack.add(w)
+                        work.append((w, iter(sorted(adj[w]))))
+                        advanced = True
+                        break
+                    if w in on_stack:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    if len(scc) > 1:
+                        out.append(sorted(scc))
+
+        for v in sorted(adj):
+            if v not in index:
+                strongconnect(v)
+        return sorted(out)
+
+    def self_deadlocks(self) -> List[AcqSite]:
+        """Re-acquisition of a non-reentrant lock already held."""
+        out = []
+        for facts in self.fn_facts.values():
+            for acq in facts.acquisitions:
+                if acq.lock.kind != "rlock" and any(
+                        h.name == acq.lock.name for h in acq.held_before):
+                    out.append(acq)
+        return out
+
+    # -- signal roots -------------------------------------------------------
+    def signal_roots(self) -> Dict[FunctionInfo, str]:
+        roots: Dict[FunctionInfo, str] = {}
+        for reg in self.signal_regs:
+            mod = (reg.fn.module if reg.fn is not None
+                   else self.project.modules.get(
+                       _modname_of_path(self.project, reg.path)))
+            if mod is None:
+                continue
+            hit = self.project.resolve(mod, reg.fn, reg.target)
+            if hit is not None:
+                roots.setdefault(
+                    hit, f"registered as signal handler at "
+                         f"{reg.path}:{reg.lineno}")
+        return roots
+
+    def signal_reachable(self) -> Dict[FunctionInfo, str]:
+        roots = self.signal_roots()
+        reached = dict(roots)
+        queue = list(roots.items())
+        while queue:
+            fn, why = queue.pop()
+            for callee in self._resolve_callees_cached(fn):
+                if callee in reached:
+                    continue
+                via = f"called from {fn.qualname} ({why})"
+                reached[callee] = via
+                queue.append((callee, via))
+        return reached
+
+
+def _modname_of_path(project: Project, path: str) -> Optional[str]:
+    for name, mod in project.modules.items():
+        if mod.path == path:
+            return name
+    return None
+
+
+def _param_annotation(fn_node: ast.AST, pname: str) -> Optional[str]:
+    a = fn_node.args
+    for p in list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs):
+        if p.arg == pname and p.annotation is not None:
+            return _annotation_name(p.annotation)
+    return None
+
+
+def _annotation_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value.strip().strip('"\'')
+    name = dotted_name(node)
+    if name:
+        return name
+    # Optional[X] / "Optional[X]"-style subscripts: take the inner name
+    if isinstance(node, ast.Subscript):
+        return _annotation_name(node.slice)
+    return None
+
+
+def _call_arg(call: ast.Call, params: List[str], pname: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == pname:
+            return kw.value
+    try:
+        idx = params.index(pname)
+    except ValueError:
+        return None
+    if idx < len(call.args) and not any(
+            isinstance(a, ast.Starred) for a in call.args[: idx + 1]):
+        return call.args[idx]
+    return None
+
+
+def _shallow_walk(node: ast.AST, include_root: bool = False) -> Iterator[ast.AST]:
+    """ast.walk that does not descend into nested function/class scopes
+    (their statements belong to their own FunctionInfo)."""
+    queue: List[ast.AST] = [node]
+    first = True
+    while queue:
+        n = queue.pop()
+        if not first or include_root:
+            yield n
+        if first or not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                       ast.ClassDef, ast.Lambda)):
+            queue.extend(ast.iter_child_nodes(n))
+        first = False
+
+
+def _is_blocking_acquire(call: ast.Call) -> bool:
+    """``acquire()`` with no timeout and blocking != False is indefinite."""
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return False
+        if kw.arg == "blocking" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return False
+    if call.args:
+        a0 = call.args[0]
+        if isinstance(a0, ast.Constant) and a0.value is False:
+            return False
+        if len(call.args) >= 2:  # acquire(True, timeout)
+            return False
+    return True
+
+
+class _FnWalker:
+    """Held-set walk of one function body, in statement order."""
+
+    def __init__(self, model: LockModel, fn: FunctionInfo):
+        self.model = model
+        self.fn = fn
+        self.mod = fn.module
+        self.held: List[LockDecl] = []
+        self.facts = FnFacts()
+        self.local_threads: Set[str] = set()
+        self._socket_mod = any(
+            t == "socket" or t.startswith("socket.")
+            for t in self.mod.imports.values())
+
+    def run(self) -> FnFacts:
+        # *_locked methods run with the caller already holding every
+        # lock of their class — seed the held set accordingly
+        if self.fn.name.endswith(_CALLER_HOLDS_SUFFIX) and self.fn.class_name:
+            self.held.extend(sorted(
+                self.model.class_locks.get(
+                    (self.mod.modname, self.fn.class_name), {}).values(),
+                key=lambda d: d.name))
+        self._walk(self.fn.node.body)
+        return self.facts
+
+    # -- helpers -----------------------------------------------------------
+    def _snapshot(self) -> Tuple[LockDecl, ...]:
+        return tuple(self.held)
+
+    def _acquire(self, decl: LockDecl, lineno: int, blocking: bool) -> None:
+        self.facts.acquisitions.append(AcqSite(
+            lock=decl, path=self.mod.path, lineno=lineno, fn=self.fn,
+            blocking=blocking, held_before=self._snapshot()))
+        self.held.append(decl)
+
+    def _release(self, decl: LockDecl) -> None:
+        for i in range(len(self.held) - 1, -1, -1):
+            if self.held[i].name == decl.name:
+                del self.held[i]
+                return
+
+    def _resolve_lock(self, text: Optional[str]) -> Optional[LockDecl]:
+        return self.model._resolve_lock_text(text, self.mod, self.fn)
+
+    # -- statement walk ------------------------------------------------------
+    def _walk(self, stmts: Iterable[ast.stmt]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                pushed = []
+                for item in stmt.items:
+                    self._scan_expr(item.context_expr)
+                    decl = self._resolve_lock(
+                        dotted_name(item.context_expr))
+                    if decl is not None:
+                        self._acquire(decl, item.context_expr.lineno,
+                                      blocking=True)
+                        pushed.append(decl)
+                self._walk(stmt.body)
+                for decl in reversed(pushed):
+                    self._release(decl)
+                continue
+            if isinstance(stmt, ast.Try):
+                self._walk(stmt.body)
+                for handler in stmt.handlers:
+                    self._walk(handler.body)
+                self._walk(stmt.orelse)
+                self._walk(stmt.finalbody)
+                continue
+            if isinstance(stmt, ast.If):
+                self._scan_expr(stmt.test)
+                saved = list(self.held)
+                self._walk(stmt.body)
+                self.held = list(saved)
+                self._walk(stmt.orelse)
+                self.held = saved
+                continue
+            if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+                if isinstance(stmt, ast.While):
+                    self._scan_expr(stmt.test)
+                else:
+                    self._scan_expr(stmt.iter)
+                self._walk(stmt.body)
+                self._walk(stmt.orelse)
+                continue
+            # plain statement: scan its expressions in order
+            for node in ast.iter_child_nodes(stmt):
+                self._scan_expr(node)
+            self._track_locals(stmt)
+
+    def _track_locals(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            fname = dotted_name(stmt.value.func)
+            if fname and fname.rsplit(".", 1)[-1] == "Thread":
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.local_threads.add(tgt.id)
+
+    def _is_thread(self, base: str) -> bool:
+        if base in self.local_threads:
+            return True
+        parts = base.split(".")
+        if parts[0] == "self" and len(parts) == 2 and self.fn.class_name:
+            return parts[1] in self.model.thread_attrs.get(
+                (self.mod.modname, self.fn.class_name), set())
+        return False
+
+    # -- expression scan -----------------------------------------------------
+    def _scan_expr(self, node: ast.AST) -> None:
+        for sub in _walk_expr(node):
+            if isinstance(sub, ast.Call):
+                self._handle_call(sub)
+            elif isinstance(sub, ast.Attribute):
+                self._handle_attribute(sub)
+            elif isinstance(sub, ast.Subscript):
+                self._handle_subscript(sub)
+
+    def _handle_call(self, node: ast.Call) -> None:
+        hint = _CALLS_RE.search(self.model._line_comment(self.mod, node.lineno))
+        if hint:
+            names = {n.strip() for n in hint.group("names").split(",")
+                     if n.strip()}
+            self.model.calls_hints.setdefault(self.fn, set()).update(names)
+            if self.held:
+                # an indirect call under a lock contributes order edges
+                # exactly like a resolved one
+                for name in sorted(names):
+                    self.facts.held_calls.append(HeldCall(
+                        callee=name, path=self.mod.path,
+                        lineno=node.lineno, held=self._snapshot()))
+        fname = dotted_name(node.func)
+        if not fname:
+            return
+        parts = fname.rsplit(".", 1)
+        base = parts[0] if len(parts) == 2 else None
+        last = parts[-1]
+        if base is not None:
+            if last == "acquire":
+                decl = self._resolve_lock(base)
+                if decl is not None:
+                    self._acquire(decl, node.lineno,
+                                  blocking=_is_blocking_acquire(node))
+                    return
+            elif last == "release":
+                decl = self._resolve_lock(base)
+                if decl is not None:
+                    self._release(decl)
+                    return
+            elif last in ("wait", "wait_for"):
+                decl = self._resolve_lock(base)
+                if decl is not None and decl.kind == "condition":
+                    others = tuple(h for h in self.held
+                                   if h.name != decl.name)
+                    self.facts.block_ops.append(BlockOp(
+                        kind="cond_wait", detail=f"{base}.{last}()",
+                        path=self.mod.path, lineno=node.lineno, held=others))
+                    return
+            elif last == "join" and self._is_thread(base):
+                self.facts.block_ops.append(BlockOp(
+                    kind="thread_join", detail=f"{base}.join()",
+                    path=self.mod.path, lineno=node.lineno,
+                    held=self._snapshot()))
+            elif last in ("recv", "recv_into", "accept") and self._socket_mod:
+                self.facts.block_ops.append(BlockOp(
+                    kind="socket_recv", detail=f"{fname}()",
+                    path=self.mod.path, lineno=node.lineno,
+                    held=self._snapshot()))
+        if fname == "time.sleep" or (
+                fname == "sleep" and self.mod.imports.get("sleep") == "time.sleep"):
+            self.facts.block_ops.append(BlockOp(
+                kind="sleep", detail="time.sleep()",
+                path=self.mod.path, lineno=node.lineno,
+                held=self._snapshot()))
+        if last == "register_signal_callback" and node.args:
+            target = dotted_name(node.args[0])
+            if target:
+                self.model.signal_regs.append(SignalReg(
+                    target=target, path=self.mod.path,
+                    lineno=node.lineno, fn=self.fn))
+        elif (fname == "signal.signal" or fname.endswith(".signal.signal")) \
+                and len(node.args) >= 2:
+            target = dotted_name(node.args[1])
+            if target:
+                self.model.signal_regs.append(SignalReg(
+                    target=target, path=self.mod.path,
+                    lineno=node.lineno, fn=self.fn))
+        # ``self.X.append(...)`` mutates field X in place: a write for
+        # guarded-field classification
+        fparts = fname.split(".")
+        if (len(fparts) == 3 and fparts[0] == "self"
+                and fparts[2] in _MUTATOR_METHODS and self.fn.class_name):
+            self.facts.touches.append(FieldTouch(
+                attr=fparts[1], path=self.mod.path, lineno=node.lineno,
+                fn=self.fn, is_write=True, held=self._snapshot()))
+        if self.held:
+            self.facts.held_calls.append(HeldCall(
+                callee=fname, path=self.mod.path, lineno=node.lineno,
+                held=self._snapshot()))
+
+    def _handle_attribute(self, node: ast.Attribute) -> None:
+        if not (isinstance(node.value, ast.Name) and node.value.id == "self"
+                and self.fn.class_name):
+            return
+        key = (self.mod.modname, self.fn.class_name)
+        if node.attr in self.model.class_locks.get(key, {}):
+            return  # the lock itself is not a guarded field
+        if f"{self.fn.class_name}.{node.attr}" in self.mod.functions:
+            return  # a method reference, not field state
+        is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+        self.facts.touches.append(FieldTouch(
+            attr=node.attr, path=self.mod.path, lineno=node.lineno,
+            fn=self.fn, is_write=is_write, held=self._snapshot()))
+
+    def _handle_subscript(self, node: ast.Subscript) -> None:
+        # self.X[k] = v / del self.X[k]: a write to field X
+        if isinstance(node.ctx, (ast.Store, ast.Del)) \
+                and isinstance(node.value, ast.Attribute) \
+                and isinstance(node.value.value, ast.Name) \
+                and node.value.value.id == "self" and self.fn.class_name:
+            self.facts.touches.append(FieldTouch(
+                attr=node.value.attr, path=self.mod.path,
+                lineno=node.lineno, fn=self.fn, is_write=True,
+                held=self._snapshot()))
+
+
+def _walk_expr(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk an expression without entering Lambda bodies; mutator-method
+    calls on ``self.X`` are rewritten as write touches by the caller via
+    the Call handler, so plain walk order is fine here."""
+    queue: List[ast.AST] = [node]
+    while queue:
+        n = queue.pop(0)
+        yield n
+        if isinstance(n, ast.Lambda):
+            continue
+        queue.extend(ast.iter_child_nodes(n))
+
+
+def build_lock_model(project: Project) -> LockModel:
+    return LockModel(project)

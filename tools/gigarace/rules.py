@@ -1,0 +1,296 @@
+"""GL018-GL021: the lock-discipline and signal-safety rules.
+
+Registered into gigalint's rule registry, so ``scripts/lint.sh``'s one
+``python -m tools.gigalint gigapath_tpu scripts tests`` invocation (and
+every ``run_lint`` call in the tier-1 tests) runs them alongside
+GL001-GL017 with the same waiver machinery:
+
+- GL018 — a cycle in the inter-lock acquisition order (two threads
+  entering the cycle from different nodes deadlock), including
+  re-acquiring a non-reentrant lock already held on the same stack;
+- GL019 — guarded-field discipline: a field written under lock L in
+  one method and touched without L elsewhere in the same class is a
+  data race (declare intent with ``# gigarace: guarded-by _lock`` /
+  ``# gigarace: unguarded -- reason`` on the field's init line);
+- GL020 — signal-handler reachability: code reachable from a
+  ``register_signal_callback`` / ``signal.signal`` chain may not make
+  an indefinite (non-try) lock acquisition or call buffered ``print``
+  — the handler may have interrupted the very thread that holds the
+  lock (generalizes GL011 from "where handlers live" to "what handlers
+  may call");
+- GL021 — blocking calls made while holding a lock: ``Thread.join``,
+  ``Condition.wait`` on a different lock, blocking socket reads and
+  ``time.sleep`` stall every other thread contending for the lock.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.gigalint.graph import Project
+from tools.gigalint.rules import Finding, register
+from tools.gigalint.walker import ModuleInfo
+from tools.gigarace.lockmodel import LockDecl, LockModel, build_lock_model
+
+RACE_RULES = ("GL018", "GL019", "GL020", "GL021")
+
+_EXEMPT_SEGMENTS = frozenset({"scripts", "tests", "demo"})
+
+_BLOCK_KIND_PROSE = {
+    "thread_join": "Thread.join()",
+    "cond_wait": "Condition.wait() on a different lock",
+    "socket_recv": "a blocking socket read/accept",
+    "sleep": "time.sleep()",
+}
+
+
+def _exempt(mod: ModuleInfo) -> bool:
+    segments = mod.path.split("/")
+    return mod.is_test_file or any(
+        s in _EXEMPT_SEGMENTS for s in segments)
+
+
+def model_for(project: Project) -> LockModel:
+    """One LockModel per lint invocation, shared by all four rules;
+    built over the non-exempt modules only, so test/driver threading
+    never shapes the library's lock graph."""
+    cached = getattr(project, "_gigarace_model", None)
+    if cached is not None:
+        return cached
+    sub = Project(
+        modules={name: mod for name, mod in project.modules.items()
+                 if not _exempt(mod)},
+        root=project.root,
+    )
+    model = build_lock_model(sub)
+    project._gigarace_model = model
+    return model
+
+
+# ---------------------------------------------------------------------------
+# GL018 — lock-order cycles
+# ---------------------------------------------------------------------------
+
+@register(
+    "GL018",
+    "cycle in the inter-lock acquisition order: two threads entering the "
+    "cycle from different locks deadlock; establish one global order",
+)
+def check_lock_order(project: Project) -> List[Finding]:
+    model = model_for(project)
+    findings: List[Finding] = []
+    for acq in sorted(model.self_deadlocks(),
+                      key=lambda a: (a.path, a.lineno)):
+        findings.append(Finding(
+            rule="GL018", path=acq.path, lineno=acq.lineno,
+            symbol=acq.fn.qualname,
+            message=f"re-acquisition of non-reentrant lock "
+            f"'{acq.lock.name}' already held on this stack: guaranteed "
+            "self-deadlock. Split the locked region or use an RLock "
+            "with documented re-entrancy.",
+        ))
+    for scc in model.cycles():
+        in_cycle = set(scc)
+        sites = []
+        for (a, b), edges in sorted(model.edges.items()):
+            if a in in_cycle and b in in_cycle:
+                e = edges[0]
+                sites.append(e)
+        if not sites:
+            continue
+        anchor = min(sites, key=lambda e: (e.path, e.lineno))
+        chain = " -> ".join(scc + [scc[0]])
+        detail = "; ".join(
+            f"{e.src} -> {e.dst} at {e.path}:{e.lineno} ({e.note})"
+            for e in sites)
+        findings.append(Finding(
+            rule="GL018", path=anchor.path, lineno=anchor.lineno,
+            symbol=scc[0],
+            message=f"lock-order cycle {chain}: potential deadlock. "
+            f"Edges: {detail}. Pick one global acquisition order and "
+            "restructure the odd edge out (move the nested acquire "
+            "outside the outer lock).",
+        ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# GL019 — guarded-field discipline
+# ---------------------------------------------------------------------------
+
+def _own_lock_names(model: LockModel, key: Tuple[str, Optional[str]]) -> Dict[str, LockDecl]:
+    return model.class_locks.get(key, {})
+
+
+def resolved_field_guards(
+    model: LockModel,
+) -> Dict[Tuple[str, str, str], Tuple[LockDecl, list]]:
+    """(modname, class, attr) -> (guard lock, touches) for every field
+    with a resolvable guard.
+
+    The resolution GL019 enforces and ``--inventory`` reports: an
+    explicit ``# gigarace: guarded-by`` declaration wins; otherwise the
+    class's own lock held during the most non-``__init__`` writes.
+    Fields declared ``# gigarace: unguarded`` are excluded.
+    """
+    by_field: Dict[Tuple[str, str, str], list] = {}
+    for fn, facts in model.fn_facts.items():
+        if not fn.class_name:
+            continue
+        for t in facts.touches:
+            by_field.setdefault(
+                (fn.module.modname, fn.class_name, t.attr), []).append(t)
+    out: Dict[Tuple[str, str, str], Tuple[LockDecl, list]] = {}
+    for (modname, cls, attr), touches in by_field.items():
+        if (modname, cls, attr) in model.unguarded_decls:
+            continue
+        own = _own_lock_names(model, (modname, cls))
+        if not own:
+            continue
+        own_names = {d.name for d in own.values()}
+        guard: Optional[LockDecl] = None
+        declared = model.guarded_decls.get((modname, cls, attr))
+        if declared is not None:
+            guard = own.get(declared) or model.class_locks.get(
+                (modname, None), {}).get(declared)
+        else:
+            counts: Dict[str, int] = {}
+            for t in touches:
+                if not t.is_write or t.fn.name == "__init__":
+                    continue
+                for h in t.held:
+                    if h.name in own_names:
+                        counts[h.name] = counts.get(h.name, 0) + 1
+            if counts:
+                best = max(sorted(counts), key=lambda n: counts[n])
+                guard = model.locks.get(best)
+        if guard is not None:
+            out[(modname, cls, attr)] = (guard, touches)
+    return out
+
+
+@register(
+    "GL019",
+    "field written under a lock in one method but touched without it in "
+    "another: a data race; hold the guard at every touch or declare "
+    "'# gigarace: unguarded -- reason' for single-owner handoffs",
+)
+def check_guarded_fields(project: Project) -> List[Finding]:
+    model = model_for(project)
+    findings: List[Finding] = []
+    for (modname, cls, attr), (guard, touches) in sorted(
+            resolved_field_guards(model).items()):
+        for t in sorted(touches, key=lambda t: (t.path, t.lineno)):
+            if t.fn.name == "__init__":
+                continue  # construction happens-before publication
+            if guard.name in {h.name for h in t.held}:
+                continue
+            kind = "written" if t.is_write else "read"
+            findings.append(Finding(
+                rule="GL019", path=t.path, lineno=t.lineno,
+                symbol=t.fn.qualname,
+                message=f"field '{attr}' of {cls} is guarded by "
+                f"'{guard.name}' (written under it elsewhere) but {kind} "
+                "here without holding it: data race. Acquire the guard, "
+                "or declare the field '# gigarace: unguarded -- reason' "
+                "at its __init__ assignment if ownership transfer makes "
+                "this safe.",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# GL020 — signal-handler reachability
+# ---------------------------------------------------------------------------
+
+@register(
+    "GL020",
+    "signal-handler-reachable code performs an indefinite lock acquire or "
+    "buffered print: the handler may have interrupted the thread holding "
+    "that very lock — use the *_from_signal try-acquire surface",
+)
+def check_signal_reachability(project: Project) -> List[Finding]:
+    model = model_for(project)
+    findings: List[Finding] = []
+    reached = model.signal_reachable()
+    for fn in sorted(reached, key=lambda f: (f.module.path, f.lineno)):
+        why = reached[fn]
+        facts = model.fn_facts.get(fn)
+        if facts is None:
+            continue
+        for acq in facts.acquisitions:
+            if not acq.blocking:
+                continue
+            findings.append(Finding(
+                rule="GL020", path=acq.path, lineno=acq.lineno,
+                symbol=fn.qualname,
+                message=f"indefinite acquire of '{acq.lock.name}' in "
+                f"signal-handler-reachable code ({why}): the signal may "
+                "have interrupted the thread that holds it — "
+                "self-deadlock. Use acquire(timeout=...) and drop on "
+                "contention (the *_from_signal discipline).",
+            ))
+        for site in fn.calls:
+            if site.callee == "print":
+                findings.append(Finding(
+                    rule="GL020", path=fn.module.path, lineno=site.lineno,
+                    symbol=fn.qualname,
+                    message=f"buffered print() in signal-handler-reachable "
+                    f"code ({why}): stdio buffers lock internally — use "
+                    "os.write (the echo_from_signal discipline).",
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# GL021 — blocking calls while holding a lock
+# ---------------------------------------------------------------------------
+
+@register(
+    "GL021",
+    "blocking call (Thread.join / Condition.wait on another lock / socket "
+    "recv / sleep) while holding a lock: every contending thread stalls "
+    "for the full blocking duration",
+)
+def check_blocking_under_lock(project: Project) -> List[Finding]:
+    model = model_for(project)
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int, str]] = set()
+    for fn, facts in sorted(model.fn_facts.items(),
+                            key=lambda kv: (kv[0].module.path, kv[0].lineno)):
+        for op in facts.block_ops:
+            if not op.held:
+                continue
+            held = ", ".join(sorted({h.name for h in op.held}))
+            key = (op.path, op.lineno, op.kind)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(Finding(
+                rule="GL021", path=op.path, lineno=op.lineno,
+                symbol=fn.qualname,
+                message=f"{_BLOCK_KIND_PROSE[op.kind]} ({op.detail}) while "
+                f"holding [{held}]: every thread contending for the lock "
+                "stalls for the full blocking duration. Move the blocking "
+                "call outside the locked region.",
+            ))
+        for call in facts.held_calls:
+            reasons = []
+            for callee in model.resolve_callees(fn, call.callee):
+                for kind, why in sorted(model.may_block(callee).items()):
+                    reasons.append(f"{_BLOCK_KIND_PROSE[kind]} ({why})")
+            if not reasons:
+                continue
+            key = (call.path, call.lineno, "call")
+            if key in seen:
+                continue
+            seen.add(key)
+            held = ", ".join(sorted({h.name for h in call.held}))
+            findings.append(Finding(
+                rule="GL021", path=call.path, lineno=call.lineno,
+                symbol=fn.qualname,
+                message=f"call to '{call.callee}' may block — "
+                f"{'; '.join(reasons)} — while holding [{held}]. Move "
+                "the call outside the locked region.",
+            ))
+    return findings

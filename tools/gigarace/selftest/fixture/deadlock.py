@@ -1,0 +1,58 @@
+"""Seeded GL018 violations: a lock-order cycle and a self-deadlock.
+
+``LedgerPair`` takes its two locks in opposite orders from ``flush``
+and ``merge`` — two threads entering from different sides deadlock.
+``Reentry`` re-acquires a non-reentrant lock it already holds.
+``OrderedPair`` is the negative control: same two-lock nesting, one
+global order, no finding.
+"""
+
+import threading
+
+
+class LedgerPair:
+    def __init__(self):
+        self._index_lock = threading.Lock()
+        self._journal_lock = threading.Lock()
+        self._rows = []
+
+    def flush(self):
+        with self._journal_lock:        # journal -> index
+            with self._index_lock:
+                self._rows.clear()
+
+    def merge(self):
+        with self._index_lock:          # index -> journal: the cycle
+            with self._journal_lock:
+                self._rows.append(0)
+
+
+class Reentry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._spins = 0
+
+    def seeded_self_deadlock(self):
+        with self._lock:
+            self._lock.acquire()        # already held, non-reentrant
+            self._spins += 1
+            self._lock.release()
+
+
+class OrderedPair:
+    """Negative control: both methods honor the a-before-b order."""
+
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+        self._items = []
+
+    def negative_control_push(self, item):
+        with self._a_lock:
+            with self._b_lock:
+                self._items.append(item)
+
+    def negative_control_drain(self):
+        with self._a_lock:
+            with self._b_lock:
+                self._items.clear()

@@ -1,0 +1,51 @@
+"""Seeded GL021 violations: blocking calls made while holding a lock.
+
+A ``Thread.join()`` and a ``time.sleep()`` inside locked regions, and a
+``Condition.wait()`` entered while a DIFFERENT lock is held — every
+contending thread stalls for the full blocking duration. The negative
+controls do the same blocking calls with no foreign lock held.
+"""
+
+import threading
+import time
+
+
+class WorkerPool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._worker = threading.Thread(target=_noop)
+        self._stopped = False
+
+    def seeded_join_under_lock(self):
+        with self._lock:
+            self._stopped = True
+            self._worker.join()         # join while holding _lock
+
+    def seeded_sleep_under_lock(self):
+        with self._lock:
+            time.sleep(0.1)             # sleep while holding _lock
+
+    def negative_control_join(self):
+        with self._lock:
+            self._stopped = True
+        self._worker.join()
+
+
+class TwoPhase:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._ready = False
+
+    def seeded_wait_under_foreign_lock(self):
+        with self._lock:
+            with self._cond:
+                self._cond.wait(timeout=0.1)    # parks holding _lock
+
+    def negative_control_wait(self):
+        with self._cond:
+            self._cond.wait(timeout=0.1)
+
+
+def _noop():
+    return None

@@ -1,0 +1,36 @@
+"""Seeded GL019 violations: unguarded touches of a lock-guarded field.
+
+``_counts`` is written under ``_lock`` in ``bump`` but read and mutated
+lock-free elsewhere — the data race the rule exists for. The two
+annotated fields are the negative controls: ``guarded-by`` with every
+touch under the lock, and ``unguarded`` for a declared single-owner
+handoff.
+"""
+
+import threading
+
+
+class StatsBoard:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = {}
+        self._guarded_total = 0   # gigarace: guarded-by _lock
+        self._handoff = None      # gigarace: unguarded -- set once before the worker starts; single-owner handoff
+
+    def bump(self, key):
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + 1
+            self._guarded_total += 1
+
+    def seeded_unguarded_read(self):
+        return dict(self._counts)       # read without the guard
+
+    def seeded_unguarded_clear(self):
+        self._counts.clear()            # in-place mutation without it
+
+    def negative_control_guarded_read(self):
+        with self._lock:
+            return self._guarded_total
+
+    def negative_control_handoff(self):
+        return self._handoff

@@ -1,0 +1,58 @@
+"""Seeded GL020 violations: signal-reachable blocking acquire + print.
+
+``DrainHook`` installs ``_on_term`` via ``signal.signal``; the handler
+calls ``_report``, which makes an indefinite ``with self._lock:``
+acquisition — the signal may have interrupted the very thread that
+holds it. The handler also calls buffered ``print``. ``BudgetHook``
+seeds the same reachability through a ``register_signal_callback``
+chain. ``negative_control_from_signal`` is the sanctioned discipline:
+try-acquire with a timeout, drop on contention.
+"""
+
+import signal
+import threading
+
+_CALLBACKS = []
+
+
+def register_signal_callback(cb):
+    """Stand-in for the flight-recorder signal-callback registry."""
+    _CALLBACKS.append(cb)
+
+
+class DrainHook:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = 0
+
+    def install(self):
+        signal.signal(signal.SIGTERM, self._on_term)
+
+    def _on_term(self, signum, frame):
+        self._report()
+        print("draining")               # buffered stdio in a handler
+
+    def _report(self):
+        with self._lock:                # indefinite, signal-reachable
+            self._pending += 1
+
+    def negative_control_from_signal(self):
+        if not self._lock.acquire(timeout=0.1):
+            return
+        try:
+            self._pending += 1
+        finally:
+            self._lock.release()
+
+
+class BudgetHook:
+    def __init__(self):
+        self._budget_lock = threading.Lock()
+        self._spent = 0
+
+    def install(self):
+        register_signal_callback(self._on_signal)
+
+    def _on_signal(self):
+        with self._budget_lock:         # reachable via the callback chain
+            self._spent += 1
