@@ -39,6 +39,7 @@ from __future__ import annotations
 import dataclasses
 import glob
 import hashlib
+import json
 import os
 import threading
 import time
@@ -49,6 +50,8 @@ from gigapath_tpu.obs.locktrace import make_condition
 
 import numpy as np
 
+from gigapath_tpu.obs.clock import (ClockSample, LinkClock, emit_clock_sync)
+from gigapath_tpu.obs.metrics import get_metrics
 from gigapath_tpu.obs.runlog import env_number
 
 
@@ -144,7 +147,14 @@ class EmbeddingChunk:
     """One contiguous tile range of one slide's embeddings in flight.
 
     ``seq == chunk_id`` (see :func:`plan_chunks`); ``producer`` is
-    provenance for the report, never protocol state."""
+    provenance for the report, never protocol state. ``trace_id`` /
+    ``parent_span_id`` carry the fleet trace context across the boundary
+    (:mod:`gigapath_tpu.obs.reqtrace`): the parent is the producer's
+    structural ``send`` span id, computed at build time, so the
+    consumer's ``deliver`` span joins the causal tree without any
+    side-channel. Like ``producer``, they stay OUTSIDE the checksum —
+    observability fields must never change the assembled bytes'
+    verification."""
 
     slide_id: str
     chunk_id: int
@@ -154,6 +164,8 @@ class EmbeddingChunk:
     coords: Optional[np.ndarray] = None    # [stop-start, 2] float32
     producer: str = ""
     checksum: str = ""
+    trace_id: str = ""
+    parent_span_id: str = ""
 
     @property
     def seq(self) -> int:
@@ -162,7 +174,9 @@ class EmbeddingChunk:
     @classmethod
     def build(cls, slide_id: str, chunk_id: int, start: int, stop: int,
               payload: np.ndarray, coords: Optional[np.ndarray] = None,
-              producer: str = "", digest: bool = True) -> "EmbeddingChunk":
+              producer: str = "", digest: bool = True,
+              trace_id: str = "",
+              parent_span_id: str = "") -> "EmbeddingChunk":
         """``digest=False`` skips the sha256 (checksum stays ``""``) —
         ONLY for intra-process channels, where the handoff is a memory
         reference that cannot corrupt and hashing hundreds of MB per
@@ -183,6 +197,7 @@ class EmbeddingChunk:
             producer=producer,
             checksum=chunk_checksum(slide_id, chunk_id, start, stop,
                                     payload, coords) if digest else "",
+            trace_id=trace_id, parent_span_id=parent_span_id,
         )
 
     def verify(self) -> bool:
@@ -224,6 +239,72 @@ def _emit_backpressure(runlog, *, channel: str, seq: int, queue_depth: int,
             "backpressure", channel=channel, seq=seq, credits=0,
             queue_depth=queue_depth, capacity=capacity,
         )
+
+
+class LinkTelemetry:
+    """Per-(producer, consumer)-link labeled instruments
+    (:mod:`gigapath_tpu.obs.metrics`): the channel-health view the fleet
+    report's ``== fleet ==`` per-link table renders. One instance per
+    producing half of a cross-process channel; the link label is
+    ``{transport}.{producer}`` (the consumer side is the single fan-in
+    point, so the producer id identifies the link).
+
+    Instruments (all ``dist.link.{link}.*``):
+
+    - ``credits_in_flight`` (gauge) — credits currently consumed;
+    - ``unacked_depth``     (gauge) — sent-unacked chunks;
+    - ``ack_lag_chunks``    (gauge) — chunks past the ack watermark
+      (for this protocol: the unacked set's size);
+    - ``ack_lag_s``         (gauge) — age of the OLDEST unacked chunk
+      (how long the watermark has been stuck);
+    - ``backpressure_s``    (counter) — producer wall spent credit-blocked;
+    - ``retransmits``       (counter) — timer-driven re-sends;
+    - ``bytes``             (counter) — payload/frame bytes pushed.
+
+    Built on :func:`~gigapath_tpu.obs.metrics.get_metrics`, so with obs
+    (or metrics) off every instrument is the shared null — zero
+    overhead, no locks. The final ``metrics`` snapshot rides the
+    registry's existing closer flush."""
+
+    def __init__(self, runlog, link: str):
+        registry = get_metrics(runlog)
+        self.link = link
+        prefix = f"dist.link.{link}"
+        self.credits_in_flight = registry.gauge(f"{prefix}.credits_in_flight")
+        self.unacked_depth = registry.gauge(f"{prefix}.unacked_depth")
+        self.ack_lag_chunks = registry.gauge(f"{prefix}.ack_lag_chunks")
+        self.ack_lag_s = registry.gauge(f"{prefix}.ack_lag_s")
+        self.backpressure_s = registry.counter(f"{prefix}.backpressure_s")
+        self.retransmits = registry.counter(f"{prefix}.retransmits")
+        self.bytes = registry.counter(f"{prefix}.bytes")
+
+    def on_send(self, nbytes: int) -> None:
+        self.bytes.inc(max(int(nbytes), 0))
+
+    def on_blocked(self, seconds: float) -> None:
+        self.backpressure_s.inc(max(float(seconds), 0.0))
+
+    def on_retransmit(self, n: int = 1) -> None:
+        self.retransmits.inc(n)
+
+    def set_depth(self, *, unacked: int, capacity: int,
+                  oldest_sent_at: Optional[float],
+                  now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        self.credits_in_flight.set(min(unacked, capacity))
+        self.unacked_depth.set(unacked)
+        self.ack_lag_chunks.set(unacked)
+        self.ack_lag_s.set(0.0 if oldest_sent_at is None
+                           else max(now - oldest_sent_at, 0.0))
+
+
+def chunk_nbytes(chunk: EmbeddingChunk) -> int:
+    """Payload bytes a send pushes across the link (the dir transport's
+    byte accounting; the TCP transport counts real frame bytes)."""
+    n = int(chunk.payload.nbytes)
+    if chunk.coords is not None:
+        n += int(chunk.coords.nbytes)
+    return n
 
 
 # ---------------------------------------------------------------------------
@@ -384,17 +465,70 @@ class DirChannelProducer:
         self._chunks: Dict[int, EmbeddingChunk] = {}  # unacked payloads
         self._nonce = 0
         self._episode_seq: Optional[int] = None   # backpressure dedup
+        self.telemetry = LinkTelemetry(
+            runlog, f"{name}.{producer or 'p'}")
+        # clock alignment (obs/clock.py): one ping/pong file exchange per
+        # producer lifetime — the dir transport is same-machine (shared
+        # monotonic clock), so a single sample documents offset ~= 0 with
+        # an honest poll-cadence uncertainty bound
+        self.clock = LinkClock(f"{name}.{producer or 'p'}")
+        self._clock_ping: Optional[Tuple[str, float]] = None
+        self._send_clock_ping()
+
+    # -- clock alignment --------------------------------------------------
+    def _send_clock_ping(self) -> None:
+        tag = self.producer or "p"
+        path = os.path.join(self.dir, f"clock-ping-{tag}-{os.getpid()}.json")
+        t_send = time.monotonic()
+        try:
+            tmp = f"{path}.tmp-{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump({"link": self.clock.link, "t_send": t_send}, fh)
+            os.replace(tmp, path)
+        except OSError:
+            return  # clock sync is best-effort; the channel must not care
+        self._clock_ping = (path, t_send)
+
+    def _poll_clock(self) -> None:
+        """Complete an outstanding ping if the consumer answered: fold
+        the four-timestamp sample, emit one ``clock_sync`` event, clean
+        both files up."""
+        if self._clock_ping is None:
+            return
+        path, t_send = self._clock_ping
+        pong = path.replace("clock-ping-", "clock-pong-")
+        if not os.path.exists(pong):
+            return
+        t_ack = time.monotonic()
+        try:
+            with open(pong, "r", encoding="utf-8") as fh:
+                doc = json.load(fh)
+            sample = ClockSample(t_send=t_send, t_recv=float(doc["t_recv"]),
+                                 t_reply=float(doc["t_reply"]), t_ack=t_ack)
+        except (OSError, ValueError, KeyError):
+            return  # torn pong: re-read next poll
+        est = self.clock.update(sample)
+        emit_clock_sync(self._runlog, self.clock, est)
+        self._clock_ping = None
+        _unlink_quiet(path)
+        _unlink_quiet(pong)
 
     # -- protocol ---------------------------------------------------------
     def _ack_path(self, seq: int) -> str:
         return os.path.join(self.dir, f"ack-{seq:06d}")
 
     def _refresh_acks(self) -> None:
+        self._poll_clock()
         for seq in list(self._sent_at):
             if os.path.exists(self._ack_path(seq)):
                 self._sent_at.pop(seq, None)
                 self._chunks.pop(seq, None)
                 self.stats.acked += 1
+        self.telemetry.set_depth(
+            unacked=len(self._sent_at), capacity=self.cfg.capacity,
+            oldest_sent_at=min(self._sent_at.values())
+            if self._sent_at else None,
+        )
 
     def _write(self, chunk: EmbeddingChunk) -> None:
         self._nonce += 1
@@ -410,6 +544,8 @@ class DirChannelProducer:
             payload=chunk.payload,
             producer=np.array(chunk.producer or self.producer),
             checksum=np.array(chunk.checksum),
+            trace_id=np.array(chunk.trace_id),
+            parent_span_id=np.array(chunk.parent_span_id),
         )
         if chunk.coords is not None:
             arrays["coords"] = chunk.coords
@@ -447,14 +583,18 @@ class DirChannelProducer:
                         capacity=self.cfg.capacity,
                     )
             if deadline is not None and time.monotonic() >= deadline:
-                self.stats.blocked_s += time.monotonic() - blocked_at
+                blocked = time.monotonic() - blocked_at
+                self.stats.blocked_s += blocked
+                self.telemetry.on_blocked(blocked)
                 raise TimeoutError(
                     f"{self.name}: no credit within {timeout}s "
                     f"(seq {chunk.seq})"
                 )
             time.sleep(self.cfg.poll_s)
         if blocked_at is not None:
-            self.stats.blocked_s += time.monotonic() - blocked_at
+            blocked = time.monotonic() - blocked_at
+            self.stats.blocked_s += blocked
+            self.telemetry.on_blocked(blocked)
         self._sent_at[chunk.seq] = time.monotonic()
         self._chunks[chunk.seq] = chunk
         self.stats.sent += 1
@@ -462,8 +602,10 @@ class DirChannelProducer:
             self.stats.dropped += 1
             return
         self._write(chunk)
+        self.telemetry.on_send(chunk_nbytes(chunk))
         if self._chaos is not None and self._chaos.dups_chunk(chunk.seq):
             self._write(chunk)
+            self.telemetry.on_send(chunk_nbytes(chunk))
 
     def pump_retransmits(self, now: Optional[float] = None) -> int:
         """Re-send every chunk unacked for longer than ``retransmit_s``.
@@ -480,6 +622,8 @@ class DirChannelProducer:
                 self._write(chunk)
                 self._sent_at[seq] = now
                 self.stats.retransmits += 1
+                self.telemetry.on_retransmit()
+                self.telemetry.on_send(chunk_nbytes(chunk))
                 n += 1
         return n
 
@@ -519,6 +663,10 @@ class DirChannelConsumer:
                     coords=None if coords is None else np.asarray(coords),
                     producer=str(z["producer"]),
                     checksum=str(z["checksum"]),
+                    trace_id=str(z["trace_id"])
+                    if "trace_id" in z.files else "",
+                    parent_span_id=str(z["parent_span_id"])
+                    if "parent_span_id" in z.files else "",
                 )
         except (OSError, ValueError, KeyError, zipfile.BadZipFile):
             # a torn archive can only be a racing writer's tmp that
@@ -531,6 +679,7 @@ class DirChannelConsumer:
         are counted and dropped."""
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
+            self._answer_clock_pings()
             for path in sorted(glob.glob(os.path.join(self.dir, "chunk-*.npz"))):
                 name = os.path.basename(path)
                 try:
@@ -565,6 +714,24 @@ class DirChannelConsumer:
             if deadline is not None and time.monotonic() >= deadline:
                 return None
             time.sleep(self.cfg.poll_s)
+
+    def _answer_clock_pings(self) -> None:
+        """Answer outstanding clock pings (obs/clock.py's dir-transport
+        half): stamp this process's monotonic clock into an atomic pong
+        the pinging producer completes its sample from. Idempotent — an
+        already-answered ping is skipped."""
+        for path in glob.glob(os.path.join(self.dir, "clock-ping-*.json")):
+            pong = path.replace("clock-ping-", "clock-pong-")
+            if os.path.exists(pong):
+                continue
+            now = time.monotonic()
+            try:
+                tmp = f"{pong}.tmp-{os.getpid()}"
+                with open(tmp, "w", encoding="utf-8") as fh:
+                    json.dump({"t_recv": now, "t_reply": now}, fh)
+                os.replace(tmp, pong)
+            except OSError:
+                continue  # best-effort: the producer just re-polls
 
     def ack(self, seq: int) -> None:
         atomic_touch(os.path.join(self.dir, f"ack-{seq:06d}"))

@@ -90,6 +90,11 @@ def default_plan(*, slide_id: str = "slide0", n_tiles: int = 64,
         encoder_seed=int(encoder_seed), lease_s=float(lease_s),
         credits=int(credits), retransmit_s=float(retransmit_s),
         poll_s=float(poll_s), chunked_prefill=bool(chunked_prefill),
+        # the fleet-wide trace id, minted HERE at plan time: every
+        # process reads it from plan.json, so producer and consumer
+        # spans join one causal tree with zero coordination
+        # (obs/reqtrace.py TraceContext)
+        trace_id=f"tr-{slide_id}-{os.urandom(4).hex()}",
     )
     if transport is not None:
         plan["transport"] = str(transport)
@@ -384,6 +389,22 @@ def run_slide_consumer(root: str, *, runlog=None,
                              transport=transport or plan.get("transport"),
                              delivered=watermark,
                              run_id=getattr(runlog, "run_id", ""))
+    from gigapath_tpu.obs.reqtrace import get_tracer
+    from gigapath_tpu.obs.spans import span
+
+    # the consumer's half of the fleet trace (same plan-minted trace id
+    # as every worker): deliver/fold/checkpoint/finalize spans, plus the
+    # recovery gap as an EXPLICIT annotated span — detection to first
+    # replayed chunk readable straight off the merged timeline
+    ctx = get_tracer(runlog).context(
+        str(plan.get("trace_id", "")), actor="consumer",
+        name=str(plan.get("slide_id", "")),
+    )
+    # open recovery gap: (t_detect, action, who, closing chunk-id set —
+    # None = the next delivered chunk closes it)
+    gap_open: Optional[tuple] = None
+    if restored_state is not None:
+        gap_open = (time.monotonic(), "consumer_resume", "consumer", None)
 
     # who currently owns which chunk (updated by reassignments): the
     # coordinator's view of the SAME deterministic assignment the
@@ -405,10 +426,15 @@ def run_slide_consumer(root: str, *, runlog=None,
         the checkpoint that makes it so. With checkpointing off, acks
         are immediate and this only flushes."""
         if checkpointer is not None and (pending_acks or final):
-            checkpointer.save(
-                len(assembler.received),
-                _export_consumer_state(assembler, session),
-            )
+            # chunk= the covered watermark: discriminates the structural
+            # span id per commit (checkpoints repeat; spans must not
+            # dedup into one)
+            with span("dist.checkpoint", runlog, trace=ctx,
+                      chunk=len(assembler.received)):
+                checkpointer.save(
+                    len(assembler.received),
+                    _export_consumer_state(assembler, session),
+                )
         while pending_acks:
             consumer.ack(pending_acks.pop(0))
 
@@ -454,15 +480,36 @@ def run_slide_consumer(root: str, *, runlog=None,
                 write_reassignment(root, lost_worker=lost,
                                    assignments=new_owners, runlog=runlog)
                 reassignments += 1
+                # the recovery gap opens at DETECTION and closes at the
+                # first replayed chunk of the reassigned set — see the
+                # delivery path below
+                gap_open = (time.monotonic(), "reassign", lost,
+                            set(pending))
             chunk = consumer.recv(timeout=cfg.poll_s * 5)
             if chunk is None:
                 continue
+            t_arrived = time.monotonic()
             if not assembler.add(chunk):
                 # belt under the transport's dedup suspenders: already
                 # held (and, with a checkpoint, already durable) — ack
                 # so the producer's credit comes home
                 consumer.ack(chunk.seq)
                 continue
+            # the cross-process causal link: the chunk header carries the
+            # producer's structural send-span id, so this deliver span
+            # parents on it and the fleet merger draws the flow arrow
+            ctx.add_span("deliver", t_arrived, time.monotonic(),
+                         chunk=chunk.chunk_id,
+                         parent=chunk.parent_span_id or None,
+                         producer=chunk.producer)
+            if gap_open is not None and (gap_open[3] is None
+                                         or chunk.chunk_id in gap_open[3]):
+                # first replayed chunk after a recovery: close the gap
+                # as one explicit annotated span on the timeline
+                ctx.add_span("recovery_gap", gap_open[0], t_arrived,
+                             chunk=chunk.chunk_id, action=gap_open[1],
+                             worker=gap_open[2])
+                gap_open = None
             if session is not None:
                 # fold on arrival: the session frontier-buffers
                 # out-of-order deliveries, so the executed fold order —
@@ -470,7 +517,10 @@ def run_slide_consumer(root: str, *, runlog=None,
                 # network's. This overlaps stage-1 production with
                 # stage-2 folding; by completion only the final layers
                 # remain.
-                session.feed(chunk.chunk_id, chunk.payload, chunk.coords)
+                with span("dist.fold", runlog, trace=ctx,
+                          chunk=chunk.chunk_id):
+                    session.feed(chunk.chunk_id, chunk.payload,
+                                 chunk.coords)
             delivered_here += 1
             if chaos:
                 # the consumer-crash injection point: AFTER the fold,
@@ -485,22 +535,23 @@ def run_slide_consumer(root: str, *, runlog=None,
                     _commit()
 
         _commit(final=True)
-        if session is not None:
-            embedding = head_fn(session.finalize())
-            runlog.event("stream_finalize", slide=plan["slide_id"],
-                         n_chunks=session.n_chunks)
-        else:
-            # the dense slide forward: jitted once, retraces watched —
-            # recovery must never show up as a recompile
-            build = forward_builder or _default_forward()
-            forward, params = build(int(plan["dim_out"]))
-            watchdog = CompileWatchdog("dist.slide_forward", runlog)
-            instrumented = watchdog.wrap(forward)
-            embedding = np.asarray(
-                instrumented(params, assembler.embeds[None],
-                             assembler.coords[None]),
-                np.float32,
-            )[0]
+        with span("dist.finalize", runlog, trace=ctx):
+            if session is not None:
+                embedding = head_fn(session.finalize())
+                runlog.event("stream_finalize", slide=plan["slide_id"],
+                             n_chunks=session.n_chunks)
+            else:
+                # the dense slide forward: jitted once, retraces
+                # watched — recovery must never show up as a recompile
+                build = forward_builder or _default_forward()
+                forward, params = build(int(plan["dim_out"]))
+                watchdog = CompileWatchdog("dist.slide_forward", runlog)
+                instrumented = watchdog.wrap(forward)
+                embedding = np.asarray(
+                    instrumented(params, assembler.embeds[None],
+                                 assembler.coords[None]),
+                    np.float32,
+                )[0]
     except BaseException:
         status = "error"
         raise

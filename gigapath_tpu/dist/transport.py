@@ -68,9 +68,11 @@ from gigapath_tpu.dist.boundary import (
     BoundaryConfig,
     ChannelStats,
     EmbeddingChunk,
+    LinkTelemetry,
     _emit_backpressure,
 )
 from gigapath_tpu.dist.membership import _read_json, atomic_write_json
+from gigapath_tpu.obs.clock import ClockSample, LinkClock, emit_clock_sync
 
 MAGIC = b"GPF1"
 _PREFIX = struct.Struct("!4sI")      # magic, body length
@@ -114,6 +116,8 @@ def chunk_to_blob(chunk: EmbeddingChunk) -> bytes:
         payload=chunk.payload,
         producer=np.array(chunk.producer),
         checksum=np.array(chunk.checksum),
+        trace_id=np.array(chunk.trace_id),
+        parent_span_id=np.array(chunk.parent_span_id),
     )
     if chunk.coords is not None:
         arrays["coords"] = chunk.coords
@@ -133,6 +137,10 @@ def blob_to_chunk(blob: bytes) -> Optional[EmbeddingChunk]:
                 coords=None if coords is None else np.asarray(coords),
                 producer=str(z["producer"]),
                 checksum=str(z["checksum"]),
+                trace_id=str(z["trace_id"])
+                if "trace_id" in z.files else "",
+                parent_span_id=str(z["parent_span_id"])
+                if "parent_span_id" in z.files else "",
             )
     except (OSError, ValueError, KeyError):
         return None
@@ -301,10 +309,22 @@ class TcpChannelConsumer:
             self._conns[sock]["producer"] = str(header.get("producer", "?"))
             # the ack watermark: what THIS consumer considers durable —
             # a reconnecting producer replays exactly the complement
-            self._send_frame(sock, {
+            reply = {
                 "type": "hello_ack", "run": self.run_id,
                 "acked": sorted(self._acked),
-            })
+            }
+            if "t_send" in header:
+                # clock alignment (obs/clock.py): echo the producer's
+                # send stamp and add this clock's receive/reply stamps —
+                # the producer completes the four-timestamp sample when
+                # the reply lands and re-estimates the link offset on
+                # EVERY (re)connect (a restarted peer is a fresh
+                # monotonic origin)
+                now = time.monotonic()
+                reply["t_send"] = header["t_send"]
+                reply["t_recv"] = now
+                reply["t_reply"] = now
+            self._send_frame(sock, reply)
             return None
         if kind == "ack":
             return None  # producers ack nothing; ignore
@@ -460,6 +480,8 @@ class TcpChannelProducer:
         self.stats = ChannelStats()
         (self._c_reconnects, self._c_frame_errors,
          self._c_bytes) = _metrics_counters(runlog)
+        self.telemetry = LinkTelemetry(runlog, f"{name}.{producer or 'p'}")
+        self.clock = LinkClock(f"{name}.{producer or 'p'}")
         self._sock: Optional[socket.socket] = None
         self._buf = FrameBuffer()           # the ack/handshake stream
         self._ever_connected = False
@@ -511,9 +533,13 @@ class TcpChannelProducer:
             now = time.monotonic()
             for seq in self._sent_at:
                 self._sent_at[seq] = now
+        # every (re)connect re-estimates the link clock: the peer may be
+        # a restarted process with a brand-new monotonic origin
+        self.clock.resync()
         self._raw_send(encode_frame({
             "type": "hello", "run": self.run_id,
             "producer": self.producer,
+            "t_send": time.monotonic(),
         }))
         if self._sock is None:  # the hello send itself failed
             return False
@@ -547,6 +573,7 @@ class TcpChannelProducer:
             self._transmit(chunk)
             self._sent_at[seq] = time.monotonic()
             self.stats.retransmits += 1
+            self.telemetry.on_retransmit()
 
     def _ensure_connected(self,
                           deadline: Optional[float] = None) -> bool:
@@ -578,6 +605,7 @@ class TcpChannelProducer:
             self._sock.sendall(frame)
             self.stats.bytes_sent += len(frame)
             self._c_bytes.inc(len(frame))
+            self.telemetry.on_send(len(frame))
         except OSError:
             self._close_sock()
 
@@ -613,6 +641,7 @@ class TcpChannelProducer:
                         self._sock.sendall(half)
                         self.stats.bytes_sent += len(half)
                         self._c_bytes.inc(len(half))
+                        self.telemetry.on_send(len(half))
                     except OSError:
                         pass
                 self._close_sock()
@@ -655,11 +684,40 @@ class TcpChannelProducer:
                         self._chunks.pop(seq, None)
                         self.stats.acked += 1
                 elif header.get("type") == "hello_ack":
+                    self._fold_clock_sample(header)
                     self._on_watermark(header.get("acked", []))
+
+    def _fold_clock_sample(self, header: dict) -> None:
+        """Complete the four-timestamp sample the ``hello`` opened: the
+        ``hello_ack`` echoes ``t_send`` and carries the consumer's
+        ``t_recv``/``t_reply``; the ack stamp is taken here, when the
+        reply surfaces from the drain. One ``clock_sync`` event per
+        folded sample."""
+        if "t_send" not in header:
+            return  # pre-clock peer: no sample, offset stays 0
+        try:
+            sample = ClockSample(
+                t_send=float(header["t_send"]),
+                t_recv=float(header["t_recv"]),
+                t_reply=float(header["t_reply"]),
+                t_ack=time.monotonic(),
+            )
+        except (KeyError, TypeError, ValueError):
+            return  # malformed stamps: drop the sample, never the link
+        est = self.clock.update(sample)
+        emit_clock_sync(self._runlog, self.clock, est)
+
+    def _update_depth(self) -> None:
+        self.telemetry.set_depth(
+            unacked=len(self._sent_at), capacity=self.cfg.capacity,
+            oldest_sent_at=min(self._sent_at.values())
+            if self._sent_at else None,
+        )
 
     # -- the channel surface --------------------------------------------------
     def credits(self) -> int:
         self._drain_acks()
+        self._update_depth()
         return max(self.cfg.capacity - len(self._sent_at), 0)
 
     def unacked_seqs(self) -> List[int]:
@@ -686,14 +744,18 @@ class TcpChannelProducer:
                         capacity=self.cfg.capacity,
                     )
             if deadline is not None and time.monotonic() >= deadline:
-                self.stats.blocked_s += time.monotonic() - blocked_at
+                blocked = time.monotonic() - blocked_at
+                self.stats.blocked_s += blocked
+                self.telemetry.on_blocked(blocked)
                 raise TimeoutError(
                     f"{self.name}: no credit within {timeout}s "
                     f"(seq {chunk.seq})"
                 )
             time.sleep(self.cfg.poll_s)
         if blocked_at is not None:
-            self.stats.blocked_s += time.monotonic() - blocked_at
+            blocked = time.monotonic() - blocked_at
+            self.stats.blocked_s += blocked
+            self.telemetry.on_blocked(blocked)
         self._sent_at[chunk.seq] = time.monotonic()
         self._chunks[chunk.seq] = chunk
         self.stats.sent += 1
@@ -710,6 +772,7 @@ class TcpChannelProducer:
         every unacked chunk the moment the ``hello_ack`` arrives, and
         the timer below stays the fallback)."""
         self._drain_acks()
+        self._update_depth()
         if self._sock is None and self._sent_at:
             # ONE connect attempt per pump: the caller's poll loop is
             # the backoff here, and a worker must keep renewing its
@@ -730,6 +793,7 @@ class TcpChannelProducer:
                 self._transmit(chunk)
                 self._sent_at[seq] = now
                 self.stats.retransmits += 1
+                self.telemetry.on_retransmit()
                 n += 1
         return n
 

@@ -248,7 +248,16 @@ def run_tile_worker(root: str, worker_id: str, *,
                              runlog=runlog, chaos=chaos,
                              transport=plan.get("transport"),
                              run_id=getattr(runlog, "run_id", ""))
+    from gigapath_tpu.obs.reqtrace import get_tracer
     from gigapath_tpu.obs.spans import span
+
+    # the fleet trace context: the slide's trace id was minted at PLAN
+    # time, so this worker's encode/send/backpressure spans land in the
+    # same causal tree as the consumer's fold spans with no coordination
+    ctx = get_tracer(runlog).context(
+        str(plan.get("trace_id", "")), actor=worker_id,
+        name=str(plan.get("slide_id", "")),
+    )
 
     pending: List[int] = list(mine)
     seen_reassign: set = set()
@@ -268,17 +277,26 @@ def run_tile_worker(root: str, worker_id: str, *,
                 # process index 0): obs_report's per-rank straggler
                 # table keys on exactly this tag
                 with span("dist.chunk", runlog, rank=rank, chunk=cid,
-                          tiles=stop - start, worker=worker_id):
-                    if chaos:
-                        # inside the span: injected slowness models slow
-                        # COMPUTE, and the straggler table must see it
-                        slow = chaos.slow_worker(cid)
-                        if slow:
-                            time.sleep(slow)
-                    embeds, coords = encode(start, stop)
+                          tiles=stop - start, worker=worker_id,
+                          trace=ctx):
+                    with span("dist.encode", runlog, rank=rank, chunk=cid,
+                              worker=worker_id, trace=ctx):
+                        if chaos:
+                            # inside the span: injected slowness models
+                            # slow COMPUTE, and the straggler table (and
+                            # the fleet critical path) must see it
+                            slow = chaos.slow_worker(cid)
+                            if slow:
+                                time.sleep(slow)
+                        embeds, coords = encode(start, stop)
                     chunk = EmbeddingChunk.build(
                         plan["slide_id"], cid, start, stop, embeds,
                         coords=coords, producer=worker_id,
+                        trace_id=ctx.trace_id,
+                        # the producer's send-span id is STRUCTURAL, so
+                        # it can ride the header before the span closes:
+                        # the consumer's deliver span parents on it
+                        parent_span_id=ctx.span_id_for("send", chunk=cid),
                     )
                     # a credit-blocked send must not starve the lease:
                     # bound each wait well under the lease window and
@@ -287,6 +305,8 @@ def run_tile_worker(root: str, worker_id: str, *,
                     # retransmits between attempts too: at low credit a
                     # DROPPED earlier write can be the very thing
                     # holding every credit, and only a re-send frees it
+                    blocked0 = producer.stats.blocked_s
+                    t_send0 = time.monotonic()
                     while True:
                         lease.renew()
                         try:
@@ -304,6 +324,22 @@ def run_tile_worker(root: str, worker_id: str, *,
                             if time.monotonic() >= t_deadline:
                                 raise
                             producer.pump_retransmits()
+                    if sent:
+                        # split the send wall into credit-blocked wait
+                        # vs the actual transmit: two adjacent trace
+                        # spans, so the fleet critical path can tell
+                        # backpressure from wire time. Manual add_span
+                        # (not span()): the split is known only after
+                        # the fact, from the producer's blocked_s delta
+                        t_send1 = time.monotonic()
+                        blocked = max(
+                            producer.stats.blocked_s - blocked0, 0.0)
+                        blocked = min(blocked, t_send1 - t_send0)
+                        if blocked > 0:
+                            ctx.add_span("backpressure_wait", t_send0,
+                                         t_send0 + blocked, chunk=cid)
+                        ctx.add_span("send", t_send0 + blocked, t_send1,
+                                     chunk=cid)
                 if not sent:
                     break  # DONE appeared while credit-blocked
                 produced += 1

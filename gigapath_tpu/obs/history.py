@@ -70,6 +70,12 @@ _DIRECTION_RULES: Tuple[Tuple[str, str], ...] = (
     # resolved geometries is up-good (a DROP means dispatch silently
     # fell back to flag/defaults on geometries that used to be planned)
     ("plan_hit_rate", "up"),
+    # fleet-trace critical-path shares (dist|trace entry,
+    # scripts/dist_smoke.py --fleet-json): time the slide spent on the
+    # wire or blocked on credits is the regression; encode/fold shares
+    # ride no rule (they trade against each other as the split moves)
+    ("wire_share", "down"),
+    ("backpressure_share", "down"),
     # streaming-prefill decision-table rows (prefill|stream entry):
     # executable arg/temp/peak megabytes and stream-vs-dense ratios,
     # smaller is better
@@ -308,6 +314,29 @@ def fold_dist(doc: dict, snapshot: dict, label: str,
     return _fold_serve_snapshot(
         doc, snapshot, label, key="dist|smoke",
         metric_keys=_DIST_METRICS, source=source, force=force,
+    )
+
+
+# dist_smoke --fleet-json payload fields worth trending (the fleet
+# critical-path attribution over the merged cross-process timeline):
+# slide throughput/wall plus the share of the slide's wall charged to
+# each pipeline category by scripts/fleet_report.py's priority sweep
+_FLEET_METRICS = (
+    "chunks_per_sec", "slide_wall_s",
+    "wire_share", "backpressure_share", "encode_share", "fold_share",
+    "flows", "clock_links",
+)
+
+
+def fold_fleet(doc: dict, snapshot: dict, label: str,
+               source: Optional[str] = None, force: bool = False) -> dict:
+    """One ``dist_smoke --fleet-json`` JSON -> one point under
+    ``dist|trace`` (the fleet-timeline twin of :func:`fold_dist` — same
+    shared CPU-stale-with-keys policy: a CPU smoke carries the metric
+    keys and share shapes, only an on-chip fleet moves the trend)."""
+    return _fold_serve_snapshot(
+        doc, snapshot, label, key="dist|trace",
+        metric_keys=_FLEET_METRICS, source=source, force=force,
     )
 
 

@@ -29,6 +29,25 @@ vs the cache store? This module carries that:
   leaves the artifact; a ``trace`` event in the run JSONL records the
   path + totals for ``scripts/obs_report.py``'s ``== traces ==``.
 
+Fleet scope (ISSUE 17): a request is not the only thing with a
+cross-thread lifecycle — a SLIDE crosses PROCESSES in the
+disaggregated pipeline, and its timeline (encode on the worker, wire
+transit, fold on the consumer) must land in one causal tree.
+:class:`TraceContext` is the process-crossing face of the same
+machinery: every participant calls
+``get_tracer(runlog).context(trace_id, actor=...)`` with the
+fleet-wide trace id minted at PLAN time (``dist/pipeline.default_plan``
+stamps it into the plan document, so producers and the consumer agree
+with zero coordination), and records spans with STRUCTURAL span ids —
+``{trace_id}/{actor}/c{chunk}/{name}`` — that are stable across export,
+retransmit, and reassignment (a replayed chunk's span dedups instead of
+forking the tree). ``EmbeddingChunk`` headers carry
+``(trace_id, parent_span_id)`` so the consumer's ``deliver`` span can
+name the producer's ``send`` span as its causal parent across the
+process boundary; ``obs/fleet.py`` merges the per-process exports on
+those ids (clock-corrected via ``obs/clock.py``) into one Perfetto
+timeline with flow arrows.
+
 Zero-overhead contract: :func:`get_tracer` against a ``NullRunLog``
 (or with ``GIGAPATH_OBS`` off) returns the shared null collector whose
 traces absorb every call — no clocks, no memory, no file. Tracing
@@ -49,6 +68,15 @@ from typing import Any, Dict, List, Optional
 from gigapath_tpu.obs.locktrace import make_lock
 
 TRACE_FILE_SUFFIX = ".trace.json"
+
+
+def _hostname() -> str:
+    try:
+        import socket
+
+        return socket.gethostname()
+    except OSError:
+        return ""
 
 
 class TraceSpan:
@@ -110,7 +138,11 @@ class RequestTrace(NullRequestTrace):
 
     def add_span(self, name: str, t0: float, t1: float, **args) -> None:
         self._seq += 1
-        args["span_id"] = f"{self.trace_id}.{self._seq}"
+        if "span_id" not in args:
+            # default: positional minting (request-shaped, one owner at a
+            # time). Fleet callers pass STRUCTURAL ids via TraceContext so
+            # the same logical span is stable across retransmit/replay.
+            args["span_id"] = f"{self.trace_id}.{self._seq}"
         self.spans.append(TraceSpan(name, t0, t1, args))
 
     @property
@@ -126,6 +158,74 @@ class RequestTrace(NullRequestTrace):
             self.status = status
 
 
+class NullTraceContext:
+    """Obs-off twin of :class:`TraceContext`: absorbs every call and
+    answers ``span_id_for`` with stable EMPTY ids, so chunk headers built
+    with tracing off simply carry blank trace fields."""
+
+    trace_id = ""
+    actor = ""
+
+    def span_id_for(self, name: str, chunk: Optional[int] = None) -> str:
+        return ""
+
+    def add_span(self, name: str, t0: float, t1: float, *,
+                 chunk: Optional[int] = None, parent: Optional[str] = None,
+                 **args) -> None:
+        return None
+
+
+NULL_TRACE_CONTEXT = NullTraceContext()
+
+
+class TraceContext(NullTraceContext):
+    """One process's view of a FLEET-wide trace (one slide's causal
+    tree). Wraps a :class:`RequestTrace` whose ``trace_id`` was minted
+    externally (at plan time) and is shared by every participating
+    process; what this class adds is the cross-process contract:
+
+    - **Structural span ids** — ``{trace_id}/{actor}/c{chunk}/{name}``
+      (the ``c{chunk}`` segment only for per-chunk spans). Any process
+      can compute the id of any other process's span from the shared
+      header fields alone, which is how a chunk header can carry the
+      producer's ``send`` span id as ``parent_span_id`` BEFORE that span
+      has closed.
+    - **Idempotent appends** — a span id is recorded once; a retransmit
+      or replayed chunk re-announcing the same logical span dedups
+      instead of forking the merged tree.
+
+    Single-owner handoff is preserved: each context is owned by one
+    thread at a time (the worker send loop, the consumer fold loop),
+    exactly like the request traces it generalizes."""
+
+    __slots__ = ("_trace", "trace_id", "actor", "_seen")
+
+    def __init__(self, trace: RequestTrace, actor: str):
+        self._trace = trace
+        self.trace_id = trace.trace_id
+        self.actor = actor
+        self._seen: set = set()
+
+    def span_id_for(self, name: str, chunk: Optional[int] = None) -> str:
+        if chunk is None:
+            return f"{self.trace_id}/{self.actor}/{name}"
+        return f"{self.trace_id}/{self.actor}/c{int(chunk)}/{name}"
+
+    def add_span(self, name: str, t0: float, t1: float, *,
+                 chunk: Optional[int] = None, parent: Optional[str] = None,
+                 **args) -> None:
+        sid = self.span_id_for(name, chunk)
+        if sid in self._seen:
+            return  # replay/retransmit of an already-recorded span
+        self._seen.add(sid)
+        if chunk is not None:
+            args["chunk"] = int(chunk)
+        if parent:
+            args["parent_span_id"] = parent
+        args["actor"] = self.actor
+        self._trace.add_span(name, t0, t1, span_id=sid, **args)
+
+
 class NullTraceCollector:
     """Obs-off twin: hands out the shared null trace, exports nothing."""
 
@@ -135,6 +235,10 @@ class NullTraceCollector:
     def start(self, name: str, now: Optional[float] = None,
               **args) -> NullRequestTrace:
         return NULL_REQUEST_TRACE
+
+    def context(self, trace_id: str, *, actor: str,
+                name: Optional[str] = None) -> NullTraceContext:
+        return NULL_TRACE_CONTEXT
 
     def export(self) -> Optional[str]:
         return None
@@ -154,9 +258,13 @@ class TraceCollector(NullTraceCollector):
         self._t0 = time.monotonic()
         self._lock = make_lock("gigapath_tpu.obs.reqtrace.TraceCollector._lock")
         self._traces: List[RequestTrace] = []
+        self._contexts: Dict[str, TraceContext] = {}
         self._next = 0
         self.dropped = 0
         self._exported = False
+        # host-side, read ONCE at construction (GL001 discipline): lets a
+        # fleet launcher relabel this process's track without code changes
+        self.actor_override = os.environ.get("GIGAPATH_TRACE_ACTOR", "")
 
     def start(self, name: str, now: Optional[float] = None,
               **args) -> NullRequestTrace:
@@ -175,6 +283,37 @@ class TraceCollector(NullTraceCollector):
             )
             self._traces.append(tr)
         return tr
+
+    def context(self, trace_id: str, *, actor: str,
+                name: Optional[str] = None) -> NullTraceContext:
+        """Get-or-create the fleet context for an EXTERNALLY minted trace
+        id (the plan document's `trace_id`). Every process that calls
+        this with the same id contributes spans to the same causal tree;
+        `obs/fleet.py` joins the per-process exports on the id. Shares
+        the ``max_traces`` cap with :meth:`start` (same COUNTED-overflow
+        discipline)."""
+        if not trace_id:
+            return NULL_TRACE_CONTEXT
+        if self.actor_override:
+            actor = self.actor_override
+        # keyed by (trace_id, actor): an in-process pipeline (memory
+        # channel) hosts producer AND consumer in one collector, and each
+        # role must mint its own structural ids
+        key = f"{trace_id}\x00{actor}"
+        with self._lock:
+            ctx = self._contexts.get(key)
+            if ctx is not None:
+                return ctx
+            self._next += 1
+            if len(self._traces) >= self.max_traces:
+                self.dropped += 1
+                return NULL_TRACE_CONTEXT
+            tr = RequestTrace(trace_id, self._next, name or trace_id,
+                              time.monotonic(), {"actor": actor})
+            self._traces.append(tr)
+            ctx = TraceContext(tr, actor)
+            self._contexts[key] = ctx
+        return ctx
 
     def stats(self) -> dict:
         with self._lock:
@@ -223,7 +362,15 @@ class TraceCollector(NullTraceCollector):
                 })
         doc = {"traceEvents": events, "displayTimeUnit": "ms",
                "metadata": {"run": self.runlog.run_id,
-                            "source": "gigapath_tpu.obs.reqtrace"}}
+                            "source": "gigapath_tpu.obs.reqtrace",
+                            # fleet-merge anchors: span ts are µs past
+                            # this process's monotonic origin; fleet.py
+                            # adds the per-link clock offset to land all
+                            # processes on the consumer's axis
+                            "clock": {"t0_monotonic": self._t0},
+                            "actor": self.actor_override,
+                            "pid": os.getpid(),
+                            "host": _hostname()}}
         try:
             parent = os.path.dirname(self.path)
             if parent:
@@ -272,10 +419,13 @@ def get_tracer(runlog, *, max_traces: Optional[int] = None):
 
 __all__ = [
     "NULL_REQUEST_TRACE",
+    "NULL_TRACE_CONTEXT",
     "NullRequestTrace",
     "NullTraceCollector",
+    "NullTraceContext",
     "RequestTrace",
     "TraceCollector",
+    "TraceContext",
     "TraceSpan",
     "get_tracer",
 ]

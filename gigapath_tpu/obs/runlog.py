@@ -62,6 +62,10 @@ Event kinds (schema v1, one JSON object per line, every record carries
   stage, reason, the stale lease's pid/renewal — fires the anomaly
   engine's ``consumer_lost`` detector and precedes the
   ``recovery action="consumer_resume"`` event;
+- ``clock_sync`` — one cross-process clock-offset estimate for a
+  transport link (:mod:`gigapath_tpu.obs.clock`): link, offset/rtt/
+  uncertainty seconds, sample count, reconnect epoch — what
+  ``obs/fleet.py`` aligns per-process timelines with;
 - ``error``      — exception surfaced by a driver;
 - ``run_end``    — terminal status + summary payload.
 
@@ -88,8 +92,8 @@ SCHEMA_VERSION = 1
 EVENT_KINDS = (
     "run_start", "step", "compile", "compile_profile", "span", "eval",
     "heartbeat", "stall", "anomaly", "recovery", "serve_dispatch",
-    "cache_hit", "metrics", "slo", "trace", "backpressure", "worker_lost",
-    "consumer_lost", "error", "run_end",
+    "cache_hit", "metrics", "slo", "trace", "clock_sync", "backpressure",
+    "worker_lost", "consumer_lost", "error", "run_end",
 )
 
 

@@ -196,7 +196,7 @@ def _is_recording(runlog) -> bool:
 
 @contextlib.contextmanager
 def span(name: str, runlog=None, *, fence: Any = None, annotate: bool = False,
-         rank: Optional[int] = None, **fields):
+         rank: Optional[int] = None, trace=None, **fields):
     """Nestable timed region emitting one ``span`` event at exit.
 
     ``fence``: falsy -> no sync (dur_s is host dispatch time, marked
@@ -211,6 +211,13 @@ def span(name: str, runlog=None, *, fence: Any = None, annotate: bool = False,
     but the per-rank straggler table needs the WORKER index; an explicit
     rank also keeps a numpy-only worker from importing jax just to be
     told ``0``.
+
+    ``trace`` threads a fleet :class:`~gigapath_tpu.obs.reqtrace.TraceContext`:
+    at exit the region is MIRRORED into the context's causal tree (same
+    name, same interval, structural span id) in addition to the span
+    event. ``dist/`` library code must pass it (gigalint GL022) so no
+    per-slide region is orphaned from the cross-process timeline; a
+    ``chunk=`` field keys the mirrored span per chunk.
 
     Against a ``NullRunLog`` (``GIGAPATH_OBS=0``) the whole thing is a
     no-op: the yielded span absorbs ``fence``/``note`` calls and nothing
@@ -287,5 +294,11 @@ def span(name: str, runlog=None, *, fence: Any = None, annotate: bool = False,
                 status=status,
                 **merged,
             )
+            if trace is not None:
+                # mirror the region into the fleet causal tree; the
+                # context dedups on its structural id, so a retried
+                # region re-announcing itself cannot fork the tree
+                trace.add_span(name, t0, t0 + sp.dur_s,
+                               chunk=merged.get("chunk"), status=status)
         finally:
             _STACK.names.pop()

@@ -18,6 +18,12 @@ slide (chunk counts, fold counts, wall), so ``obs_report.py`` sees
 streaming serves next to bucketed ones. Out-of-order and duplicate
 chunk delivery are absorbed by the session's deterministic fold
 frontier (bit-parity per the dist boundary's contract).
+
+Fleet tracing (ISSUE 17): ``open(..., trace=ctx)`` threads a
+:class:`~gigapath_tpu.obs.reqtrace.TraceContext` so each fold and the
+finalize land as ``fold`` / ``finalize`` spans in the slide's
+cross-process causal tree. Duplicate deliveries dedup on the context's
+structural span id, so a replayed chunk cannot fork the tree.
 """
 
 from __future__ import annotations
@@ -45,9 +51,10 @@ class StreamingSlideSession:
     surface the parity tests pin)."""
 
     def __init__(self, submitter: "StreamingSubmitter", slide_id: str,
-                 n_tiles: int):
+                 n_tiles: int, trace=None):
         self.submitter = submitter
         self.slide_id = slide_id
+        self.trace = trace
         self.session = StreamingEncoderSession(
             submitter.model, submitter.params, int(n_tiles),
             chunk_tiles=submitter.chunk_tiles, all_layer_embed=True,
@@ -65,16 +72,26 @@ class StreamingSlideSession:
         """Fold one chunk (any arrival order). Returns the fold
         frontier — how many chunks are folded so far."""
         if embeds is None:
-            return self.session.feed(chunk.chunk_id, chunk.payload,
-                                     chunk.coords)
-        return self.session.feed(int(chunk), embeds, coords)
+            cid, embeds, coords = chunk.chunk_id, chunk.payload, chunk.coords
+            parent = getattr(chunk, "parent_span_id", "") or None
+        else:
+            cid, parent = int(chunk), None
+        t0 = time.monotonic()
+        frontier = self.session.feed(cid, embeds, coords)
+        if self.trace is not None:
+            self.trace.add_span("fold", t0, time.monotonic(), chunk=cid,
+                                parent=parent)
+        return frontier
 
     def pending(self) -> List[int]:
         return self.session.pending()
 
     def result(self) -> Dict[str, np.ndarray]:
         if self._outputs is None:
+            t0 = time.monotonic()
             self._outputs = embeds_to_outputs(self.session.finalize())
+            if self.trace is not None:
+                self.trace.add_span("finalize", t0, time.monotonic())
             self.submitter.served += 1
             if self.submitter.runlog is not None:
                 self.submitter.runlog.event(
@@ -103,16 +120,17 @@ class StreamingSubmitter:
         self.name = name
         self.served = 0
 
-    def open(self, slide_id: str, n_tiles: int) -> StreamingSlideSession:
-        return StreamingSlideSession(self, slide_id, n_tiles)
+    def open(self, slide_id: str, n_tiles: int,
+             trace=None) -> StreamingSlideSession:
+        return StreamingSlideSession(self, slide_id, n_tiles, trace=trace)
 
-    def stream_slide(self, slide_id: str, chunks,
-                     n_tiles: int) -> Dict[str, np.ndarray]:
+    def stream_slide(self, slide_id: str, chunks, n_tiles: int,
+                     trace=None) -> Dict[str, np.ndarray]:
         """Convenience: open + feed an iterable/channel of chunks +
         result, folding each chunk the moment the iterable yields it
         (a blocking channel ``recv`` loop overlaps production with the
         folds for free)."""
-        session = self.open(slide_id, n_tiles)
+        session = self.open(slide_id, n_tiles, trace=trace)
         for chunk in chunks:
             session.feed(chunk)
         return session.result()

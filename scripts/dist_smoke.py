@@ -4,12 +4,13 @@ cross-stage boundary (ISSUE 11's acceptance driver).
 
     python scripts/dist_smoke.py
     python scripts/dist_smoke.py --json DIST_SMOKE.json
+    python scripts/dist_smoke.py --fleet-json FLEET_SMOKE.json
 
-Eight checks, each a hard assertion (exit 1 + structured JSON on
+Nine checks, each a hard assertion (exit 1 + structured JSON on
 violation, bench.py-style; progress rides stderr). Every check runs a
 REAL fleet: tile-worker OS processes + the slide-stage consumer, joined
 by the boundary channel (``gigapath_tpu/dist/``; directory transport
-for checks 1-5 and 8, the TCP transport for 6-7):
+for checks 1-5 and 8, the TCP transport for 6-7 and 9):
 
 1. **clean_parity**: two workers, no chaos — the assembled tile
    sequence and the slide forward match a single-process oracle
@@ -57,12 +58,31 @@ for checks 1-5 and 8, the TCP transport for 6-7):
    ``encode`` seam; the fleet-assembled rows match an in-process
    encode BIT-exactly, and a ``kill_worker@1`` run is BIT-exact vs the
    clean quant run.
+9. **fleet_trace** (ISSUE 17): the fleet over TCP in streaming mode
+   under one pinned ``GIGAPATH_OBS_RUN_ID`` — every process's
+   ``.trace.json`` export assembles
+   (``gigapath_tpu/obs/fleet.FleetTimeline``) into ONE timeline:
+   every chunk's ``deliver`` span parents on the producer's ``send``
+   span across the process boundary (zero orphans — one causal tree),
+   the clock-corrected merge passes the invariant check (no
+   negative-duration spans, ``send`` end <= ``deliver`` start per
+   chunk within the measured link uncertainty), the per-slide
+   critical-path shares sum to the slide wall within 5%, the merged
+   Perfetto doc carries one flow arrow per chunk, ``clock_sync``
+   events rode the TCP hello handshake, and tracing paid zero
+   unexpected retraces. Checks 2 and 7 additionally assert the
+   assembled trace shows the recovery window as an EXPLICIT annotated
+   ``recovery_gap`` span (detection -> reassignment/resume -> first
+   replayed chunk).
 
 The JSON line carries the ``dist|smoke`` trend keys
 (``chunks_per_sec``, ``clean_wall_s``, ``recover_extra_s``,
 ``reconnect_s``, ``consumer_recover_s``);
 ``perf_history.py ingest --dist`` folds them (CPU runs land stale —
-provenance, not a perf baseline). Pure-CPU, tiny shapes, no chip.
+provenance, not a perf baseline). ``--fleet-json`` writes check 9's
+``fleet_trace`` payload (``chunks_per_sec``, ``wire_share``,
+``backpressure_share``, ``encode_share``, ``fold_share``) for
+``perf_history.py ingest --fleet``. Pure-CPU, tiny shapes, no chip.
 """
 
 from __future__ import annotations
@@ -118,6 +138,23 @@ def events_of(events, kind, **match):
     return out
 
 
+def trace_spans(root: str, name=None):
+    """``ph: "X"`` events from every process's ``.trace.json`` export
+    under ``root/obs`` (the fleet-trace artifacts; a SIGKILLed process
+    leaves none — its closers never ran — which is expected)."""
+    spans = []
+    for path in glob.glob(os.path.join(root, "obs", "*.trace.json")):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        for ev in doc.get("traceEvents", []):
+            if ev.get("ph") == "X" and (name is None or ev.get("name") == name):
+                spans.append(ev)
+    return spans
+
+
 def oracle(plan: dict):
     """Single-process truth: assemble + forward without any channel."""
     from gigapath_tpu.dist.boundary import plan_chunks
@@ -139,7 +176,7 @@ def oracle(plan: dict):
 def check_clean_parity(root: str, plan: dict) -> dict:
     from gigapath_tpu.dist.pipeline import run_disaggregated
 
-    echo("1/8 clean_parity: two workers, no chaos")
+    echo("1/9 clean_parity: two workers, no chaos")
     t0 = time.monotonic()
     result = run_disaggregated(os.path.join(root, "clean"), plan=plan,
                                deadline_s=90)
@@ -157,7 +194,7 @@ def check_clean_parity(root: str, plan: dict) -> dict:
     assert all(rc == 0 for rc in result["worker_exit_codes"].values()), (
         result["worker_exit_codes"]
     )
-    echo(f"1/8 ok: bit-exact vs oracle, {stats['delivered']} chunks in "
+    echo(f"1/9 ok: bit-exact vs oracle, {stats['delivered']} chunks in "
          f"{wall:.1f}s")
     return {"wall_s": round(wall, 3), "chunks": stats["delivered"],
             "embedding": result["embedding"]}
@@ -166,7 +203,7 @@ def check_clean_parity(root: str, plan: dict) -> dict:
 def check_kill_recover(root: str, plan: dict, clean_embedding) -> dict:
     from gigapath_tpu.dist.pipeline import run_disaggregated
 
-    echo("2/8 kill_recover: SIGKILL w0 after 1 chunk, mid-slide")
+    echo("2/9 kill_recover: SIGKILL w0 after 1 chunk, mid-slide")
     t0 = time.monotonic()
     result = run_disaggregated(
         os.path.join(root, "kill"), plan=plan,
@@ -191,10 +228,23 @@ def check_kill_recover(root: str, plan: dict, clean_embedding) -> dict:
     unexpected = [ev for ev in events_of(events, "compile")
                   if ev.get("unexpected")]
     assert not unexpected, f"recovery paid unexpected retraces: {unexpected}"
-    echo(f"2/8 ok: lost w0, reassigned "
-         f"{reassigns[0].get('chunks')} chunk(s), bit-exact in {wall:.1f}s")
+    # the assembled trace must show the recovery window as an EXPLICIT
+    # annotated span: detection -> reassignment -> first replayed chunk
+    gaps = [ev for ev in trace_spans(os.path.join(root, "kill"),
+                                     "recovery_gap")
+            if (ev.get("args") or {}).get("action") == "reassign"]
+    assert gaps, (
+        "no recovery_gap span in the assembled trace — the reassignment "
+        "window is invisible on the timeline"
+    )
+    assert gaps[0]["args"].get("worker") == "w0", gaps[0]
+    assert gaps[0]["dur"] > 0, gaps[0]
+    echo(f"2/9 ok: lost w0, reassigned "
+         f"{reassigns[0].get('chunks')} chunk(s), bit-exact in {wall:.1f}s "
+         f"(recovery_gap {gaps[0]['dur'] / 1e6:.2f}s on the trace)")
     return {"wall_s": round(wall, 3),
-            "reassigned_chunks": reassigns[0].get("chunks")}
+            "reassigned_chunks": reassigns[0].get("chunks"),
+            "recovery_gap_s": round(gaps[0]["dur"] / 1e6, 3)}
 
 
 def check_slow_worker_skew(root: str, plan: dict, slow_s: float) -> dict:
@@ -203,7 +253,7 @@ def check_slow_worker_skew(root: str, plan: dict, slow_s: float) -> dict:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import obs_report
 
-    echo(f"3/8 slow_worker_skew: w1 sleeps {slow_s}s per chunk")
+    echo(f"3/9 slow_worker_skew: w1 sleeps {slow_s}s per chunk")
     run_id = "dist-smoke-slow"
     out = os.path.join(root, "slow")
     result = run_disaggregated(
@@ -229,7 +279,7 @@ def check_slow_worker_skew(root: str, plan: dict, slow_s: float) -> dict:
     text = buf.getvalue()
     assert "per-rank skew (span 'dist.chunk')" in text, text
     assert "straggler: rank 1" in text, text
-    echo(f"3/8 ok: straggler rank 1 visible (medians {med})")
+    echo(f"3/9 ok: straggler rank 1 visible (medians {med})")
     return {"median_rank0_s": round(med[0], 4),
             "median_rank1_s": round(med[1], 4)}
 
@@ -237,7 +287,7 @@ def check_slow_worker_skew(root: str, plan: dict, slow_s: float) -> dict:
 def check_drop_dup_dedup(root: str, plan: dict, clean_embedding) -> dict:
     from gigapath_tpu.dist.pipeline import run_disaggregated
 
-    echo("4/8 drop_dup_dedup: drop chunk 0's first send, dup chunk 2")
+    echo("4/9 drop_dup_dedup: drop chunk 0's first send, dup chunk 2")
     result = run_disaggregated(
         os.path.join(root, "dropdup"), plan=plan,
         worker_chaos={"w0": "drop_chunk@0,dup_chunk@2"}, deadline_s=90,
@@ -257,7 +307,7 @@ def check_drop_dup_dedup(root: str, plan: dict, clean_embedding) -> dict:
         f"the dropped chunk was not retransmitted: {worker_ends}"
     )
     assert worker_ends[0].get("dropped", 0) >= 1, worker_ends
-    echo(f"4/8 ok: {stats['duplicates']} dup(s) deduped, "
+    echo(f"4/9 ok: {stats['duplicates']} dup(s) deduped, "
          f"{worker_ends[0]['retransmits']} retransmit(s) healed the drop")
     return {"duplicates": stats["duplicates"],
             "retransmits": worker_ends[0]["retransmits"]}
@@ -271,7 +321,7 @@ def check_streaming_prefill(root: str, plan: dict, clean_embedding) -> dict:
     frontier absorbs reassignment + out-of-order delivery)."""
     from gigapath_tpu.dist.pipeline import run_disaggregated
 
-    echo("5/8 streaming_prefill: consumer folds chunks on arrival")
+    echo("5/9 streaming_prefill: consumer folds chunks on arrival")
     stream_plan = dict(plan, chunked_prefill=True)
     t0 = time.monotonic()
     result = run_disaggregated(os.path.join(root, "stream"),
@@ -313,7 +363,7 @@ def check_streaming_prefill(root: str, plan: dict, clean_embedding) -> dict:
             f"{leg}: streaming stages paid unexpected retraces: "
             f"{unexpected}"
         )
-    echo(f"5/8 ok: fold-on-arrival parity + BIT-exact kill-recover in "
+    echo(f"5/9 ok: fold-on-arrival parity + BIT-exact kill-recover in "
          f"{wall:.1f}s")
     return {"wall_s": round(wall, 3),
             "max_err_vs_dense": float(
@@ -331,7 +381,7 @@ def check_tcp_boundary(root: str, plan: dict, clean_embedding) -> dict:
     zero unexpected retraces."""
     from gigapath_tpu.dist.pipeline import run_disaggregated
 
-    echo("6/8 tcp_boundary: fleet over TCP, then drop_conn+corrupt_frame")
+    echo("6/9 tcp_boundary: fleet over TCP, then drop_conn+corrupt_frame")
     tcp_plan = dict(plan, transport="tcp")
     t0 = time.monotonic()
     result = run_disaggregated(os.path.join(root, "tcp"), plan=tcp_plan,
@@ -367,7 +417,7 @@ def check_tcp_boundary(root: str, plan: dict, clean_embedding) -> dict:
         f"TCP chaos recovery paid unexpected retraces: {unexpected}"
     )
     reconnect_s = round(max(chaos_wall - tcp_wall, 0.0), 3)
-    echo(f"6/8 ok: TCP bit-exact clean+chaos, "
+    echo(f"6/9 ok: TCP bit-exact clean+chaos, "
          f"{chaos['stats']['frame_errors']} frame error(s) healed, "
          f"reconnect_s={reconnect_s}")
     return {"wall_s": round(tcp_wall, 3),
@@ -389,7 +439,7 @@ def check_consumer_kill_recover(root: str, plan: dict,
     unexpected retraces on the restarted leg."""
     from gigapath_tpu.dist.pipeline import run_disaggregated
 
-    echo(f"7/8 consumer_kill_recover: SIGKILL consumer after "
+    echo(f"7/9 consumer_kill_recover: SIGKILL consumer after "
          f"{kill_after} chunks, restart from checkpoint")
     ckpt_plan = dict(plan, chunked_prefill=True, transport="tcp",
                      consumer_ckpt_every=2, lease_s=max(plan["lease_s"], 2.0))
@@ -421,8 +471,18 @@ def check_consumer_kill_recover(root: str, plan: dict,
     assert not unexpected, (
         f"consumer resume paid unexpected retraces: {unexpected}"
     )
+    # the restarted consumer's trace must show the resume window as an
+    # explicit annotated span (detection -> first replayed chunk); the
+    # SIGKILLed predecessor leaves no export — its closers never ran
+    gaps = [ev for ev in trace_spans(out, "recovery_gap")
+            if (ev.get("args") or {}).get("action") == "consumer_resume"]
+    assert gaps, (
+        "no consumer_resume recovery_gap span in the restarted "
+        "consumer's trace"
+    )
+    assert gaps[0]["dur"] > 0, gaps[0]
     consumer_recover_s = round(max(wall - stream_wall, 0.0), 3)
-    echo(f"7/8 ok: consumer SIGKILLed at {kill_after}, resumed from "
+    echo(f"7/9 ok: consumer SIGKILLed at {kill_after}, resumed from "
          f"watermark of {resumes[0].get('chunks')} chunk(s), bit-exact "
          f"(consumer_recover_s={consumer_recover_s})")
     return {"wall_s": round(wall, 3),
@@ -444,7 +504,7 @@ def check_quant_encoder(root: str, plan: dict) -> dict:
     from gigapath_tpu.dist.pipeline import run_disaggregated
     from gigapath_tpu.dist.worker import make_encoder
 
-    echo("8/8 quant_encoder: REAL quantized ViT behind the encode seam")
+    echo("8/9 quant_encoder: REAL quantized ViT behind the encode seam")
     qplan = dict(plan, encoder="quant_vit", quant="int8")
     t0 = time.monotonic()
     clean = run_disaggregated(os.path.join(root, "quant"), plan=qplan,
@@ -467,10 +527,105 @@ def check_quant_encoder(root: str, plan: dict) -> dict:
     assert np.array_equal(kill["embedding"], clean["embedding"]), (
         "quant-encoder kill-recover is NOT bit-exact vs the clean run"
     )
-    echo(f"8/8 ok: quantized encoder behind the seam, BIT-exact "
+    echo(f"8/9 ok: quantized encoder behind the seam, BIT-exact "
          f"kill-recover in {wall:.1f}s")
     return {"wall_s": round(wall, 3),
             "kill_reassignments": kill["reassignments"]}
+
+
+def check_fleet_trace(root: str, plan: dict) -> dict:
+    """Check 9 (ISSUE 17 acceptance): the fleet over TCP in streaming
+    mode under one pinned ``GIGAPATH_OBS_RUN_ID`` — assemble every
+    process's trace export into ONE timeline and assert the causal
+    tree, the clock-corrected orderings, the critical-path accounting,
+    and the flow arrows (module docstring, item 9)."""
+    from gigapath_tpu.dist.pipeline import run_disaggregated
+    from gigapath_tpu.obs.fleet import FleetTimeline
+
+    echo("9/9 fleet_trace: one causal timeline across the TCP fleet")
+    run_id = "dist-smoke-fleet"
+    out = os.path.join(root, "fleet")
+    fleet_plan = dict(plan, transport="tcp", chunked_prefill=True)
+    # the in-driver consumer's runlog reads the shared run id from the
+    # env (get_run_log), exactly like a real fleet launcher pins it
+    prev = os.environ.get("GIGAPATH_OBS_RUN_ID")
+    os.environ["GIGAPATH_OBS_RUN_ID"] = run_id
+    t0 = time.monotonic()
+    try:
+        result = run_disaggregated(out, plan=fleet_plan, deadline_s=90,
+                                   run_id=run_id)
+    finally:
+        if prev is None:
+            os.environ.pop("GIGAPATH_OBS_RUN_ID", None)
+        else:
+            os.environ["GIGAPATH_OBS_RUN_ID"] = prev
+    wall = time.monotonic() - t0
+    assert result["lost"] == [], f"clean fleet lost workers: {result['lost']}"
+    fleet = FleetTimeline.from_dir(os.path.join(out, "obs"), run_id)
+    actors = {sp.actor for sp in fleet.spans if sp.actor}
+    assert {"w0", "w1", "consumer"} <= actors, (
+        f"trace exports missing a process's spans: actors={sorted(actors)}"
+    )
+    slides = fleet.slides()
+    assert list(slides) == [fleet_plan["trace_id"]], (
+        f"expected ONE slide tree for the plan-minted trace id: "
+        f"{sorted(slides)}"
+    )
+    trace_id, spans = next(iter(slides.items()))
+    n_chunks = -(-int(plan["n_tiles"]) // int(plan["chunk_tiles"]))
+    delivers = [sp for sp in spans if sp.name == "deliver"]
+    assert len(delivers) == n_chunks, (len(delivers), n_chunks)
+    # one causal tree: every deliver parents on a producer's send span
+    # that a loaded export actually carries — zero orphans anywhere
+    orphans = fleet.orphans()
+    assert not orphans, (
+        f"orphan parent refs break the causal tree: "
+        f"{[sp.span_id for sp in orphans]}"
+    )
+    for sp in delivers:
+        parent = fleet.resolve(sp.parent_id)
+        assert parent is not None and parent.name == "send", sp.span_id
+        assert parent.process != sp.process, (
+            f"deliver c{sp.chunk} parents inside its own process"
+        )
+    for name in ("send", "dist.encode", "dist.fold"):
+        got = sum(1 for sp in spans if sp.name == name)
+        assert got == n_chunks, f"{name}: {got} span(s), want {n_chunks}"
+    # clock-corrected merge sanity: no negative durations, no span
+    # before its causal parent, send end <= deliver start per chunk
+    # within the measured link uncertainty
+    bad = fleet.invariants()
+    assert not bad, f"merged-timeline violations: {bad}"
+    row = fleet.critical_path()[trace_id]
+    total = sum(row["seconds"].values())
+    assert abs(total - row["wall_s"]) <= 0.05 * max(row["wall_s"], 1e-9), (
+        f"critical-path shares do not sum to the slide wall: "
+        f"{total} vs {row['wall_s']}"
+    )
+    doc = fleet.perfetto()
+    assert doc["metadata"]["flows"] >= n_chunks, (
+        f"merged Perfetto doc has {doc['metadata']['flows']} flow "
+        f"arrow(s), want >= {n_chunks}"
+    )
+    events = run_events(out)
+    syncs = events_of(events, "clock_sync")
+    assert syncs, "no clock_sync events from the TCP hello handshake"
+    unexpected = [ev for ev in events_of(events, "compile")
+                  if ev.get("unexpected")]
+    assert not unexpected, f"tracing paid unexpected retraces: {unexpected}"
+    shares = row["shares"]
+    echo(f"9/9 ok: one tree over {sorted(actors)}, {n_chunks} flow "
+         f"arrow(s), shares sum {total:.3f}s vs wall {row['wall_s']:.3f}s "
+         f"(wire {shares['wire']:.1%}, fold {shares['fold']:.1%})")
+    return {"wall_s": round(wall, 3),
+            "slide_wall_s": row["wall_s"],
+            "chunks_per_sec": round(n_chunks / max(row["wall_s"], 1e-9), 3),
+            "wire_share": shares["wire"],
+            "backpressure_share": shares["backpressure"],
+            "encode_share": shares["encode"],
+            "fold_share": shares["fold"],
+            "flows": doc["metadata"]["flows"],
+            "clock_links": len({ev.get("link") for ev in syncs})}
 
 
 def run(args) -> dict:
@@ -501,6 +656,7 @@ def run(args) -> dict:
     checks["consumer_kill_recover"] = check_consumer_kill_recover(
         root, plan, stream_embedding, stream["wall_s"])
     checks["quant_encoder"] = check_quant_encoder(root, plan)
+    checks["fleet_trace"] = check_fleet_trace(root, plan)
     clean_wall = checks["clean_parity"]["wall_s"]
     return {
         "metric": "dist_smoke",
@@ -537,6 +693,9 @@ def main(argv=None) -> int:
     ap.add_argument("--out-dir", default=None,
                     help="work dir (default: fresh temp dir)")
     ap.add_argument("--json", default=None, help="also write the payload here")
+    ap.add_argument("--fleet-json", default=None,
+                    help="also write check 9's fleet_trace payload here "
+                    "(for perf_history.py ingest --fleet)")
     args = ap.parse_args(argv)
 
     try:
@@ -552,6 +711,12 @@ def main(argv=None) -> int:
     if args.json:
         with open(args.json, "w", encoding="utf-8") as fh:
             fh.write(line + "\n")
+    if args.fleet_json and payload["rc"] == 0:
+        fleet_payload = dict(payload["checks"]["fleet_trace"],
+                             metric="fleet_trace", rc=0,
+                             backend=payload["backend"])
+        with open(args.fleet_json, "w", encoding="utf-8") as fh:
+            fh.write(json.dumps(fleet_payload, sort_keys=True) + "\n")
     return payload["rc"]
 
 

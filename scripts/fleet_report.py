@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Assemble one fleet run's per-process obs artifacts into ONE timeline.
+
+    python scripts/fleet_report.py <obs-dir> --run <run-id>
+    python scripts/fleet_report.py <obs-dir> --run <run-id> \
+        --out FLEET_TIMELINE.json          # merged Perfetto doc
+    python scripts/fleet_report.py <obs-dir> --run <run-id> --json
+
+A disaggregated run launched under one ``GIGAPATH_OBS_RUN_ID`` leaves a
+runlog JSONL + ``.trace.json`` export per process in the obs dir.  This
+CLI drives :class:`gigapath_tpu.obs.fleet.FleetTimeline` over them and
+renders: the fleet health roll-up (processes, per-link channel
+telemetry from the final metrics snapshots, clock offsets per link,
+loss events), the per-slide critical-path table (every instant of the
+slide's wall charged to exactly one of encode / wire / backpressure /
+deliver / fold / checkpoint / finalize / idle, so the shares sum to
+100% by construction, plus the straggler link), and the merged-timeline
+invariant check (negative durations, causality across the clock
+correction).  ``--out`` additionally writes the merged Perfetto doc —
+one named track group per process, flow arrows on every cross-process
+chunk hand-off — loadable at https://ui.perfetto.dev.
+
+Pure stdlib (the fleet module imports nothing heavier), so it runs on a
+workstation against artifacts scp'd from the fleet.  Exit 0 on a
+healthy render, 1 on invariant violations, 2 on no artifacts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gigapath_tpu.obs.fleet import CATEGORIES, FleetTimeline  # noqa: E402
+
+
+def render(fleet: FleetTimeline, out=None, slack_s: Optional[float] = None
+           ) -> int:
+    out = out or sys.stdout
+    w = out.write
+    health = fleet.health()
+    if not fleet.processes:
+        w("no fleet artifacts\n")
+        return 2
+    w("== fleet ==\n")
+    w(f"run: {health['run'] or '?'}\n")
+    w(f"processes: {', '.join(health['processes'])}\n")
+    w(f"spans: {health['spans']} over {health['slides']} slide(s), "
+      f"{health['orphans']} orphan parent ref(s)\n")
+    if health["worker_lost"] or health["consumer_lost"]:
+        w(f"losses: {health['worker_lost']} worker(s), "
+          f"{health['consumer_lost']} consumer(s)\n")
+    for link, clk in sorted(health["clocks"].items()):
+        w(f"clock link '{link}': offset {clk['offset_s']:+.6f}s "
+          f"±{clk['uncertainty_s']:.6f}s "
+          f"(epoch {clk['epoch']}, {clk['samples']} sample(s), "
+          f"process {clk['process']})\n")
+    if health["links"]:
+        w("link telemetry (final snapshots):\n")
+        for link, m in sorted(health["links"].items()):
+            w(f"  {link}: unacked {m.get('unacked_depth', 0):g}"
+              f"/{m.get('credits_in_flight', 0):g}+inflight, "
+              f"ack lag {m.get('ack_lag_chunks', 0):g} chunk(s) "
+              f"({m.get('ack_lag_s', 0):.3f}s), "
+              f"backpressure {m.get('backpressure_s', 0):.3f}s, "
+              f"retransmits {m.get('retransmits', 0):g}, "
+              f"bytes {m.get('bytes', 0):g}\n")
+    table = fleet.critical_path()
+    if table:
+        w("critical path (slide / wall / shares / straggler):\n")
+        for tid, row in sorted(table.items()):
+            shares = " ".join(
+                f"{c} {100.0 * row['shares'][c]:.1f}%" for c in CATEGORIES
+                if row["seconds"][c] > 0 or c == "idle")
+            extra = (f", {row['recovery_gaps']} recovery gap(s)"
+                     if row["recovery_gaps"] else "")
+            w(f"  {tid}: {row['wall_s']:.3f}s over {row['chunks']} "
+              f"chunk(s): {shares}"
+              + (f"  straggler {row['straggler']}" if row["straggler"]
+                 else "") + extra + "\n")
+    kwargs = {} if slack_s is None else {"slack_s": slack_s}
+    bad = fleet.invariants(**kwargs)
+    if bad:
+        for v in bad:
+            w(f"  VIOLATION: {v}\n")
+        w(f"WARNING: {len(bad)} merged-timeline violation(s) — the clock "
+          f"correction or an export is wrong\n")
+        return 1
+    w("invariants: OK\n")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python scripts/fleet_report.py",
+        description="Merge one fleet run's per-process obs artifacts into "
+        "a single timeline + critical-path report",
+    )
+    ap.add_argument("obs_dir", help="directory holding the per-process "
+                    "JSONL + .trace.json artifacts")
+    ap.add_argument("--run", required=True,
+                    help="the shared GIGAPATH_OBS_RUN_ID of the fleet run")
+    ap.add_argument("--out", default=None,
+                    help="write the merged Perfetto timeline JSON here")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable summary instead of text")
+    ap.add_argument("--slack", type=float, default=None,
+                    help="extra causality slack (s) past the measured clock "
+                    "uncertainty")
+    args = ap.parse_args(argv)
+
+    fleet = FleetTimeline.from_dir(args.obs_dir, args.run)
+    if not fleet.processes:
+        print(f"error: no '{args.run}*' artifacts in {args.obs_dir}",
+              file=sys.stderr)
+        return 2
+    if args.out:
+        doc = fleet.perfetto()
+        tmp = f"{args.out}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh)
+        os.replace(tmp, args.out)
+    if args.json:
+        kwargs = {} if args.slack is None else {"slack_s": args.slack}
+        bad = fleet.invariants(**kwargs)
+        print(json.dumps({
+            "health": fleet.health(),
+            "critical_path": fleet.critical_path(),
+            "invariants": bad,
+        }, indent=2, sort_keys=True))
+        return 1 if bad else 0
+    return render(fleet, slack_s=args.slack)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

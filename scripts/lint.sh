@@ -30,6 +30,9 @@
 #               surface);
 #   - GL021     (gigarace): the seeded blocking-under-lock fixture must
 #               fire (join/wait/sleep while holding a lock);
+#   - GL022     the seeded untraced-dist-span fixture must fire
+#               (span() in dist/ library code without trace=ctx never
+#               reaches the fleet's merged timeline);
 #   - autotune  (scripts/autotune.py --selftest): blessed-plan dispatch,
 #               env precedence, corrupt-registry refusal.
 #
@@ -90,6 +93,8 @@ run_selftest GL016 1 python -m tools.gigalint --no-waivers --select GL016 \
     tools/gigalint/selftest/fixture/models/lowprec.py
 run_selftest GL017 1 python -m tools.gigalint --no-waivers --select GL017 \
     tools/gigalint/selftest/fixture/models/dispatch.py
+run_selftest GL022 1 python -m tools.gigalint --no-waivers --select GL022 \
+    tools/gigalint/selftest/fixture/dist/worker.py
 
 # gigarace (lock-discipline) seeded fixtures — same rc=1 contract
 run_selftest GL018 1 python -m tools.gigalint --no-waivers --select GL018 \

@@ -17,7 +17,11 @@ dir), recovery (the fault-tolerance layer's actions — skips,
 rollbacks, resumes, data retries, sheds, deadline failures, breaker
 trips, drains, reassignments — per action with its context), dist (the
 cross-stage boundary: backpressure episodes per channel with queue
-depth/capacity, lost workers with lease-expiry context), latency (the typed
+depth/capacity, lost workers with lease-expiry context), fleet (the
+per-link clock offsets from ``clock_sync`` events and the
+``dist.link.*`` channel telemetry from the final metrics snapshot —
+the per-process half of what ``scripts/fleet_report.py`` assembles into
+one cross-process timeline), latency (the typed
 metrics registry's last ``metrics`` snapshot: per-histogram
 p50/p90/p99/max plus counters and gauges), slo (burn-rate transitions
 and the terminal error-budget status from the ``SloTracker``), locks
@@ -449,6 +453,55 @@ def render(events: List[dict], out=None) -> int:
             )
         w("\n")
 
+    # -- fleet (obs/clock.py + dist LinkTelemetry: per-link channel state) -
+    clock_syncs = by_kind.get("clock_sync", [])
+    link_metrics: Dict[str, Dict[str, float]] = {}
+    for ev in by_kind.get("metrics", []):
+        if ev.get("reason") != "final":
+            continue
+        for group in ("counters", "gauges"):
+            for mname, value in (ev.get(group) or {}).items():
+                if not str(mname).startswith("dist.link."):
+                    continue
+                link, _, metric = str(mname)[len("dist.link."):].rpartition(".")
+                if link:
+                    link_metrics.setdefault(link, {})[metric] = value
+    if clock_syncs or link_metrics:
+        w("== fleet ==\n")
+        if clock_syncs:
+            last_by_link: Dict[str, dict] = {}
+            for ev in clock_syncs:
+                last_by_link[str(ev.get("link", "?"))] = ev
+            w(f"clock syncs: {len(clock_syncs)} over "
+              f"{len(last_by_link)} link(s)\n")
+            for link in sorted(last_by_link):
+                ev = last_by_link[link]
+                w(
+                    "  link '{}': offset {:+.6f}s ±{:.6f}s "
+                    "(epoch {}, {} sample(s))\n".format(
+                        link, float(ev.get("offset_s", 0.0)),
+                        float(ev.get("uncertainty_s", 0.0)),
+                        ev.get("epoch", 0), ev.get("samples", 0),
+                    )
+                )
+        if link_metrics:
+            w("link telemetry (final snapshots):\n")
+            for link in sorted(link_metrics):
+                m = link_metrics[link]
+                w(
+                    "  {}: unacked {:g}, ack lag {:g} chunk(s) "
+                    "({:.3f}s), backpressure {:.3f}s, retransmits {:g}, "
+                    "bytes {:g}\n".format(
+                        link, m.get("unacked_depth", 0),
+                        m.get("ack_lag_chunks", 0), m.get("ack_lag_s", 0),
+                        m.get("backpressure_s", 0), m.get("retransmits", 0),
+                        m.get("bytes", 0),
+                    )
+                )
+        w("assemble the cross-process timeline with "
+          "scripts/fleet_report.py\n")
+        w("\n")
+
     # -- latency (obs/metrics.py: metrics-event snapshots) -----------------
     metrics_events = by_kind.get("metrics", [])
     if metrics_events:
@@ -711,10 +764,23 @@ def selftest() -> int:
                   reason="checkpoint_found", pid=4243, last_renew=101.0)
         log.recovery(action="consumer_resume", step=4, chunks=4,
                      missing=2)
+        # ...the fleet layer (ISSUE 17): a producer's clock_sync per
+        # link + the LinkTelemetry instruments on the final snapshot
+        log.event("clock_sync", link="chunks.w0", offset_s=-12.345678,
+                  rtt_s=0.0004, uncertainty_s=0.0002,
+                  sample_offset_s=-12.345678, samples=3, epoch=1)
         log.event("metrics", reason="final", counters={
             "dist.reconnects": 1, "dist.frame_errors": 2,
             "dist.bytes_sent": 65536,
-        }, gauges={}, histograms={})
+            "dist.link.chunks.w0.backpressure_s": 1.25,
+            "dist.link.chunks.w0.retransmits": 2,
+            "dist.link.chunks.w0.bytes": 65536,
+        }, gauges={
+            "dist.link.chunks.w0.credits_in_flight": 3,
+            "dist.link.chunks.w0.unacked_depth": 2,
+            "dist.link.chunks.w0.ack_lag_chunks": 2,
+            "dist.link.chunks.w0.ack_lag_s": 0.05,
+        }, histograms={})
         # lock-sanitizer telemetry (gigapath_tpu.obs.locktrace): the
         # exact payload attach_locktrace's closer emits when the run
         # executes under GIGAPATH_LOCKTRACE=1 — synthesized here because
@@ -882,7 +948,13 @@ def selftest() -> int:
                 "CONSUMER_LOST at", "checkpoint_found",
                 "transport: reconnects 1 / frame_errors 2 / "
                 "bytes_sent 65536",
-                "REASSIGN at", "worker w0, 3 chunk(s), -> w1,w2")
+                "REASSIGN at", "worker w0, 3 chunk(s), -> w1,w2",
+                "== fleet ==", "clock syncs: 1 over 1 link(s)",
+                "link 'chunks.w0': offset -12.345678s ±0.000200s "
+                "(epoch 1, 3 sample(s))",
+                "chunks.w0: unacked 2, ack lag 2 chunk(s) (0.050s), "
+                "backpressure 1.250s, retransmits 2, bytes 65536",
+                "scripts/fleet_report.py")
     missing = [s for s in required if s not in text]
     required_fl = ("== flight dumps ==", "reason=step_time_spike")
     missing_fl = [s for s in required_fl if s not in text_fl]

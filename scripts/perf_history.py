@@ -120,6 +120,10 @@ def cmd_ingest(args) -> int:
             history.fold_dist(doc, _load_json(args.dist), args.label,
                               source=os.path.basename(args.dist),
                               force=args.force)
+        if args.fleet:
+            history.fold_fleet(doc, _load_json(args.fleet), args.label,
+                               source=os.path.basename(args.fleet),
+                               force=args.force)
         if args.prefill:
             history.fold_prefill(doc, _load_json(args.prefill), args.label,
                                  source=os.path.basename(args.prefill),
@@ -351,6 +355,40 @@ def selftest() -> int:
         render(dv, out=sys.stderr)
         return 1
 
+    # dist|trace folding (dist_smoke --fleet-json): same shared
+    # staleness policy (CPU fleet = stale with keys), and a wire-share
+    # GROWTH on the merged critical path flips the gate
+    history.fold_fleet(
+        serve_doc,
+        {"rc": 0, "backend": "cpu", "chunks_per_sec": 60.0,
+         "wire_share": 0.07, "backpressure_share": 0.0,
+         "fold_share": 0.34}, "r01")
+    fleet_points = serve_doc["entries"]["dist|trace"]["points"]
+    if not fleet_points[0].get("stale") or "wire_share" not in \
+            fleet_points[0]["metrics"]:
+        print("perf_history selftest FAILED: CPU fleet point must be "
+              "stale WITH metric keys", file=sys.stderr)
+        return 1
+    history.fold_fleet(
+        serve_doc,
+        {"rc": 0, "backend": "tpu", "chunks_per_sec": 500.0,
+         "wire_share": 0.05, "backpressure_share": 0.01,
+         "fold_share": 0.30}, "r02")
+    history.fold_fleet(
+        serve_doc,
+        {"rc": 0, "backend": "tpu", "chunks_per_sec": 500.0,
+         "wire_share": 0.25, "backpressure_share": 0.01,
+         "fold_share": 0.30}, "r03")
+    fv = history.trend_verdict(serve_doc)
+    if fv["decision"]["ok"] or not any(
+        "dist|trace: wire_share 0.05" in line
+        for line in fv["decision"]["regressed"]
+    ):
+        print("perf_history selftest FAILED: fleet wire-share growth "
+              "undetected", file=sys.stderr)
+        render(fv, out=sys.stderr)
+        return 1
+
     # prefill|stream folding: same shared staleness policy (CPU point =
     # stale with keys), and fold-executable memory growth flips the gate
     history.fold_prefill(
@@ -533,6 +571,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                        help="dist_smoke snapshot JSON "
                        "(scripts/dist_smoke.py --json output) -> the "
                        "dist|smoke boundary trend entry")
+    p_ing.add_argument("--fleet", default=None,
+                       help="fleet-trace snapshot JSON "
+                       "(scripts/dist_smoke.py --fleet-json output) -> the "
+                       "dist|trace trend entry (cross-process critical-path "
+                       "shares over the merged timeline)")
     p_ing.add_argument("--prefill", default=None,
                        help="long_context_smoke --stream snapshot JSON "
                        "-> the prefill|stream trend entry "

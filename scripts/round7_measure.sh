@@ -74,9 +74,12 @@ tail -3 /tmp/r7_serve.log
 #    SIGKILL-and-resume from the checkpoint watermark
 #    (consumer_recover_s), all hard-asserted. The ingest below folds the
 #    dist|smoke entry next to the serve ones (the label lands once, with
-#    every snapshot measured this round).
+#    every snapshot measured this round). --fleet-json additionally
+#    writes the cross-process fleet-trace payload (critical-path shares
+#    over the merged timeline from check 9) for the dist|trace entry;
+#    scripts/fleet_report.py renders the same run's merged timeline.
 timeout 1200 python scripts/dist_smoke.py --json DIST_SMOKE.json \
-  > /tmp/r7_dist.log 2>&1
+  --fleet-json FLEET_SMOKE.json > /tmp/r7_dist.log 2>&1
 tail -3 /tmp/r7_dist.log
 
 # 9. streaming chunked prefill (ROADMAP item 2): the
@@ -116,5 +119,6 @@ timeout 2400 python scripts/autotune.py --n 10241 --iters 12 \
   --label r07 --bless --json AUTOTUNE.json > /tmp/r7_autotune.log 2>&1
 tail -6 /tmp/r7_autotune.log
 python scripts/perf_history.py ingest --label r07 --serve SERVE_SMOKE.json \
-  --dist DIST_SMOKE.json --prefill PREFILL_SMOKE.json \
+  --dist DIST_SMOKE.json --fleet FLEET_SMOKE.json \
+  --prefill PREFILL_SMOKE.json \
   --tile AB_TILE.json --plan AUTOTUNE.json || true

@@ -1,0 +1,334 @@
+"""Fleet-scoped tracing (gigapath_tpu/obs/clock.py + obs/fleet.py).
+
+Synthetic two-process timelines pin the contracts the live dist_smoke
+cannot exercise on one machine (where every process shares one
+CLOCK_MONOTONIC and measured offsets are ~0): NTP offset math including
+NEGATIVE offsets, lowest-RTT-wins within an epoch, reconnect
+re-estimation, clock-corrected merged-timeline invariants (and their
+violation detection), the exact-sum critical-path sweep, cross-process
+flow arrows, and orphan semantics after a kill -9.
+"""
+
+import pytest
+
+from gigapath_tpu.obs.clock import (
+    ClockSample,
+    LinkClock,
+    emit_clock_sync,
+    estimate_offset,
+)
+from gigapath_tpu.obs.fleet import FleetTimeline, ProcessDoc
+from gigapath_tpu.obs.history import fold_fleet, metric_direction, new_history
+from gigapath_tpu.obs.reqtrace import RequestTrace, TraceContext
+
+
+# ---------------------------------------------------------------------------
+# clock math
+# ---------------------------------------------------------------------------
+
+class TestClockEstimate:
+    def test_symmetric_sample_recovers_true_offset(self):
+        # consumer clock = producer clock + 997.0, one-way delay 0.05
+        s = ClockSample(t_send=10.0, t_recv=1007.05,
+                        t_reply=1007.10, t_ack=10.15)
+        est = estimate_offset(s)
+        assert est.offset_s == pytest.approx(997.0)
+        assert est.rtt_s == pytest.approx(0.10)
+        assert est.uncertainty_s == pytest.approx(0.05)
+        assert est.to_reference(10.0) == pytest.approx(1007.0)
+
+    def test_negative_offset_is_legal(self):
+        # producer's monotonic origin AHEAD of the consumer's: consumer
+        # clock = producer clock - 500.0 (arbitrary per-process origins)
+        s = ClockSample(t_send=1000.0, t_recv=500.01,
+                        t_reply=500.02, t_ack=1000.03)
+        est = estimate_offset(s)
+        assert est.offset_s == pytest.approx(-500.0)
+        assert est.to_reference(1000.0) == pytest.approx(500.0)
+
+    def test_rtt_clamped_nonnegative(self):
+        # clock jitter can make the raw rtt formula go negative; the
+        # estimate must clamp instead of reporting negative uncertainty
+        s = ClockSample(t_send=0.0, t_recv=5.0, t_reply=5.2, t_ack=0.1)
+        est = estimate_offset(s)
+        assert est.rtt_s == 0.0
+        assert est.uncertainty_s == 0.0
+
+    def test_lowest_rtt_sample_wins_within_epoch(self):
+        clk = LinkClock("chunks.w0")
+        loose = ClockSample(t_send=0.0, t_recv=100.2, t_reply=100.2,
+                            t_ack=0.4)     # rtt 0.4
+        tight = ClockSample(t_send=1.0, t_recv=101.05, t_reply=101.05,
+                            t_ack=1.1)     # rtt 0.1
+        clk.update(loose)
+        assert clk.uncertainty_s == pytest.approx(0.2)
+        clk.update(tight)
+        assert clk.uncertainty_s == pytest.approx(0.05)
+        assert clk.offset_s == pytest.approx(100.0)
+        # a WORSE sample never displaces the epoch's best
+        clk.update(loose)
+        assert clk.uncertainty_s == pytest.approx(0.05)
+        assert clk.samples == 3
+
+    def test_resync_reestimates_from_scratch(self):
+        clk = LinkClock("chunks.w0")
+        clk.update(ClockSample(t_send=0.0, t_recv=100.0, t_reply=100.0,
+                               t_ack=0.1))
+        assert clk.offset_s == pytest.approx(99.95)
+        assert clk.epochs == 0
+        # reconnect: the peer may be a RESTARTED process with a brand-new
+        # monotonic origin — the old estimate must not survive
+        clk.resync()
+        assert clk.estimate is None and clk.samples == 0
+        assert clk.epochs == 1
+        clk.update(ClockSample(t_send=50.0, t_recv=7.0, t_reply=7.0,
+                               t_ack=50.1))
+        assert clk.offset_s == pytest.approx(-43.05)
+        # an idle resync (no samples folded) does not burn an epoch
+        clk.resync()
+        clk.resync()
+        assert clk.epochs == 2
+
+    def test_emit_clock_sync_event_shape(self):
+        class Log:
+            def __init__(self):
+                self.events = []
+
+            def event(self, kind, **fields):
+                self.events.append(dict(fields, kind=kind))
+
+        log = Log()
+        clk = LinkClock("chunks.w1")
+        est = clk.update(ClockSample(t_send=0.0, t_recv=10.0,
+                                     t_reply=10.0, t_ack=0.2))
+        emit_clock_sync(log, clk, est)
+        (ev,) = log.events
+        assert ev["kind"] == "clock_sync"
+        assert ev["link"] == "chunks.w1"
+        assert ev["offset_s"] == pytest.approx(9.9)
+        assert ev["uncertainty_s"] == pytest.approx(0.1)
+        assert ev["samples"] == 1 and ev["epoch"] == 0
+        # never raises with no runlog (transport paths call it blind)
+        emit_clock_sync(None, clk, est)
+
+
+# ---------------------------------------------------------------------------
+# trace contexts: structural ids, dedup
+# ---------------------------------------------------------------------------
+
+class TestTraceContext:
+    def test_structural_ids_computable_cross_process(self):
+        tr = RequestTrace("tr1", 1, "slide", 0.0)
+        ctx = TraceContext(tr, "w0")
+        assert ctx.span_id_for("send", chunk=3) == "tr1/w0/c3/send"
+        assert ctx.span_id_for("finalize") == "tr1/w0/finalize"
+        # another process computes the SAME id from header fields alone
+        other = TraceContext(RequestTrace("tr1", 2, "slide", 0.0),
+                             "consumer")
+        assert other.span_id_for("send", chunk=3).replace(
+            "/consumer/", "/w0/") == ctx.span_id_for("send", chunk=3)
+
+    def test_replay_dedups_instead_of_forking(self):
+        tr = RequestTrace("tr1", 1, "slide", 0.0)
+        ctx = TraceContext(tr, "consumer")
+        ctx.add_span("deliver", 1.0, 1.1, chunk=0, parent="tr1/w0/c0/send")
+        ctx.add_span("deliver", 2.0, 2.1, chunk=0)  # retransmit replay
+        assert len(tr.spans) == 1
+        sp = tr.spans[0]
+        assert sp.args["span_id"] == "tr1/consumer/c0/deliver"
+        assert sp.args["parent_span_id"] == "tr1/w0/c0/send"
+        assert sp.args["actor"] == "consumer"
+
+
+# ---------------------------------------------------------------------------
+# merged timeline
+# ---------------------------------------------------------------------------
+
+TR = "tr-fleet-1"
+
+
+def _sid(actor, name, chunk=None):
+    if chunk is None:
+        return f"{TR}/{actor}/{name}"
+    return f"{TR}/{actor}/c{chunk}/{name}"
+
+
+def _ev(name, t0, t1, **args):
+    return {"ph": "X", "tid": 1, "name": name, "ts": t0 * 1e6,
+            "dur": (t1 - t0) * 1e6, "args": args}
+
+
+def _doc(actor, spans, pid=1):
+    return {
+        "metadata": {"clock": {"t0_monotonic": 0.0}, "actor": actor,
+                     "pid": pid},
+        "traceEvents": spans,
+    }
+
+
+def _producer_spans():
+    # producer's LOCAL monotonic clock reads ~1000.x while the
+    # consumer's reads ~5.x at the same instant: true offset -995.0
+    return [
+        _ev("dist.encode", 1000.00, 1000.02,
+            span_id=_sid("w0", "dist.encode", 0), trace_id=TR, chunk=0,
+            actor="w0"),
+        _ev("send", 1000.02, 1000.03, span_id=_sid("w0", "send", 0),
+            trace_id=TR, chunk=0, actor="w0",
+            parent_span_id=_sid("w0", "dist.encode", 0)),
+    ]
+
+
+def _consumer_spans(fold_t0=5.04):
+    return [
+        _ev("deliver", 5.035, 5.04, span_id=_sid("consumer", "deliver", 0),
+            trace_id=TR, chunk=0, actor="consumer",
+            parent_span_id=_sid("w0", "send", 0)),
+        _ev("dist.fold", fold_t0, 5.06,
+            span_id=_sid("consumer", "dist.fold", 0), trace_id=TR, chunk=0,
+            actor="consumer", parent_span_id=_sid("consumer", "deliver", 0)),
+        _ev("dist.finalize", 5.06, 5.07,
+            span_id=_sid("consumer", "dist.finalize"), trace_id=TR,
+            actor="consumer"),
+    ]
+
+
+def _fleet(offset_s=-995.0, uncertainty_s=0.001, fold_t0=5.04):
+    return FleetTimeline.from_parts([
+        {"label": "w0", "doc": _doc("w0", _producer_spans(), pid=11),
+         "offset_s": offset_s, "uncertainty_s": uncertainty_s},
+        {"label": "consumer",
+         "doc": _doc("consumer", _consumer_spans(fold_t0), pid=22),
+         "offset_s": 0.0},
+    ], run_id="fleet-test")
+
+
+class TestFleetTimeline:
+    def test_one_causal_tree_on_the_reference_axis(self):
+        fleet = _fleet()
+        slides = fleet.slides()
+        assert list(slides) == [TR]
+        assert len(slides[TR]) == 5
+        send = fleet.resolve(_sid("w0", "send", 0))
+        # -995.0 landed the producer span on the consumer's axis
+        assert send.t1 == pytest.approx(5.03)
+        deliver = fleet.resolve(_sid("consumer", "deliver", 0))
+        assert fleet.resolve(deliver.parent_id) is send
+        assert fleet.orphans() == []
+        assert fleet.invariants() == []
+
+    def test_wrong_offset_is_a_causality_violation(self):
+        # 100ms of clock error >> uncertainty + slack: the deliver now
+        # starts BEFORE its send ends on the merged axis
+        fleet = _fleet(offset_s=-994.9)
+        bad = fleet.invariants()
+        assert len(bad) == 1 and "causality" in bad[0]
+        assert "w0->consumer" in bad[0]
+        # ...but error inside the measured uncertainty stays tolerated
+        assert _fleet(offset_s=-995.002, uncertainty_s=0.01).invariants() \
+            == []
+
+    def test_negative_duration_and_parent_exceeding_detected(self):
+        torn = _doc("consumer", [
+            _ev("deliver", 5.04, 5.03, span_id=_sid("consumer", "deliver", 0),
+                trace_id=TR, chunk=0, actor="consumer"),
+        ])
+        fleet = FleetTimeline.from_parts(
+            [{"label": "consumer", "doc": torn, "offset_s": 0.0}])
+        assert any("negative-duration" in v for v in fleet.invariants())
+        # a fold starting well before its deliver parent
+        fleet = _fleet(fold_t0=5.01)
+        assert any("parent-exceeding" in v for v in fleet.invariants())
+
+    def test_critical_path_shares_sum_to_wall_exactly(self):
+        fleet = _fleet()
+        row = fleet.critical_path()[TR]
+        assert row["wall_s"] == pytest.approx(0.07)
+        assert sum(row["seconds"].values()) == pytest.approx(row["wall_s"])
+        s = row["seconds"]
+        assert s["encode"] == pytest.approx(0.02)
+        # wire = [send end 5.03, deliver start 5.035] on the merged axis
+        assert s["wire"] == pytest.approx(0.005)
+        assert s["deliver"] == pytest.approx(0.005)
+        assert s["fold"] == pytest.approx(0.02)
+        assert s["finalize"] == pytest.approx(0.01)
+        # the send interval itself maps to no category -> idle
+        assert s["idle"] == pytest.approx(0.01)
+        assert row["chunks"] == 1
+        assert row["straggler"] == "w0"
+
+    def test_perfetto_flows_cross_process_only(self):
+        fleet = _fleet()
+        doc = fleet.perfetto()
+        # ONE cross-process edge (send -> deliver); fold's parent is the
+        # same-process deliver and must not draw an arrow
+        assert doc["metadata"]["flows"] == 1
+        starts = [e for e in doc["traceEvents"] if e.get("ph") == "s"]
+        ends = [e for e in doc["traceEvents"] if e.get("ph") == "f"]
+        assert len(starts) == 1 and len(ends) == 1
+        assert starts[0]["pid"] != ends[0]["pid"]
+        names = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert names == {"w0", "consumer"}
+        # every rebased timestamp is non-negative (fleet origin = the
+        # earliest reference instant)
+        assert all(e.get("ts", 0.0) >= 0.0 for e in doc["traceEvents"])
+
+    def test_killed_producer_is_an_orphan_not_a_violation(self):
+        # kill -9: the producer never ran its export closer, so only the
+        # consumer doc loads; the deliver's parent ref dangles
+        fleet = FleetTimeline.from_parts([
+            {"label": "consumer", "doc": _doc("consumer", _consumer_spans()),
+             "offset_s": 0.0},
+        ], run_id="fleet-test")
+        orphan_ids = {sp.span_id for sp in fleet.orphans()}
+        assert orphan_ids == {_sid("consumer", "deliver", 0)}
+        assert fleet.invariants() == []
+        assert fleet.health()["orphans"] == 1
+
+    def test_offset_from_last_clock_sync_after_restart(self):
+        # the producer reconnected to a RESTARTED consumer: epoch 0's
+        # offset is garbage for the new consumer's origin; the LAST
+        # clock_sync (epoch 1, re-estimated) must win the placement
+        events = [
+            {"kind": "clock_sync", "link": "chunks.w0", "offset_s": 123.4,
+             "uncertainty_s": 0.5, "epoch": 0, "samples": 2},
+            {"kind": "clock_sync", "link": "chunks.w0", "offset_s": -995.0,
+             "uncertainty_s": 0.001, "epoch": 1, "samples": 3},
+        ]
+        fleet = FleetTimeline.from_parts([
+            {"label": "w0", "doc": _doc("w0", _producer_spans(), pid=11),
+             "events": events},
+            {"label": "consumer",
+             "doc": _doc("consumer", _consumer_spans(), pid=22)},
+        ], run_id="fleet-test")
+        assert fleet.processes[0].offset_s == pytest.approx(-995.0)
+        assert fleet.processes[0].uncertainty_s == pytest.approx(0.001)
+        # no causality overlap after the correction
+        assert fleet.invariants() == []
+        clocks = fleet.health()["clocks"]
+        assert clocks["chunks.w0"]["epoch"] == 1
+
+    def test_process_without_clock_sync_is_the_reference(self):
+        doc = ProcessDoc("consumer", doc=_doc("consumer", _consumer_spans()))
+        assert doc.offset_s == 0.0 and doc.uncertainty_s == 0.0
+
+
+# ---------------------------------------------------------------------------
+# trend folding
+# ---------------------------------------------------------------------------
+
+class TestFleetTrend:
+    def test_direction_rules(self):
+        assert metric_direction("wire_share") == "down"
+        assert metric_direction("backpressure_share") == "down"
+        assert metric_direction("chunks_per_sec") == "up"
+        assert metric_direction("slide_wall_s") == "down"
+
+    def test_fold_fleet_cpu_point_is_stale_with_keys(self):
+        doc = new_history()
+        fold_fleet(doc, {"rc": 0, "backend": "cpu", "chunks_per_sec": 60.0,
+                         "wire_share": 0.07}, "r01")
+        (point,) = doc["entries"]["dist|trace"]["points"]
+        assert point["stale"] is True
+        assert set(point["metrics"]) == {"chunks_per_sec", "wire_share"}

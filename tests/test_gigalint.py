@@ -51,7 +51,7 @@ def test_fixture_tree_fires_every_rule_class():
     fired = {f.rule for f in result.findings}
     expected = {"GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
                 "GL007", "GL008", "GL009", "GL010", "GL011", "GL012",
-                "GL013", "GL014", "GL015", "GL016", "GL017"}
+                "GL013", "GL014", "GL015", "GL016", "GL017", "GL022"}
     assert fired >= expected, (
         f"missing rule classes: {sorted(expected - fired)}"
     )
@@ -155,6 +155,12 @@ def test_fixture_specific_findings():
         ("GL017", "dispatch.py", "block_override_by_hand"),
         ("GL017", "dispatch.py", "helper_env_flag_read"),
         ("GL017", "dispatch.py", "subscript_read"),
+        # untraced spans in dist/ library code (the fixture twins
+        # dist/worker.py; the traced span and the manual ctx.add_span
+        # call are the negative controls): a missing trace= kwarg and
+        # an explicit trace=None both fall out of the fleet timeline
+        ("GL022", "worker.py", "untraced_encode_span"),
+        ("GL022", "worker.py", "untraced_none_span"),
     }
     assert expected <= got, f"missing: {sorted(expected - got)}"
 

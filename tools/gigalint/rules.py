@@ -1482,3 +1482,84 @@ def check_streaming_reassembly(project: Project) -> List[Finding]:
                 "IS the sanctioned oracle path",
             ))
     return findings
+
+
+# ---------------------------------------------------------------------------
+# GL022 — untraced spans in distributed library code
+# ---------------------------------------------------------------------------
+
+# The fleet timeline (obs/fleet.py) is assembled from per-process trace
+# exports: a span in dist/ library code that does not thread the slide's
+# TraceContext (``span(..., trace=ctx)``) records into the local runlog
+# but falls OUT of the merged cross-process tree — its seconds silently
+# land in the critical path's "idle" bucket and the causality invariants
+# go blind to it. That is exactly the kind of gap nobody notices until a
+# production straggler hunt comes up empty. Host tooling (scripts/,
+# tests/, demos) renders single-process reports and is exempt; manual
+# ``ctx.add_span(...)`` calls (the deliver/fold paths that measure
+# across ``with`` boundaries) are invisible to this rule by design —
+# they already name a context.
+_GL022_EXEMPT_SEGMENTS = frozenset({"scripts", "tests", "demo"})
+_GL022_PATH_SEGMENT = "dist"
+
+
+@register(
+    "GL022",
+    "span() in dist/ library code without a trace= context: the span "
+    "lands in the local runlog but not the fleet's merged cross-process "
+    "timeline — thread the slide's TraceContext "
+    "(span(..., trace=ctx), gigapath_tpu.obs.reqtrace)",
+)
+def check_untraced_dist_spans(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.modules.values():
+        segments = mod.path.split("/")[:-1]
+        if _GL022_PATH_SEGMENT not in segments:
+            continue
+        if mod.is_test_file or any(
+            s in _GL022_EXEMPT_SEGMENTS for s in segments
+        ):
+            continue
+        # innermost-enclosing-function attribution (the GL014 pattern):
+        # smallest span containing the call wins
+        spans = sorted(
+            (
+                (fn.lineno, getattr(fn.node, "end_lineno", fn.lineno), fn)
+                for fn in mod.functions.values()
+            ),
+            key=lambda t: t[1] - t[0],
+        )
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            if not callee or not (
+                callee == "span" or callee.endswith(".span")
+            ):
+                continue
+            if any(
+                kw.arg == "trace"
+                and not (
+                    isinstance(kw.value, ast.Constant)
+                    and kw.value.value in (None, False)
+                )
+                for kw in node.keywords
+            ):
+                # trace=<ctx> threads the fleet context (the GL008
+                # fence-kwarg shape: an explicit None/False earns no
+                # credit — it IS the untraced case, spelled out)
+                continue
+            symbol = "<module>"
+            for lo, hi, fn in spans:
+                if lo <= node.lineno <= hi:
+                    symbol = fn.qualname
+                    break
+            findings.append(Finding(
+                "GL022", mod.path, node.lineno, symbol,
+                "span() in dist/ library code without a trace= context: "
+                "this span never reaches the fleet's merged timeline — "
+                "its wall lands in the critical path's idle bucket and "
+                "the cross-process causality checks cannot see it. "
+                "Thread the slide's TraceContext: span(..., trace=ctx)",
+            ))
+    return findings

@@ -1,0 +1,37 @@
+"""Seeded GL022 violations (never imported — parsed only).
+
+This module's path carries a ``dist`` segment, so its ``span()`` calls
+are distributed LIBRARY spans: each must thread the slide's
+TraceContext (``trace=ctx``) or it never reaches the fleet's merged
+cross-process timeline. Two seeded violations (a missing kwarg and an
+explicit ``trace=None``), plus traced negative controls.
+"""
+
+from gigapath_tpu.obs import span
+
+
+def untraced_encode_span(runlog, tiles, cid):
+    # GL022: no trace= kwarg — this span stays in the local runlog and
+    # falls out of the merged fleet tree
+    with span("dist.encode", runlog, chunk=cid):
+        return tiles * 2
+
+
+def untraced_none_span(runlog, tiles, cid):
+    # GL022: trace=None is the untraced case spelled out — no credit
+    with span("dist.send", runlog, chunk=cid, trace=None):
+        return tiles + 1
+
+
+def negative_control_traced_span(runlog, ctx, tiles, cid):
+    # NEGATIVE CONTROL: the slide's TraceContext is threaded — the span
+    # lands in the fleet timeline. No GL022 finding.
+    with span("dist.encode", runlog, chunk=cid, trace=ctx):
+        return tiles * 2
+
+
+def negative_control_manual_add_span(ctx, t0, t1, cid):
+    # NEGATIVE CONTROL: manual ctx.add_span already names a context —
+    # invisible to GL022 by design.
+    ctx.add_span("deliver", t0, t1, chunk=cid)
+    return cid
