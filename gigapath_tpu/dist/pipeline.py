@@ -368,6 +368,13 @@ def run_slide_consumer(root: str, *, runlog=None,
         assembler = ChunkTracker()
     else:
         assembler = SlideAssembler(int(plan["n_tiles"]), int(plan["dim_out"]))
+    # anytime-peek cadence (ISSUE 19): GIGAPATH_DRIFT_PEEK_EVERY read
+    # ONCE here — the consumer loop never touches the environment
+    from gigapath_tpu.obs.drift import cosine, stream_peek_every
+
+    peek_every = stream_peek_every() if session is not None else 0
+    last_peek = 0
+    prev_peek: Optional[np.ndarray] = None
     assembler.expect([c[0] for c in chunks])
     watermark: List[int] = []
     if restored_state is not None:
@@ -519,8 +526,37 @@ def run_slide_consumer(root: str, *, runlog=None,
                 # remain.
                 with span("dist.fold", runlog, trace=ctx,
                           chunk=chunk.chunk_id):
-                    session.feed(chunk.chunk_id, chunk.payload,
-                                 chunk.coords)
+                    frontier = session.feed(chunk.chunk_id, chunk.payload,
+                                            chunk.coords)
+                if (peek_every > 0 and frontier > last_peek
+                        and frontier < session.n_chunks
+                        and frontier % peek_every == 0
+                        and hasattr(session, "peek")):
+                    # provisional embedding off the running partials —
+                    # same anytime surface serve/streaming.py exposes,
+                    # here mid-recovery-capable: the peek reads only
+                    # folded state, so replayed chunks never skew it
+                    with span("dist.peek", runlog, trace=ctx,
+                              fence=True, chunk=chunk.chunk_id) as sp:
+                        emb_dev = session.peek()[-1]
+                        sp.fence(emb_dev)
+                    emb = np.asarray(emb_dev, np.float32).reshape(-1)
+                    cos_prev = (cosine(emb, prev_peek)
+                                if prev_peek is not None else None)
+                    prev_peek = emb
+                    last_peek = frontier
+                    runlog.event(
+                        "stream_peek", slide=plan["slide_id"],
+                        frontier=frontier, n_chunks=session.n_chunks,
+                        frac=round(frontier / session.n_chunks, 4),
+                        cos_prev=(round(cos_prev, 6)
+                                  if cos_prev is not None else None),
+                        lse_spread=(round(session.lse_spread(), 4)
+                                    if hasattr(session, "lse_spread")
+                                    else None),
+                        wall_s=(round(sp.dur_s, 4)
+                                if sp.dur_s is not None else None),
+                    )
             delivered_here += 1
             if chaos:
                 # the consumer-crash injection point: AFTER the fold,

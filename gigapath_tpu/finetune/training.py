@@ -50,6 +50,12 @@ from gigapath_tpu.obs import (
     get_run_log,
     span,
 )
+from gigapath_tpu.obs.numerics import (
+    NumericsMonitor,
+    numerics_enabled,
+    numerics_scalars,
+    split_numerics,
+)
 from gigapath_tpu.obs.runlog import fail_run
 from gigapath_tpu.obs.telemetry import step_scalars
 from gigapath_tpu.utils.checkpoint import MonitorScore, restore_checkpoint, save_checkpoint
@@ -204,6 +210,12 @@ def train(dataloader, fold: int, args):
         labels = labels if multi_label else labels[:, 0]
         return loss_fn(logits, labels)
 
+    # GIGAPATH_NUMERICS is read HERE, once, at driver start (GL001): the
+    # Python bool gates the extra reductions at trace time, so the
+    # flag-off step lowers to byte-identical HLO and the flag-on step is
+    # still one executable across steps (shape-static summaries)
+    numerics_on = numerics_enabled()
+
     @jax.jit
     def train_step(params, opt_state, images, coords, labels, pad_mask, rng):
         loss, grads = jax.value_and_grad(_loss)(
@@ -214,6 +226,8 @@ def train(dataloader, fold: int, args):
         # in-graph telemetry: a few extra reductions in the same XLA
         # program, resolved host-side only at existing sync points
         tel = step_scalars(grads=grads, params=params)
+        if numerics_on:
+            tel.update(numerics_scalars(grads=grads))
         return params, opt_state, loss, tel
 
     @jax.jit
@@ -363,6 +377,7 @@ def train_one_epoch(
     # are the device-truth numbers the report already trusts
     metrics = get_metrics(runlog)
     step_walls = metrics.histogram("finetune.step_wall_s")
+    numerics = NumericsMonitor(runlog, name="finetune")
     start_time = time.time()
     seq_len = 0
     records = get_records_array(len(train_loader), args.n_classes)
@@ -426,6 +441,9 @@ def train_one_epoch(
             # tel's device arrays are materialized by the sync above —
             # reading them here costs no extra round-trip
             scalars = {k: float(np.asarray(v)) for k, v in tel.items()}
+            # per-layer numerics (GIGAPATH_NUMERICS) ride the same sync:
+            # num.* keys peel off into their own schema'd event
+            scalars, num_scalars = split_numerics(scalars)
             runlog.step(
                 global_step,
                 wall_s=round(t_now - t_prev, 6),
@@ -437,6 +455,8 @@ def train_one_epoch(
                 seq_len=seq_len / (batch_idx + 1),
                 **scalars,
             )
+            if num_scalars:
+                numerics.emit(global_step, num_scalars)
             step_walls.observe(round(t_now - t_prev, 6))
             metrics.maybe_flush()
             runlog.echo(

@@ -518,6 +518,77 @@ class StreamingEncoderSession:
             ]]
         return [self._readout(blocks) for blocks in states]
 
+    # -- anytime embeddings (ROADMAP item 4 / ISSUE 19) ----------------------
+
+    def _truncated_state(self, n_blocks: int,
+                         valid_len: int) -> StreamingPrefillState:
+        """A fold state over the FIRST ``n_blocks`` token blocks.
+        ``total_len`` stays the full slide length so ``_branch_geometry``'s
+        ``g = min(sl, L)`` clamp — and therefore the branch schedule and
+        the compiled fold executables — is identical to the final pass;
+        only the chunk list and the valid-key horizon shrink."""
+        return StreamingPrefillState(
+            self.token_bounds[:n_blocks], self.segment_lengths,
+            self.dilated_ratios, total_len=self.token_bounds[-1][1],
+            valid_len=valid_len, fold_fn=self._fold_fn,
+        )
+
+    def peek(self) -> List[jnp.ndarray]:
+        """Provisional embeddings from the chunks folded so far — the
+        anytime read of the stream. Layer 0 comes straight off the LIVE
+        running ``(out, lse)`` partials (:meth:`StreamingPrefillState.
+        peek_blocks` — exact attention over the folded keys, nothing
+        recomputed, nothing mutated); layers 1+ run chunk-blocked over
+        the truncated block list through the SAME stage executables as
+        ``finalize`` (same block shapes, same static fold geometry — a
+        peek adds zero compiles once the stages are warm). Returns the
+        same per-layer embed list shape as :meth:`finalize`; with every
+        chunk folded the two are BIT-exact (identical op sequence) —
+        the convergence anchor of the ``serve.stream_confidence``
+        surface."""
+        f = self._next_tile_chunk
+        if f < 1:
+            raise RuntimeError("peek before any tile chunk folded")
+        n_blocks = 1 + f  # cls + folded tile chunks
+        valid = 1 + min(self.n_tiles, f * self.chunk_tiles)
+        h_blocks = [b for b in self._h_blocks[:n_blocks]]
+        assert all(b is not None for b in h_blocks)
+        states = [h_blocks] if self.all_layer_embed else []
+        lp = self._layer_params(0)
+        attn_blocks = self._layer0.peek_blocks()
+        h_blocks = [
+            self._post_fn(lp, h, a, eps=self.eps, subln=self.subln)
+            for h, a in zip(h_blocks, attn_blocks)
+        ]
+        if self.all_layer_embed:
+            states.append(h_blocks)
+        for depth in range(1, self.depth):
+            lp = self._layer_params(depth)
+            state = self._truncated_state(n_blocks, valid)
+            for i, h in enumerate(h_blocks):
+                state.ingest(i, *self._qkv_fn(
+                    lp, h, num_heads=self.num_heads, eps=self.eps,
+                ))
+            attn_blocks = state.finalize()
+            h_blocks = [
+                self._post_fn(lp, h, a, eps=self.eps, subln=self.subln)
+                for h, a in zip(h_blocks, attn_blocks)
+            ]
+            if self.all_layer_embed:
+                states.append(h_blocks)
+        if not self.all_layer_embed:
+            final_ln = self.params["encoder"]["layer_norm"]
+            states = [[
+                _layer_norm(b, final_ln, self.eps) for b in h_blocks
+            ]]
+        return [self._readout(blocks) for blocks in states]
+
+    def lse_spread(self) -> float:
+        """Layer-0 per-branch LSE spread off the live partials — the
+        streaming numerics signal attached to ``stream_peek`` events.
+        Syncs to host: call at peek cadence, never per fold."""
+        return self._layer0.lse_spread()
+
 
 def embeds_to_outputs(embeds: List) -> Dict[str, np.ndarray]:
     """The ONE encoder-output contract: a session's per-layer embed list

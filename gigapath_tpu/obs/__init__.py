@@ -30,6 +30,15 @@
   transitions feed the anomaly engine's ``slo_burn`` detector — plus
   the ONE shared :func:`~gigapath_tpu.obs.metrics.percentile`
   implementation (GL012);
+- :mod:`gigapath_tpu.obs.numerics` — in-graph per-layer numerics
+  telemetry (finite fraction / absmax / rms behind the
+  ``GIGAPATH_NUMERICS`` host flag, riding the ``step_scalars``
+  discipline) emitted as schema'd ``numerics`` events;
+- :mod:`gigapath_tpu.obs.drift` — the embedding-drift sentinel:
+  mergeable :class:`~gigapath_tpu.obs.drift.EmbeddingSketch` baselines
+  (manifest-verified artifacts), drift scores as metrics gauges, and
+  transition-edged ``drift`` events feeding the anomaly engine's
+  ``embedding_drift`` detector;
 - :mod:`gigapath_tpu.obs.reqtrace` — end-to-end request tracing:
   ``RequestTrace`` contexts with stable ``trace_id``/``span_id`` pairs
   threaded submit -> queue -> dispatch -> forward -> cache store ->
@@ -43,6 +52,12 @@ from gigapath_tpu.obs.anomaly import (
     AnomalyEngine,
     NullAnomalyEngine,
     attach_anomaly_engine,
+)
+from gigapath_tpu.obs.drift import (
+    CorruptDriftArtifact,
+    DriftSentinel,
+    EmbeddingSketch,
+    drift_scores,
 )
 from gigapath_tpu.obs.flight import FlightRecorder
 from gigapath_tpu.obs.heartbeat import Heartbeat, memory_watermarks
@@ -63,6 +78,12 @@ from gigapath_tpu.obs.metrics import (
     get_metrics,
     merge_snapshots,
     percentile,
+)
+from gigapath_tpu.obs.numerics import (
+    NumericsMonitor,
+    numerics_enabled,
+    numerics_scalars,
+    split_numerics,
 )
 from gigapath_tpu.obs.reqtrace import (
     RequestTrace,
@@ -94,6 +115,9 @@ __all__ = [
     "AnomalyConfig",
     "AnomalyEngine",
     "CompileWatchdog",
+    "CorruptDriftArtifact",
+    "DriftSentinel",
+    "EmbeddingSketch",
     "FlightRecorder",
     "Heartbeat",
     "Histogram",
@@ -103,6 +127,7 @@ __all__ = [
     "NullMetricsRegistry",
     "NullRunLog",
     "NullSloTracker",
+    "NumericsMonitor",
     "PerfLedger",
     "RequestTrace",
     "RunLog",
@@ -113,6 +138,7 @@ __all__ = [
     "attach_anomaly_engine",
     "capture_profile",
     "console",
+    "drift_scores",
     "get_ledger",
     "get_metrics",
     "get_run_log",
@@ -120,8 +146,11 @@ __all__ = [
     "jaxpr_fingerprint",
     "memory_watermarks",
     "merge_snapshots",
+    "numerics_enabled",
+    "numerics_scalars",
     "percentile",
     "span",
+    "split_numerics",
     "start_trace",
     "stop_trace",
     "trace",

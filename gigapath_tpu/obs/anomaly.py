@@ -61,7 +61,15 @@ the traced programs are untouched, so the engine can add no retraces):
   bad regime is ONE anomaly, the same "one deadline, one owner" rule as
   ``stall``). The reaction — flight dump + armed profiler capture — is
   exactly what a degrading p99 needs: the next few dispatches run
-  inside a trace.
+  inside a trace;
+- ``embedding_drift``   — a ``drift`` event with ``alarming: true``
+  from the :class:`~gigapath_tpu.obs.drift.DriftSentinel` (served
+  embeddings' standardized mean shift vs the persisted baseline sketch
+  crossed the threshold — no re-detection: the sentinel owns the
+  scoring cadence and is transition-edged like the SloTracker, so a
+  sustained drifted regime is ONE anomaly; terminal status events are
+  marked ``final`` and never fire). The model-health page: the system
+  can be at perfect p99 while serving garbage embeddings.
 
 ``error`` events trigger a flight dump (context for the post-mortem)
 without counting as an anomaly. Per-detector cooldowns (in step events)
@@ -90,7 +98,7 @@ from gigapath_tpu.obs.flight import FlightRecorder, register_signal_dump
 DETECTORS = (
     "step_time_spike", "throughput_dip", "stall", "unexpected_retrace",
     "memory_watermark", "nonfinite_step", "slo_burn", "worker_lost",
-    "consumer_lost",
+    "consumer_lost", "embedding_drift",
 )
 
 
@@ -352,6 +360,21 @@ class AnomalyEngine(NullAnomalyEngine):
                     budget=record.get("budget"),
                     burn_long=record.get("burn_long"),
                     latency_s=record.get("latency_s"),
+                )
+            elif kind == "drift" and record.get("alarming") and not \
+                    record.get("final"):
+                # the DriftSentinel's alarming TRANSITION (the SloTracker
+                # discipline: terminal status events are final and never
+                # fire — a run that ends drifted already fired at entry)
+                self._fire_locked(
+                    "embedding_drift",
+                    value=record.get("mean_shift"),
+                    threshold=record.get("threshold"),
+                    cosine_dist=record.get("cosine_dist"),
+                    tail_mass=record.get("tail_mass"),
+                    count=record.get("count"),
+                    baseline_count=record.get("baseline_count"),
+                    name=record.get("name"),
                 )
             elif kind == "worker_lost":
                 # membership's verdict (one event per lost worker); the

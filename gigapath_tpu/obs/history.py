@@ -76,6 +76,16 @@ _DIRECTION_RULES: Tuple[Tuple[str, str], ...] = (
     # ride no rule (they trade against each other as the split moves)
     ("wire_share", "down"),
     ("backpressure_share", "down"),
+    # embedding-drift sentinel (serve|drift entry, serve_smoke --drift):
+    # drift scores vs the blessed baseline sketch are down-good; the
+    # anytime-confidence cosines (first/last peek vs the finalized
+    # embedding) are up-good — a DROP means the provisional surface got
+    # less trustworthy at the same peek cadence
+    ("drift_mean_shift", "down"),
+    ("drift_cosine_dist", "down"),
+    ("drift_tail_mass", "down"),
+    ("confidence_first", "up"),
+    ("confidence_last", "up"),
     # streaming-prefill decision-table rows (prefill|stream entry):
     # executable arg/temp/peak megabytes and stream-vs-dense ratios,
     # smaller is better
@@ -337,6 +347,28 @@ def fold_fleet(doc: dict, snapshot: dict, label: str,
     return _fold_serve_snapshot(
         doc, snapshot, label, key="dist|trace",
         metric_keys=_FLEET_METRICS, source=source, force=force,
+    )
+
+
+# serve_smoke --drift payload fields worth trending (the model-health
+# leg's JSON line): drift scores of the shifted phase vs the blessed
+# baseline sketch, plus the anytime-confidence summary
+_DRIFT_METRICS = (
+    "drift_mean_shift", "drift_cosine_dist", "drift_tail_mass",
+    "stream_confidence_first", "stream_confidence_last",
+)
+
+
+def fold_drift(doc: dict, snapshot: dict, label: str,
+               source: Optional[str] = None, force: bool = False) -> dict:
+    """One ``serve_smoke --drift`` JSON -> one point under
+    ``serve|drift`` (the model-health twin of :func:`fold_serve` — same
+    shared CPU-stale-with-keys policy: a CPU smoke carries the drift
+    score and confidence KEYS for future on-chip rounds without ever
+    moving the trend)."""
+    return _fold_serve_snapshot(
+        doc, snapshot, label, key="serve|drift",
+        metric_keys=_DRIFT_METRICS, source=source, force=force,
     )
 
 

@@ -1,0 +1,175 @@
+"""In-graph numerics telemetry: per-layer health of the traced step.
+
+The obs bus measures the *system* (steps, compiles, latency); this
+module watches the *model's arithmetic*: per-layer finite fraction,
+absolute max and RMS of gradients/params, computed INSIDE the jitted
+step as a handful of reductions and threaded out through the PR-2
+``step_scalars`` discipline — 0-d device arrays, floats only at the
+driver's existing sync points, a schema'd ``numerics`` event per sync.
+
+Flag discipline (the kernel-flag contract, gigalint GL001):
+``GIGAPATH_NUMERICS`` is read ONCE, host-side, at driver start via
+:func:`numerics_enabled`; the traced step gates on the resulting Python
+bool. Flag off, the step closure adds zero ops — the lowered HLO is
+byte-identical to a build of this repo without this module (pinned by
+``tests/test_model_health.py``). Flag on, the summaries are shape- and
+dtype-static functions of the pytree structure, so steps 2..N reuse
+step 1's executable — zero retraces (watchdog-pinned).
+
+Key space: every scalar is ``num.<layer>.<stat>`` where ``<layer>`` is
+the top-level key of the grads/params dict (the per-layer granularity
+the report renders) and ``<stat>`` is ``finite_frac`` / ``absmax`` /
+``rms``. :func:`split_numerics` peels these off the synced float dict
+host-side; :class:`NumericsMonitor` folds them back into the nested
+per-layer table of the ``numerics`` event.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+from gigapath_tpu.ops.common import env_flag
+
+NUMERICS_PREFIX = "num."
+
+_STATS = ("finite_frac", "absmax", "rms")
+
+
+def numerics_enabled() -> bool:
+    """``GIGAPATH_NUMERICS`` snapshot — default OFF (numerics telemetry
+    is opt-in: it adds reductions to the step program). Host-side, read
+    once at driver start; never call from traced code (GL001)."""
+    return env_flag("GIGAPATH_NUMERICS")
+
+
+def _leaf_groups(tree) -> Dict[str, list]:
+    """Top-level-key -> leaves. Non-dict trees collapse to one group."""
+    import jax
+
+    if not isinstance(tree, dict):
+        return {"all": jax.tree_util.tree_leaves(tree)}
+    out: Dict[str, list] = {}
+    for name in sorted(tree):
+        leaves = jax.tree_util.tree_leaves(tree[name])
+        if leaves:
+            out[str(name)] = leaves
+    return out
+
+
+def group_summaries(tree, *, prefix: str) -> Dict[str, Any]:
+    """Per-top-level-subtree numerics reductions, trace-safe.
+
+    Returns ``{prefix}.{layer}.{stat}`` -> 0-d fp32 device array. All
+    reductions accumulate in fp32 (bf16 squares of ~1e-2 grads
+    underflow — the ``tree_norm`` discipline). ``absmax`` propagates
+    NaN on purpose: a non-finite layer must read as non-finite, not be
+    masked by a finite neighbour."""
+    import jax.numpy as jnp
+
+    out: Dict[str, Any] = {}
+    for name, leaves in _leaf_groups(tree).items():
+        size = sum(leaf.size for leaf in leaves)
+        if size == 0:
+            continue
+        finite = sum(
+            jnp.sum(jnp.isfinite(leaf.astype(jnp.float32))) for leaf in leaves
+        )
+        absmax = jnp.stack(
+            [jnp.max(jnp.abs(leaf.astype(jnp.float32))) for leaf in leaves]
+        ).max()
+        sumsq = sum(
+            jnp.sum(jnp.square(leaf.astype(jnp.float32))) for leaf in leaves
+        )
+        base = f"{prefix}.{name}"
+        out[f"{base}.finite_frac"] = finite.astype(jnp.float32) / size
+        out[f"{base}.absmax"] = absmax.astype(jnp.float32)
+        out[f"{base}.rms"] = jnp.sqrt(sumsq / size).astype(jnp.float32)
+    return out
+
+
+def numerics_scalars(*, grads=None, params=None) -> Dict[str, Any]:
+    """The in-graph numerics set, ready to ride ``step_scalars``'s
+    ``**extras``: per-layer grad summaries under ``num.grad.*`` and
+    (when given) param summaries under ``num.param.*``. Call only when
+    :func:`numerics_enabled` returned True at driver start — the
+    flag-off step must not contain these ops."""
+    out: Dict[str, Any] = {}
+    if grads is not None:
+        out.update(group_summaries(grads, prefix=NUMERICS_PREFIX + "grad"))
+    if params is not None:
+        out.update(group_summaries(params, prefix=NUMERICS_PREFIX + "param"))
+    return out
+
+
+def split_numerics(
+    scalars: Dict[str, float]
+) -> Tuple[Dict[str, float], Dict[str, float]]:
+    """Host-side: peel ``num.*`` keys off a synced float dict. Returns
+    ``(rest, numerics)`` — ``rest`` goes to ``RunLog.step`` as before,
+    ``numerics`` to :meth:`NumericsMonitor.emit`."""
+    rest: Dict[str, float] = {}
+    num: Dict[str, float] = {}
+    for key, val in scalars.items():
+        (num if key.startswith(NUMERICS_PREFIX) else rest)[key] = val
+    return rest, num
+
+
+def numerics_layers(num: Dict[str, float]) -> Dict[str, Dict[str, float]]:
+    """``num.grad.encoder.rms`` -> ``{"grad.encoder": {"rms": ...}}`` —
+    the nested per-layer table the ``numerics`` event carries."""
+    layers: Dict[str, Dict[str, float]] = {}
+    for key, val in num.items():
+        body = key[len(NUMERICS_PREFIX):]
+        layer, _, stat = body.rpartition(".")
+        if not layer or stat not in _STATS:
+            continue
+        layers.setdefault(layer, {})[stat] = float(val)
+    return layers
+
+
+class NumericsMonitor:
+    """Host-side emitter: folds synced ``num.*`` floats into one
+    schema'd ``numerics`` event per sync point, with the worst-layer
+    summary the report and the tests key on. Against a ``NullRunLog``
+    every emit is a no-op event — the obs-off twin costs nothing."""
+
+    def __init__(self, runlog, *, name: str = "train"):
+        self.runlog = runlog
+        self.name = name
+        self.emitted = 0
+
+    def emit(self, step: Optional[int],
+             num: Dict[str, float]) -> Optional[dict]:
+        layers = numerics_layers(num)
+        if not layers:
+            return None
+        worst_ff = min(
+            (s["finite_frac"] for s in layers.values() if "finite_frac" in s),
+            default=None,
+        )
+        absmaxes = [s["absmax"] for s in layers.values() if "absmax" in s]
+        # max() treats NaN inconsistently (order-dependent): a single
+        # non-finite layer must own the worst_absmax verdict
+        worst_am = None
+        if absmaxes:
+            worst_am = max(absmaxes)
+            for v in absmaxes:
+                if v != v:  # NaN
+                    worst_am = v
+                    break
+        self.emitted += 1
+        return self.runlog.event(
+            "numerics", name=self.name, step=step, layers=layers,
+            worst_finite_frac=worst_ff, worst_absmax=worst_am,
+        )
+
+
+__all__ = [
+    "NUMERICS_PREFIX",
+    "NumericsMonitor",
+    "group_summaries",
+    "numerics_enabled",
+    "numerics_layers",
+    "numerics_scalars",
+    "split_numerics",
+]

@@ -66,6 +66,20 @@ Event kinds (schema v1, one JSON object per line, every record carries
   transport link (:mod:`gigapath_tpu.obs.clock`): link, offset/rtt/
   uncertainty seconds, sample count, reconnect epoch — what
   ``obs/fleet.py`` aligns per-process timelines with;
+- ``numerics``   — per-layer in-graph numerics summary
+  (:mod:`gigapath_tpu.obs.numerics`): finite fraction, absmax, rms per
+  top-level param subtree, synced at the driver's existing sync points
+  (the ``step_scalars`` discipline) behind the ``GIGAPATH_NUMERICS``
+  host flag;
+- ``drift``      — an embedding-drift transition or terminal status
+  from the :class:`~gigapath_tpu.obs.drift.DriftSentinel`
+  (standardized mean shift, cosine distance, tail mass vs a persisted
+  baseline sketch) — ``alarming: true`` transitions feed the anomaly
+  engine's ``embedding_drift`` detector;
+- ``stream_peek`` — one anytime read of a streaming slide serve
+  (``StreamingEncoderSession.peek()``): fold frontier, provisional-
+  embedding cosine vs the previous peek, layer-0 branch LSE spread —
+  the provisional half of the ``serve.stream_confidence`` surface;
 - ``error``      — exception surfaced by a driver;
 - ``run_end``    — terminal status + summary payload.
 
@@ -93,7 +107,8 @@ EVENT_KINDS = (
     "run_start", "step", "compile", "compile_profile", "span", "eval",
     "heartbeat", "stall", "anomaly", "recovery", "serve_dispatch",
     "cache_hit", "metrics", "slo", "trace", "clock_sync", "backpressure",
-    "worker_lost", "consumer_lost", "error", "run_end",
+    "worker_lost", "consumer_lost", "numerics", "drift", "stream_peek",
+    "error", "run_end",
 )
 
 

@@ -480,6 +480,56 @@ class StreamingPrefillState:
             blocks.append(fuse_branch_partials(outs, lses, jnp.float32))
         return blocks
 
+    def peek_blocks(self) -> List[jnp.ndarray]:
+        """Anytime read of the fold: fused output blocks for every chunk
+        at or before the frontier, WITHOUT requiring (or mutating) a
+        completed stream — :meth:`finalize`'s fusion loop minus the
+        completeness check. Sound because the strict-order ingest folds
+        ``(i, i)`` the moment chunk ``i`` lands, so every chunk ``<=``
+        the frontier holds a non-None accumulator in every branch, and
+        the stored-LSE combine is exact: the partials ARE the exact
+        attention over the keys folded so far. The blocks are therefore
+        provisional only in the sense that future chunks will extend
+        the key set — the basis of ``StreamingEncoderSession.peek()``'s
+        anytime-confidence surface."""
+        if self._next < 1:
+            raise RuntimeError("peek before any chunk folded")
+        blocks: List[jnp.ndarray] = []
+        for i in range(self._next):
+            outs, lses = [], []
+            for b in range(len(self.branches)):
+                acc = self._acc[b][i]
+                assert acc is not None  # (i, i) always folds
+                outs.append(acc[0])
+                lses.append(acc[1])
+            blocks.append(fuse_branch_partials(outs, lses, jnp.float32))
+        return blocks
+
+    def lse_spread(self) -> float:
+        """Per-branch numerics signal off the running partials: the
+        spread (max − min over branches) of each branch's mean finite
+        LSE across folded chunks. A branch whose logsumexp mass drifts
+        far from its siblings is the streaming twin of a per-layer
+        absmax blowup — surfaced through the ``numerics``/``stream_peek``
+        events, host-side only (this syncs; call at peek cadence, never
+        per fold)."""
+        if self._next < 1:
+            return 0.0
+        means = []
+        for b in range(len(self.branches)):
+            total = jnp.float32(0.0)
+            count = jnp.float32(0.0)
+            for i in range(self._next):
+                acc = self._acc[b][i]
+                if acc is None:
+                    continue
+                lse = acc[1]
+                finite = lse > (NEG_INF * 0.5)
+                total = total + jnp.sum(jnp.where(finite, lse, 0.0))
+                count = count + jnp.sum(finite)
+            means.append(float(total) / max(float(count), 1.0))
+        return float(max(means) - min(means)) if means else 0.0
+
 
 def streaming_dilated_attention(
     q_blocks: Sequence[jnp.ndarray],

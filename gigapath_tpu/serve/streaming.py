@@ -24,12 +24,23 @@ Fleet tracing (ISSUE 17): ``open(..., trace=ctx)`` threads a
 finalize land as ``fold`` / ``finalize`` spans in the slide's
 cross-process causal tree. Duplicate deliveries dedup on the context's
 structural span id, so a replayed chunk cannot fork the tree.
+
+Model health (ISSUE 19): with ``GIGAPATH_DRIFT_PEEK_EVERY=N`` (or an
+explicit ``peek_every``), the session takes a provisional embedding off
+the running partials every N folded chunks
+(``StreamingEncoderSession.peek()``) and emits one ``stream_peek``
+event per peek (frontier, cosine vs the previous peek, layer-0 branch
+LSE spread); ``result()`` scores every peek against the finalized
+embedding — the anytime-confidence surface — observing each cosine
+into the ``serve.stream_confidence`` histogram and folding the final
+embedding into the submitter's :class:`~gigapath_tpu.obs.drift.
+DriftSentinel` when one is attached.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -39,6 +50,12 @@ from gigapath_tpu.models.streaming_encoder import (
     embeds_to_outputs,
     prefill_chunk_tiles,
 )
+from gigapath_tpu.obs.drift import cosine, stream_peek_every
+
+# cosine-confidence ladder: 0.05-wide linear rungs over (0, 1] — the
+# default exponential latency ladder would dump every confidence into
+# two buckets
+CONFIDENCE_BOUNDS = [i / 20 for i in range(1, 21)]
 
 
 class StreamingSlideSession:
@@ -61,6 +78,8 @@ class StreamingSlideSession:
         )
         self._t_open = time.monotonic()
         self._outputs: Optional[Dict[str, np.ndarray]] = None
+        self._peeks: List[Tuple[int, np.ndarray]] = []
+        self._last_peek = 0
         if submitter.runlog is not None:
             submitter.runlog.event(
                 "stream_open", slide=slide_id, n_tiles=int(n_tiles),
@@ -81,7 +100,34 @@ class StreamingSlideSession:
         if self.trace is not None:
             self.trace.add_span("fold", t0, time.monotonic(), chunk=cid,
                                 parent=parent)
+        every = self.submitter.peek_every
+        if (every > 0 and frontier > self._last_peek
+                and frontier < self.session.n_chunks
+                and frontier % every == 0):
+            self._peek(frontier)
         return frontier
+
+    def _peek(self, frontier: int) -> None:
+        """One anytime read: provisional last-layer embedding off the
+        running partials + the ``stream_peek`` event (cosine vs the
+        previous peek, layer-0 branch LSE spread)."""
+        t0 = time.monotonic()
+        emb = np.asarray(
+            self.session.peek()[-1], np.float32
+        ).reshape(-1)
+        cos_prev = cosine(emb, self._peeks[-1][1]) if self._peeks else None
+        self._peeks.append((frontier, emb))
+        self._last_peek = frontier
+        if self.submitter.runlog is not None:
+            self.submitter.runlog.event(
+                "stream_peek", slide=self.slide_id, frontier=frontier,
+                n_chunks=self.session.n_chunks,
+                frac=round(frontier / self.session.n_chunks, 4),
+                cos_prev=(round(cos_prev, 6) if cos_prev is not None
+                          else None),
+                lse_spread=round(self.session.lse_spread(), 4),
+                wall_s=round(time.monotonic() - t0, 4),
+            )
 
     def pending(self) -> List[int]:
         return self.session.pending()
@@ -93,12 +139,37 @@ class StreamingSlideSession:
             if self.trace is not None:
                 self.trace.add_span("finalize", t0, time.monotonic())
             self.submitter.served += 1
+            final = np.asarray(
+                self._outputs["last_layer_embed"], np.float32
+            ).reshape(-1)
+            # provisional-vs-final convergence: each peek's cosine to
+            # the finalized embedding, observed into the shared
+            # serve.stream_confidence histogram
+            confidences = [
+                round(cosine(emb, final), 6) for _, emb in self._peeks
+            ]
+            hist = self.submitter.confidence_hist
+            if hist is not None:
+                for c in confidences:
+                    hist.observe(c)
             if self.submitter.runlog is not None:
                 self.submitter.runlog.event(
                     "stream_result", slide=self.slide_id,
                     n_chunks=self.session.n_chunks,
+                    peeks=len(confidences),
+                    confidence_first=(
+                        confidences[0] if confidences else None
+                    ),
+                    confidence_last=(
+                        confidences[-1] if confidences else None
+                    ),
                     wall_s=round(time.monotonic() - self._t_open, 4),
                 )
+            # the served embedding feeds the drift sentinel LAST: an
+            # alarming transition's flight dump then carries this
+            # slide's stream_peek/stream_result context
+            if self.submitter.drift is not None:
+                self.submitter.drift.observe(final)
         return self._outputs
 
 
@@ -112,13 +183,29 @@ class StreamingSubmitter:
     flag."""
 
     def __init__(self, model, params, *, chunk_tiles: Optional[int] = None,
-                 runlog=None, name: str = "serve.stream"):
+                 runlog=None, name: str = "serve.stream",
+                 drift=None, peek_every: Optional[int] = None,
+                 metrics=None):
+        """``drift``: optional :class:`~gigapath_tpu.obs.drift.
+        DriftSentinel` every finalized embedding folds into.
+        ``peek_every``: anytime-peek cadence in folded chunks (defaults
+        to the ``GIGAPATH_DRIFT_PEEK_EVERY`` host flag, snapshotted
+        here at construction; 0 = off). ``metrics``: optional registry
+        for the ``serve.stream_confidence`` histogram."""
         self.model = model
         self.params = params
         self.chunk_tiles = int(chunk_tiles or prefill_chunk_tiles())
         self.runlog = runlog
         self.name = name
         self.served = 0
+        self.drift = drift
+        self.peek_every = int(peek_every if peek_every is not None
+                              else stream_peek_every())
+        self.confidence_hist = None
+        if metrics is not None:
+            self.confidence_hist = metrics.histogram(
+                "serve.stream_confidence", bounds=CONFIDENCE_BOUNDS
+            )
 
     def open(self, slide_id: str, n_tiles: int,
              trace=None) -> StreamingSlideSession:

@@ -33,6 +33,9 @@
 #   - GL022     the seeded untraced-dist-span fixture must fire
 #               (span() in dist/ library code without trace=ctx never
 #               reaches the fleet's merged timeline);
+#   - GL023     the seeded running-moments fixture must fire (by-hand
+#               Welford triple in library code instead of the obs
+#               accumulators);
 #   - autotune  (scripts/autotune.py --selftest): blessed-plan dispatch,
 #               env precedence, corrupt-registry refusal.
 #
@@ -95,6 +98,8 @@ run_selftest GL017 1 python -m tools.gigalint --no-waivers --select GL017 \
     tools/gigalint/selftest/fixture/models/dispatch.py
 run_selftest GL022 1 python -m tools.gigalint --no-waivers --select GL022 \
     tools/gigalint/selftest/fixture/dist/worker.py
+run_selftest GL023 1 python -m tools.gigalint --no-waivers --select GL023 \
+    tools/gigalint/selftest/fixture/models/moments.py
 
 # gigarace (lock-discipline) seeded fixtures — same rc=1 contract
 run_selftest GL018 1 python -m tools.gigalint --no-waivers --select GL018 \

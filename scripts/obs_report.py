@@ -387,6 +387,89 @@ def render(events: List[dict], out=None) -> int:
                 )
         w("\n")
 
+    # -- numerics (obs/numerics.py: in-graph layer summaries) -------------
+    numerics_events = by_kind.get("numerics", [])
+    if numerics_events:
+        w("== numerics ==\n")
+        last_num = numerics_events[-1]
+        w(f"numerics events: {len(numerics_events)} (monitor "
+          f"'{last_num.get('name')}', last step {last_num.get('step')})\n")
+        worst_ff: Dict[str, float] = {}
+        worst_am: Dict[str, float] = {}
+        for ev in numerics_events:
+            for layer, stats in (ev.get("layers") or {}).items():
+                layer = str(layer)
+                ff = stats.get("finite_frac")
+                if ff is not None and (layer not in worst_ff
+                                       or float(ff) < worst_ff[layer]):
+                    worst_ff[layer] = float(ff)
+                am = stats.get("absmax")
+                if am is not None:
+                    am = float(am)
+                    cur = worst_am.get(layer)
+                    # NaN (am != am) always wins the "worst" slot
+                    if cur is None or am != am or (cur == cur and am > cur):
+                        worst_am[layer] = am
+        w("per-layer worst (layer / finite_frac / absmax):\n")
+        for layer in sorted(set(worst_ff) | set(worst_am)):
+            ff = worst_ff.get(layer)
+            am = worst_am.get(layer)
+            flag = ""
+            if (ff is not None and ff < 1.0) or (am is not None and am != am):
+                flag = "  NON-FINITE"
+            w("  {}: finite_frac {} absmax {}{}\n".format(
+                layer,
+                "-" if ff is None else f"{ff:g}",
+                "-" if am is None else f"{am:g}",
+                flag,
+            ))
+        bad = [ev for ev in numerics_events
+               if ev.get("worst_finite_frac") is not None
+               and float(ev["worst_finite_frac"]) < 1.0]
+        if bad:
+            w(f"WARNING: {len(bad)} event(s) carrying non-finite values "
+              f"(first at step {bad[0].get('step')})\n")
+        w("\n")
+
+    # -- drift (obs/drift.py: embedding-drift sentinel + anytime peeks) ---
+    drift_events = by_kind.get("drift", [])
+    peeks = by_kind.get("stream_peek", [])
+    peeked_results = [ev for ev in by_kind.get("stream_result", [])
+                      if ev.get("confidence_last") is not None]
+    if drift_events or peeks or peeked_results:
+        w("== drift ==\n")
+        if drift_events:
+            alarms = [ev for ev in drift_events
+                      if ev.get("alarming") and not ev.get("final")]
+            last_dr = drift_events[-1]
+            w(f"drift events: {len(drift_events)} "
+              f"({len(alarms)} alarming transition(s))\n")
+            w("last scores vs baseline (sentinel '{}'): mean_shift {} "
+              "(threshold {}), cosine_dist {}, tail_mass {}\n".format(
+                  last_dr.get("name"), last_dr.get("mean_shift"),
+                  last_dr.get("threshold"), last_dr.get("cosine_dist"),
+                  last_dr.get("tail_mass")))
+            w(f"sketch sizes: current {last_dr.get('count')} / baseline "
+              f"{last_dr.get('baseline_count')} embedding(s)\n")
+        if peeks:
+            fracs = sorted(float(ev["frac"]) for ev in peeks
+                           if ev.get("frac") is not None)
+            w(f"anytime peeks: {len(peeks)}"
+              + (f" (frontier frac p50 {fracs[len(fracs) // 2]:g})"
+                 if fracs else "") + "\n")
+        if peeked_results:
+            firsts = sorted(float(ev["confidence_first"])
+                            for ev in peeked_results
+                            if ev.get("confidence_first") is not None)
+            lasts = sorted(float(ev["confidence_last"])
+                           for ev in peeked_results)
+            w("confidence (provisional vs final cosine): "
+              "first p50 {:g} last p50 {:g} over {} slide(s)\n".format(
+                  percentile(firsts, 0.50) if firsts else float("nan"),
+                  percentile(lasts, 0.50),
+                  len(peeked_results)))
+        w("\n")
+
     # -- dist (gigapath_tpu.dist: cross-stage boundary + membership) ------
     backpressures = by_kind.get("backpressure", [])
     lost_workers = by_kind.get("worker_lost", [])
@@ -856,6 +939,48 @@ def selftest() -> int:
         assert served == 6 and all(
             tr_.t_end is not None for tr_ in tracer._traces
         ), "traced smoke failed to resolve every request"
+
+        # -- model health (ISSUE 19): a REAL drift firing — baseline
+        # sketch saved/loaded through the manifest discipline, then a
+        # shifted serve stream through the DriftSentinel, whose alarming
+        # transition the attached anomaly engine turns into an
+        # embedding_drift anomaly + flight dump. The numerics event is
+        # synthesized (the in-graph summaries need a jitted step; the
+        # report folds the schema), as are the anytime-peek events.
+        import numpy as _np
+
+        from gigapath_tpu.obs.drift import DriftSentinel, EmbeddingSketch
+
+        rng = _np.random.default_rng(7)
+        baseline = EmbeddingSketch(8)
+        for _ in range(32):
+            baseline.update(rng.normal(0.0, 1.0, 8))
+        sketch_dir = os.path.join(tmp, "baseline_sketch")
+        baseline.save(sketch_dir)
+        sentinel = DriftSentinel(
+            EmbeddingSketch.load(sketch_dir), log, metrics=registry,
+            every=4, threshold=1.0, min_count=4,
+        )
+        for _ in range(8):
+            sentinel.observe(rng.normal(6.0, 1.0, 8))  # forced shift
+        assert sentinel.alarming, "forced drift failed to alarm"
+        sentinel.emit_status()
+        log.event(
+            "numerics", name="selftest", step=24,
+            layers={
+                "grad.encoder": {"finite_frac": 1.0, "absmax": 3.5,
+                                 "rms": 0.7},
+                "grad.head": {"finite_frac": 0.875, "absmax": 12.0,
+                              "rms": 1.1},
+            },
+            worst_finite_frac=0.875, worst_absmax=12.0,
+        )
+        log.event("stream_peek", slide="s_drift", frontier=4, n_chunks=8,
+                  frac=0.5, cos_prev=None, lse_spread=0.12, wall_s=0.01)
+        log.event("stream_result", slide="s_drift", n_chunks=8, peeks=2,
+                  confidence_first=0.91, confidence_last=0.998,
+                  wall_s=0.4)
+
         registry.flush(reason="final")
         slo.emit_status()
         trace_path = tracer.export()
@@ -954,7 +1079,17 @@ def selftest() -> int:
                 "(epoch 1, 3 sample(s))",
                 "chunks.w0: unacked 2, ack lag 2 chunk(s) (0.050s), "
                 "backpressure 1.250s, retransmits 2, bytes 65536",
-                "scripts/fleet_report.py")
+                "scripts/fleet_report.py",
+                "== numerics ==", "per-layer worst",
+                "grad.head: finite_frac 0.875 absmax 12  NON-FINITE",
+                "grad.encoder: finite_frac 1 absmax 3.5",
+                "WARNING: 1 event(s) carrying non-finite values",
+                "== drift ==", "1 alarming transition(s)",
+                "sketch sizes: current 8 / baseline 32 embedding(s)",
+                "anytime peeks: 1",
+                "confidence (provisional vs final cosine): "
+                "first p50 0.91 last p50 0.998 over 1 slide(s)",
+                "EMBEDDING_DRIFT")
     missing = [s for s in required if s not in text]
     required_fl = ("== flight dumps ==", "reason=step_time_spike")
     missing_fl = [s for s in required_fl if s not in text_fl]

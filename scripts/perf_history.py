@@ -124,6 +124,10 @@ def cmd_ingest(args) -> int:
             history.fold_fleet(doc, _load_json(args.fleet), args.label,
                                source=os.path.basename(args.fleet),
                                force=args.force)
+        if args.drift:
+            history.fold_drift(doc, _load_json(args.drift), args.label,
+                               source=os.path.basename(args.drift),
+                               force=args.force)
         if args.prefill:
             history.fold_prefill(doc, _load_json(args.prefill), args.label,
                                  source=os.path.basename(args.prefill),
@@ -463,6 +467,49 @@ def selftest() -> int:
         render(tv, out=sys.stderr)
         return 1
 
+    # serve|drift folding (serve_smoke --drift): same shared staleness
+    # policy (CPU smoke = stale with keys), a drift-score GROWTH flips
+    # the gate, and a confidence DROP (the anytime surface got less
+    # trustworthy) flips it too
+    history.fold_drift(
+        serve_doc,
+        {"rc": 0, "parsed": {"backend": "cpu", "drift_mean_shift": 0.2,
+                             "stream_confidence_last": 0.99}}, "r01")
+    drift_points = serve_doc["entries"]["serve|drift"]["points"]
+    if not drift_points[0].get("stale") or "drift_mean_shift" not in \
+            drift_points[0]["metrics"]:
+        print("perf_history selftest FAILED: CPU drift point must be "
+              "stale WITH metric keys", file=sys.stderr)
+        return 1
+    history.fold_drift(
+        serve_doc,
+        {"rc": 0, "parsed": {"backend": "tpu", "drift_mean_shift": 0.2,
+                             "drift_tail_mass": 0.01,
+                             "stream_confidence_first": 0.90,
+                             "stream_confidence_last": 0.99}}, "r02")
+    history.fold_drift(
+        serve_doc,
+        {"rc": 0, "parsed": {"backend": "tpu", "drift_mean_shift": 2.5,
+                             "drift_tail_mass": 0.01,
+                             "stream_confidence_first": 0.90,
+                             "stream_confidence_last": 0.60}}, "r03")
+    drv = history.trend_verdict(serve_doc)
+    missing_drift = [
+        needle for needle in
+        ("serve|drift: drift_mean_shift 0.2",
+         "serve|drift: stream_confidence_last 0.99")
+        if not any(needle in line for line in drv["decision"]["regressed"])
+    ]
+    if drv["decision"]["ok"] or missing_drift:
+        print(f"perf_history selftest FAILED: serve|drift regressions "
+              f"undetected: {missing_drift}", file=sys.stderr)
+        render(drv, out=sys.stderr)
+        return 1
+    if any("drift_tail_mass" in line for line in drv["decision"]["regressed"]):
+        print("perf_history selftest FAILED: an UNCHANGED tail mass "
+              "counted as a regression", file=sys.stderr)
+        return 1
+
     # plan|autotune folding: same shared staleness policy (a CPU sweep =
     # stale with keys), a best-variant walltime regression flips the
     # gate, and a plan-hit-rate DROP (registry coverage lost) flips too
@@ -576,6 +623,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                        "(scripts/dist_smoke.py --fleet-json output) -> the "
                        "dist|trace trend entry (cross-process critical-path "
                        "shares over the merged timeline)")
+    p_ing.add_argument("--drift", default=None,
+                       help="serve_smoke --drift snapshot JSON -> the "
+                       "serve|drift trend entry (model health: drift "
+                       "scores vs baseline + anytime-confidence summary)")
     p_ing.add_argument("--prefill", default=None,
                        help="long_context_smoke --stream snapshot JSON "
                        "-> the prefill|stream trend entry "

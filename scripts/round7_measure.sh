@@ -118,7 +118,19 @@ tail -4 /tmp/r7_tile.log
 timeout 2400 python scripts/autotune.py --n 10241 --iters 12 \
   --label r07 --bless --json AUTOTUNE.json > /tmp/r7_autotune.log 2>&1
 tail -6 /tmp/r7_autotune.log
+
+# 12. model-health loop (drift sentinel + anytime confidence): baseline
+#     sketch off the streaming path, clean re-serve (zero embedding_drift
+#     anomalies), chaos-shifted serve (EXACTLY ONE, with flight dump) —
+#     both ways hard-asserted inside the smoke. The ingest folds the
+#     serve|drift trend entry (clean-phase drift scores down-good,
+#     provisional-vs-final stream confidence up-good); CPU points land
+#     stale, as everywhere else.
+timeout 1200 python scripts/serve_smoke.py --drift-slides 16 \
+  --json DRIFT_SMOKE.json > /tmp/r7_drift.log 2>&1
+tail -3 /tmp/r7_drift.log
+
 python scripts/perf_history.py ingest --label r07 --serve SERVE_SMOKE.json \
   --dist DIST_SMOKE.json --fleet FLEET_SMOKE.json \
   --prefill PREFILL_SMOKE.json \
-  --tile AB_TILE.json --plan AUTOTUNE.json || true
+  --tile AB_TILE.json --plan AUTOTUNE.json --drift DRIFT_SMOKE.json || true

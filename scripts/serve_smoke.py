@@ -447,6 +447,223 @@ def run(args) -> dict:
     return payload
 
 
+def run_drift(args) -> dict:
+    """Model-health leg (ISSUE 19), standalone with ``--drift-slides``:
+
+    A. **baseline**: ``--drift-slides`` synthetic slides through the
+       REAL streaming-prefill path (anytime peeks on); the finalized
+       embeddings build an :class:`EmbeddingSketch` baseline persisted
+       with the manifest discipline and re-loaded (round-trip must be
+       bit-exact).
+    B. **clean serve**: the same slides re-served with a
+       :class:`DriftSentinel` on the loaded baseline — zero drift by
+       construction, so the run must fire NO ``embedding_drift``
+       anomaly.
+    C. **forced drift**: a fresh sentinel whose served embeddings are
+       chaos-shifted by ``--drift-shift`` before it sees them — must
+       fire EXACTLY ONE ``embedding_drift`` anomaly with a flight dump.
+
+    The payload's ``drift_*`` keys are the CLEAN-phase scores (the
+    trendable health numbers) and ``stream_confidence_*`` summarize the
+    provisional-vs-final cosines — what ``perf_history.py ingest
+    --drift`` folds into the ``serve|drift`` entry.
+    """
+    import jax
+
+    from gigapath_tpu.models.classification_head import get_model
+    from gigapath_tpu.obs.anomaly import AnomalyConfig, attach_anomaly_engine
+    from gigapath_tpu.obs.drift import DriftSentinel, EmbeddingSketch
+    from gigapath_tpu.obs.metrics import MetricsRegistry
+    from gigapath_tpu.obs.runlog import RunLog
+    from gigapath_tpu.serve.streaming import StreamingSubmitter
+    from gigapath_tpu.utils.registry import create_model_from_registry
+
+    out_dir = args.out_dir or tempfile.mkdtemp(prefix="drift_smoke_")
+    os.makedirs(out_dir, exist_ok=True)
+    run_path = os.path.join(out_dir, "drift_run.jsonl")
+    log = RunLog(run_path, driver="drift_smoke", echo=False)
+    # closed loop armed, profiler capture disabled (CPU smoke weight)
+    attach_anomaly_engine(log, config=AnomalyConfig(capture_budget=0))
+    registry = MetricsRegistry(runlog=log, interval_s=0)
+
+    _, params = get_model(
+        input_dim=args.input_dim, latent_dim=args.latent_dim,
+        feat_layer=args.feat_layer, n_classes=args.n_classes,
+        model_arch=args.arch, dtype=None,
+    )
+    inner = create_model_from_registry(
+        args.arch, in_chans=args.input_dim, global_pool=False, dtype=None,
+    )
+    n_tiles, chunk_tiles = args.drift_tiles, args.drift_chunk_tiles
+    rng = np.random.default_rng(args.seed)
+    slides = [
+        (f"drift_{i:03d}",
+         rng.normal(size=(n_tiles, args.input_dim)).astype(np.float32),
+         rng.uniform(0, 25000, (n_tiles, 2)).astype(np.float32))
+        for i in range(args.drift_slides)
+    ]
+
+    def serve(submitter, prefix: str):
+        finals = []
+        for sid, feats, coords in slides:
+            session = submitter.open(f"{prefix}_{sid}", n_tiles)
+            for c0 in range(0, n_tiles, chunk_tiles):
+                idx = c0 // chunk_tiles
+                session.feed(idx, feats[c0:c0 + chunk_tiles],
+                             coords[c0:c0 + chunk_tiles])
+            out = session.result()
+            finals.append(np.asarray(out["last_layer_embed"],
+                                     np.float32).reshape(-1))
+        return finals
+
+    payload: dict = {
+        "metric": "drift_smoke",
+        "backend": jax.default_backend(),
+        "jax_version": jax.__version__,
+        "arch": args.arch,
+        "drift_slides": len(slides),
+        "drift_tiles": n_tiles,
+        "chunk_tiles": chunk_tiles,
+        "obs": run_path,
+    }
+
+    # -- phase A: baseline sketch off the real streaming path -------------
+    base_sub = StreamingSubmitter(
+        inner, params["slide_encoder"], chunk_tiles=chunk_tiles,
+        runlog=log, peek_every=args.drift_peek_every, metrics=registry,
+    )
+    finals = serve(base_sub, "base")
+    dim = finals[0].shape[0]
+    baseline = EmbeddingSketch(dim)
+    for emb in finals:
+        baseline.update(emb)
+    sketch_dir = os.path.join(out_dir, "drift_baseline")
+    baseline.save(sketch_dir)
+    loaded = EmbeddingSketch.load(sketch_dir)
+    if (loaded.count != baseline.count
+            or not np.array_equal(loaded.mean, baseline.mean)
+            or not np.array_equal(loaded.m2, baseline.m2)
+            or not np.array_equal(loaded.hist, baseline.hist)):
+        raise AssertionError(
+            f"baseline sketch save/load round-trip not bit-exact "
+            f"({sketch_dir})"
+        )
+    payload.update(embedding_dim=dim, baseline_sketch=sketch_dir,
+                   baseline_count=loaded.count)
+
+    # -- phase B: clean serve — same slides, zero drift, no anomaly -------
+    every = max(2, len(slides) // 2)
+    sentinel = DriftSentinel(
+        loaded, log, metrics=registry, every=every,
+        threshold=args.drift_threshold, min_count=every,
+        name="serve.drift",
+    )
+    clean_sub = StreamingSubmitter(
+        inner, params["slide_encoder"], chunk_tiles=chunk_tiles,
+        runlog=log, drift=sentinel, peek_every=args.drift_peek_every,
+        metrics=registry,
+    )
+    serve(clean_sub, "clean")
+    if sentinel.alarming:
+        raise AssertionError(
+            f"clean re-serve alarmed the drift sentinel "
+            f"(scores {sentinel.scores})"
+        )
+    sentinel.emit_status(reason="clean")
+    clean_scores = sentinel.scores or {}
+    payload.update(
+        drift_mean_shift=clean_scores.get("mean_shift"),
+        drift_cosine_dist=clean_scores.get("cosine_dist"),
+        drift_tail_mass=clean_scores.get("tail_mass"),
+        drift_threshold=sentinel.threshold,
+    )
+
+    # -- phase C: forced drift — chaos-shifted embeddings, ONE anomaly ----
+    forced = DriftSentinel(
+        EmbeddingSketch.load(sketch_dir), log, metrics=registry,
+        every=every, threshold=args.drift_threshold, min_count=every,
+        name="serve.drift.forced",
+    )
+
+    class _ChaosShift:
+        """The injection point: the REAL result() wiring feeds the
+        sentinel, this shim shifts what it sees."""
+
+        def observe(self, emb):
+            return forced.observe(
+                np.asarray(emb, np.float64) + args.drift_shift
+            )
+
+    forced_sub = StreamingSubmitter(
+        inner, params["slide_encoder"], chunk_tiles=chunk_tiles,
+        runlog=log, drift=_ChaosShift(),
+        peek_every=args.drift_peek_every, metrics=registry,
+    )
+    serve(forced_sub, "forced")
+    if not forced.alarming:
+        raise AssertionError(
+            f"chaos shift {args.drift_shift} failed to alarm the "
+            f"sentinel (scores {forced.scores})"
+        )
+    forced.emit_status(reason="forced")
+    payload["forced_mean_shift"] = (forced.scores or {}).get("mean_shift")
+
+    registry.flush(reason="final")
+    log.run_end(status="ok")
+
+    # -- the both-ways anomaly contract off the run artifact --------------
+    drift_anomalies = []
+    confidence_first: List[float] = []
+    confidence_last: List[float] = []
+    peeks = 0
+    with open(run_path, encoding="utf-8") as fh:
+        for line in fh:
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            kind = ev.get("kind")
+            if kind == "anomaly" and ev.get("detector") == "embedding_drift":
+                drift_anomalies.append(ev)
+            elif kind == "stream_peek":
+                peeks += 1
+            elif kind == "stream_result":
+                if ev.get("confidence_first") is not None:
+                    confidence_first.append(float(ev["confidence_first"]))
+                if ev.get("confidence_last") is not None:
+                    confidence_last.append(float(ev["confidence_last"]))
+    payload["embedding_drift_anomalies"] = len(drift_anomalies)
+    if len(drift_anomalies) != 1:
+        raise AssertionError(
+            f"want exactly 1 embedding_drift anomaly (the forced leg), "
+            f"got {len(drift_anomalies)} — clean legs must stay silent"
+        )
+    anomaly = drift_anomalies[0]
+    if anomaly.get("name") != "serve.drift.forced":
+        raise AssertionError(
+            f"the anomaly fired on sentinel '{anomaly.get('name')}', "
+            "not the chaos-shifted one"
+        )
+    if not anomaly.get("flight"):
+        raise AssertionError("embedding_drift anomaly took no flight dump")
+    payload["drift_flight"] = anomaly["flight"]
+    if args.drift_peek_every > 0:
+        if not peeks:
+            raise AssertionError("peek cadence on but no stream_peek events")
+        if not confidence_last:
+            raise AssertionError(
+                "peeked serves recorded no provisional-vs-final confidence"
+            )
+        confidence_first.sort()
+        confidence_last.sort()
+        payload.update(
+            stream_peeks=peeks,
+            stream_confidence_first=percentile(confidence_first, 0.50),
+            stream_confidence_last=percentile(confidence_last, 0.50),
+        )
+    return payload
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python scripts/serve_smoke.py",
@@ -486,6 +703,26 @@ def main(argv=None) -> int:
                     "must fire exactly one slo_burn anomaly (flight "
                     "dump + profiler capture); combine with "
                     "--slo-target-s")
+    ap.add_argument("--drift-slides", type=int, default=0,
+                    help="model-health leg (replaces the serve phases): "
+                    "this many slides through the streaming path three "
+                    "times — baseline sketch, clean re-serve (no "
+                    "anomaly), chaos-shifted serve (exactly one "
+                    "embedding_drift anomaly)")
+    ap.add_argument("--drift-shift", type=float, default=8.0,
+                    help="per-dim chaos shift applied to the forced "
+                    "leg's served embeddings before the sentinel sees "
+                    "them")
+    ap.add_argument("--drift-threshold", type=float, default=4.0,
+                    help="DriftSentinel mean-shift threshold (in "
+                    "baseline standard deviations)")
+    ap.add_argument("--drift-tiles", type=int, default=32,
+                    help="tiles per drift-leg slide")
+    ap.add_argument("--drift-chunk-tiles", type=int, default=8,
+                    help="streaming chunk size for the drift leg")
+    ap.add_argument("--drift-peek-every", type=int, default=2,
+                    help="anytime-peek cadence (folded chunks) for the "
+                    "drift leg; 0 = no peeks")
     ap.add_argument("--json", default=None, help="also write the payload here")
     args = ap.parse_args(argv)
     if args.slow_dispatch_s > 0 and args.slo_target_s <= 0:
@@ -496,11 +733,13 @@ def main(argv=None) -> int:
                  "forced-slow run exists to fire the SLO burn detector)")
 
     try:
-        payload = run(args)
+        payload = run_drift(args) if args.drift_slides > 0 else run(args)
         payload["rc"] = 0
     except Exception as e:
         payload = {
-            "metric": "serve_smoke", "rc": 1,
+            "metric": "drift_smoke" if args.drift_slides > 0
+            else "serve_smoke",
+            "rc": 1,
             "error": f"{type(e).__name__}: {e}",
         }
     line = json.dumps(payload, sort_keys=True)

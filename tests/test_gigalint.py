@@ -51,7 +51,8 @@ def test_fixture_tree_fires_every_rule_class():
     fired = {f.rule for f in result.findings}
     expected = {"GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
                 "GL007", "GL008", "GL009", "GL010", "GL011", "GL012",
-                "GL013", "GL014", "GL015", "GL016", "GL017", "GL022"}
+                "GL013", "GL014", "GL015", "GL016", "GL017", "GL022",
+                "GL023"}
     assert fired >= expected, (
         f"missing rule classes: {sorted(expected - fired)}"
     )
@@ -161,6 +162,12 @@ def test_fixture_specific_findings():
         # an explicit trace=None both fall out of the fleet timeline
         ("GL022", "worker.py", "untraced_encode_span"),
         ("GL022", "worker.py", "untraced_none_span"),
+        # hand-rolled running-moment accumulators (Welford triple by
+        # hand) outside obs/ (the sketch-routed path, the mean-only
+        # loop and the count-plus-product loop are the negative
+        # controls)
+        ("GL023", "moments.py", "running_moments_by_hand"),
+        ("GL023", "moments.py", "MomentTracker.observe"),
     }
     assert expected <= got, f"missing: {sorted(expected - got)}"
 

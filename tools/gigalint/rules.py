@@ -11,7 +11,7 @@ import ast
 import dataclasses
 import os
 import re
-from typing import Callable, Dict, List, Optional, Set
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from tools.gigalint.astutils import (
     dotted_name,
@@ -1562,4 +1562,124 @@ def check_untraced_dist_spans(project: Project) -> List[Finding]:
                 "the cross-process causality checks cannot see it. "
                 "Thread the slide's TraceContext: span(..., trace=ctx)",
             ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# GL023 — hand-rolled running-moment accumulators
+# ---------------------------------------------------------------------------
+
+# The pattern: a Welford-style running-moment update written by hand in
+# library code — a sample count bumped by one, a mean nudged by
+# ``delta / count``, and a squared-delta sum (M2 / variance numerator)
+# accumulated in the SAME function. Hand-rolled copies drift on the
+# merge rule (Chan's cross term is easy to get wrong), cannot be
+# combined across shards, and have no save/load discipline. Time- or
+# batch-series moments in library code must go through
+# gigapath_tpu/obs — EmbeddingSketch (count/mean/M2 + merge +
+# manifest-verified artifacts) or the metrics registry. The obs/
+# segment itself is sanctioned (it IS the accumulator layer), matched
+# by path segment so fixture trees can carry their own obs/ twin as a
+# negative control; scripts, tests and demos render one-shot reports
+# and are exempt.
+_GL023_EXEMPT_SEGMENTS = frozenset({"scripts", "tests", "demo"})
+_GL023_SANCTIONED_SEGMENT = "obs"
+
+
+def _gl023_scan_function(mod, fn) -> Optional[Finding]:
+    """One GL023 verdict per function: the Welford triple — a count
+    bumped by one, a mean updated via a division by that count, and a
+    product-of-deltas accumulation — co-occurring in one function is a
+    hand-rolled running-moment accumulator."""
+
+    def owner(node: ast.AST) -> Optional[str]:
+        name = dotted_name(node)
+        return name or None
+
+    def self_add(node: ast.AST) -> Optional[Tuple[str, ast.AST]]:
+        """``x += expr`` or ``x = x + expr`` -> (owner, added expr)."""
+        if isinstance(node, ast.AugAssign) and isinstance(node.op, ast.Add):
+            tgt = owner(node.target)
+            if tgt:
+                return tgt, node.value
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.value, ast.BinOp)
+                and isinstance(node.value.op, ast.Add)):
+            tgt = owner(node.targets[0])
+            if tgt and owner(node.value.left) == tgt:
+                return tgt, node.value.right
+            if tgt and owner(node.value.right) == tgt:
+                return tgt, node.value.left
+        return None
+
+    # pass 1: sample counters (n += 1 / self._n = self._n + 1)
+    counts: Set[str] = set()
+    for node in ast.walk(fn.node):
+        bump = self_add(node)
+        if (bump is not None and isinstance(bump[1], ast.Constant)
+                and bump[1].value == 1):
+            counts.add(bump[0])
+    if not counts:
+        return None
+
+    # pass 2: a mean update — any assignment whose value divides by one
+    # of the counters (mean += delta / n, or Chan's merged-mean form)
+    mean_line: Optional[int] = None
+    for node in ast.walk(fn.node):
+        if not isinstance(node, (ast.Assign, ast.AugAssign)):
+            continue
+        for sub in ast.walk(node.value):
+            if (isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.Div)
+                    and owner(sub.right) in counts):
+                mean_line = mean_line or node.lineno
+    if mean_line is None:
+        return None
+
+    # pass 3: the second-moment accumulation — a self-add (to a target
+    # that is not the counter) of a product of two non-constant factors
+    # (delta * delta2 / delta**2-shaped cross terms)
+    for node in ast.walk(fn.node):
+        acc = self_add(node)
+        if acc is None or acc[0] in counts:
+            continue
+        for sub in ast.walk(acc[1]):
+            if (isinstance(sub, ast.BinOp)
+                    and isinstance(sub.op, (ast.Mult, ast.Pow))
+                    and not isinstance(sub.left, ast.Constant)
+                    and not isinstance(sub.right, ast.Constant)):
+                return Finding(
+                    "GL023", mod.path, node.lineno, fn.qualname,
+                    f"hand-rolled running-moment accumulator: a sample "
+                    f"count, a mean update dividing by it (line "
+                    f"{mean_line}), and a squared-delta accumulation "
+                    f"into '{acc[0]}' in one function. Library code "
+                    "must accumulate moments through gigapath_tpu.obs "
+                    "— EmbeddingSketch (mergeable count/mean/M2 with "
+                    "manifest-verified save/load) or the metrics "
+                    "registry — not a by-hand Welford loop",
+                )
+    return None
+
+
+@register(
+    "GL023",
+    "hand-rolled running-moment accumulator in library code: count bump + "
+    "mean-update-by-count + squared-delta sum in one function — use "
+    "gigapath_tpu.obs (EmbeddingSketch / metrics registry) instead; "
+    "scripts, tests, demos and obs/ itself exempt",
+)
+def check_running_moments(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.modules.values():
+        segments = mod.path.split("/")[:-1]
+        if mod.is_test_file or any(
+            s in _GL023_EXEMPT_SEGMENTS for s in segments
+        ):
+            continue
+        if _GL023_SANCTIONED_SEGMENT in segments:
+            continue  # the accumulator layer may accumulate
+        for fn in mod.functions.values():
+            finding = _gl023_scan_function(mod, fn)
+            if finding is not None:
+                findings.append(finding)
     return findings

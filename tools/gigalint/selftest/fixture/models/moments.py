@@ -1,0 +1,66 @@
+"""Seeded GL023 violations: hand-rolled running-moment accumulators in
+library-looking code (the Welford triple — count bump, mean update via
+delta/count, squared-delta M2 sum — written out by hand), plus negative
+controls the rule must NOT flag."""
+
+
+def running_moments_by_hand(samples):
+    """SEEDED GL023: the textbook Welford loop — the exact accumulator
+    obs.EmbeddingSketch replaces (and makes mergeable)."""
+    count = 0
+    mean = 0.0
+    m2 = 0.0
+    for x in samples:
+        count += 1
+        delta = x - mean
+        mean += delta / count
+        delta2 = x - mean
+        m2 += delta * delta2
+    return mean, m2 / max(count, 1)
+
+
+class MomentTracker:
+    """SEEDED GL023 (attribute-owned state): the batch-series shape —
+    moments accumulated on self across observe() calls."""
+
+    def __init__(self):
+        self._n = 0
+        self._mean = 0.0
+        self._m2 = 0.0
+
+    def observe(self, value):
+        self._n = self._n + 1
+        delta = value - self._mean
+        self._mean += delta / self._n
+        self._m2 += delta * (value - self._mean)
+        return self._mean
+
+
+def negative_control_sketch_path(samples, sketch):
+    """Moments routed through the sanctioned accumulator — no by-hand
+    triple, no finding."""
+    for x in samples:
+        sketch.update(x)
+    return sketch.std()
+
+
+def negative_control_running_mean_only(samples):
+    """A running MEAN alone (count + delta/count, no second moment) is
+    not the pattern — flagging it would outlaw every moving average."""
+    count = 0
+    mean = 0.0
+    for x in samples:
+        count += 1
+        mean += (x - mean) / count
+    return mean
+
+
+def negative_control_count_and_product(samples):
+    """A counter next to an unrelated product accumulation (no mean
+    divided by the count) is not a moment accumulator."""
+    count = 0
+    energy = 0.0
+    for x in samples:
+        count += 1
+        energy += x * x
+    return energy, count

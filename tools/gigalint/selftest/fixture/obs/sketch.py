@@ -1,0 +1,19 @@
+"""GL023 negative control: the fixture tree's own obs/ accumulator.
+
+The sanctioned moment layer is exactly where the Welford triple is
+legitimate (``gigapath_tpu/obs/drift.py``'s ``EmbeddingSketch`` owns
+the count/mean/M2 contract) — modules under an ``obs/`` segment are
+exempt by path, so this full by-hand triple must NOT fire.
+"""
+
+
+def negative_control_sanctioned_welford(values):
+    count = 0
+    mean = 0.0
+    m2 = 0.0
+    for v in values:
+        count += 1
+        delta = v - mean
+        mean += delta / count
+        m2 += delta * (v - mean)
+    return count, mean, m2
