@@ -246,6 +246,20 @@ class StreamingEncoderSession:
         self.eps = float(cfg.layernorm_eps)
         self.subln = bool(cfg.subln)
         self.depth = int(cfg.encoder_layers)
+        # THE fold plan resolution — once per session, never per chunk
+        # or per fold (the registry stat test pins lookups == 1). The
+        # geometry key is one fold pair's q/k/v block avals, so every
+        # session sharing a chunk geometry shares the blessed entry;
+        # the resolved PipelineFlags ride every fold call as a static
+        # arg. Empty registry -> snapshot_flags() -> flags-default
+        # dispatch, byte-identical to the pre-plan jnp fold.
+        from gigapath_tpu.plan.executionplan import resolve_plan
+
+        head_dim = int(self.model.embed_dim) // self.num_heads
+        blk = jax.ShapeDtypeStruct(
+            (1, self.chunk_tiles, self.num_heads, head_dim), self.dtype
+        )
+        self.fold_flags = resolve_plan("stream_fold", (blk, blk, blk))
 
         self._embed_fn = jax.jit(
             _embed_block,
@@ -272,16 +286,21 @@ class StreamingEncoderSession:
                 "stream.post", runlog).wrap(self._post_fn)
 
             def fold_key(*args, **kwargs):
-                # the fold's branch geometry is a STATIC kwarg: without
-                # it in the key, the second branch's legitimate compile
+                # the fold's branch geometry AND resolved flags are
+                # STATIC kwargs: without them in the key, the second
+                # branch's (or the plan-on path's) legitimate compile
                 # would be flagged as a retrace of the first's
                 return tuple(
                     (tuple(a.shape), str(a.dtype))
                     for a in args if hasattr(a, "shape")
-                ) + (kwargs.get("segment_len"), kwargs.get("ratio"))
+                ) + (kwargs.get("segment_len"), kwargs.get("ratio"),
+                     kwargs.get("flags"))
 
             self._fold_fn = CompileWatchdog("stream.fold", runlog).wrap(
-                jax.jit(fold_pair, static_argnames=("segment_len", "ratio")),
+                jax.jit(
+                    fold_pair,
+                    static_argnames=("segment_len", "ratio", "flags"),
+                ),
                 key_fn=fold_key,
             )
         self._h_blocks: List[Optional[jnp.ndarray]] = (
@@ -300,6 +319,7 @@ class StreamingEncoderSession:
         return StreamingPrefillState(
             self.token_bounds, self.segment_lengths, self.dilated_ratios,
             valid_len=self.valid_tokens, fold_fn=self._fold_fn,
+            flags=self.fold_flags,
         )
 
     def _layer_params(self, depth: int):
@@ -531,6 +551,7 @@ class StreamingEncoderSession:
             self.token_bounds[:n_blocks], self.segment_lengths,
             self.dilated_ratios, total_len=self.token_bounds[-1][1],
             valid_len=valid_len, fold_fn=self._fold_fn,
+            flags=self.fold_flags,
         )
 
     def peek(self) -> List[jnp.ndarray]:

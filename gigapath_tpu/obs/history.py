@@ -442,6 +442,29 @@ def fold_plan(doc: dict, snapshot: dict, label: str,
     )
 
 
+# fold-surface sweep payload fields worth trending
+# (scripts/autotune.py --surface fold): the blessed fold step's wall
+# next to the jnp default's (the per-pair A/B), plus the same registry
+# hit-rate / coverage counters as the dilated sweep
+_FOLD_SWEEP_METRICS = (
+    "best_wall_s", "default_wall_s", "plan_hit_rate",
+    "candidates", "gates_passed", "blessed",
+)
+
+
+def fold_autotune(doc: dict, snapshot: dict, label: str,
+                  source: Optional[str] = None, force: bool = False) -> dict:
+    """One fold-surface ``autotune`` JSON (``--surface fold``) -> one
+    point under ``plan|sweep``. Same shared CPU-stale-with-keys policy:
+    a CPU sweep lands STALE carrying the metric keys (and may bless
+    memory-motivated fold plans); only an on-chip sweep's fold-step
+    walltimes (``*wall_s`` — down-good) move the trend."""
+    return _fold_serve_snapshot(
+        doc, snapshot, label, key="plan|sweep",
+        metric_keys=_FOLD_SWEEP_METRICS, source=source, force=force,
+    )
+
+
 def fold_multichip(doc: dict, snapshot: dict, label: str,
                    source: Optional[str] = None, force: bool = False) -> dict:
     metrics = {
@@ -479,6 +502,11 @@ def _flatten_ledger_entry(entry: dict) -> Dict[str, float]:
         # recorded, not direction-gated: the quant eqn count changes
         # legitimately with the tier flag; ledger_diff pins it per-key
         metrics["jaxpr.quant"] = num
+    num = _finite_number(jaxpr.get("mask"))
+    if num is not None:
+        # same policy as quant: the square-bool mask eqn count is a
+        # per-key pin (0 for the Pallas fold tier), not a trend slope
+        metrics["jaxpr.mask"] = num
     return metrics
 
 

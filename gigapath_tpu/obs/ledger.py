@@ -74,22 +74,46 @@ def _eqn_is_quant(eqn) -> bool:
     return False
 
 
+def _eqn_is_mask(eqn) -> bool:
+    """Does an equation PRODUCE a dense square boolean mask — a bool
+    aval whose two trailing dims are equal and > 1? The ``mask``
+    fingerprint column: the jnp streaming fold materializes per-pair
+    ``[.., C, C]`` segment/phase/validity masks (pure O(C^2) traffic),
+    while the Pallas fold tier computes the same predicates in-kernel
+    from iota comparisons and must show ZERO such eqns — the golden
+    ledger pins both sides of that A/B, and a mask count creeping back
+    into a kernel path is exactly the regression this column flags."""
+    for var in eqn.outvars:
+        aval = getattr(var, "aval", None)
+        if str(getattr(aval, "dtype", "")) != "bool":
+            continue
+        shape = tuple(getattr(aval, "shape", ()) or ())
+        if len(shape) >= 2 and shape[-1] == shape[-2] and shape[-1] > 1:
+            return True
+    return False
+
+
 def _count_eqns(jaxpr, counts: Dict[str, int],
-                qbox: Optional[List[int]] = None) -> None:
+                qbox: Optional[List[int]] = None,
+                mbox: Optional[List[int]] = None) -> None:
     """Recursive primitive histogram over a jaxpr and every sub-jaxpr
     (pjit bodies, custom_vjp calls, scan/cond branches, pallas_call).
-    ``qbox`` (a 1-element list) additionally accumulates the
-    low-precision eqn count for the ``quant`` column."""
+    ``qbox``/``mbox`` (1-element lists) additionally accumulate the
+    low-precision and square-bool-mask eqn counts for the ``quant`` /
+    ``mask`` columns."""
     for eqn in jaxpr.eqns:
         counts[eqn.primitive.name] = counts.get(eqn.primitive.name, 0) + 1
         if qbox is not None and _eqn_is_quant(eqn):
             qbox[0] += 1
+        if mbox is not None and _eqn_is_mask(eqn):
+            mbox[0] += 1
         for val in eqn.params.values():
             for item in val if isinstance(val, (list, tuple)) else (val,):
                 sub = getattr(item, "jaxpr", None)
                 if sub is not None:
                     # ClosedJaxpr has .jaxpr.eqns; Jaxpr has .eqns
-                    _count_eqns(getattr(sub, "jaxpr", sub), counts, qbox)
+                    _count_eqns(getattr(sub, "jaxpr", sub), counts, qbox,
+                                mbox)
                 elif hasattr(item, "eqns") and eqn.primitive.name != "pallas_call":
                     # a RAW Jaxpr param (shard_map bodies ride as one):
                     # without this arm the whole sharded program would
@@ -97,28 +121,32 @@ def _count_eqns(jaxpr, counts: Dict[str, int],
                     # kernel bodies stay opaque on purpose — the KERNEL
                     # COUNT is the round-6 column's signal; Mosaic
                     # kernel-internal ops are not XLA glue
-                    _count_eqns(item, counts, qbox)
+                    _count_eqns(item, counts, qbox, mbox)
 
 
 def jaxpr_fingerprint(fn, *args, **kwargs) -> Dict[str, Any]:
     """Eqn counts by primitive for ``fn(*args, **kwargs)``'s traced
-    program: ``{"eqns_total": N, "quant": Q, "primitives": {name:
-    count}}`` with the :data:`FINGERPRINT_COLUMNS` always present and
-    ``quant`` the count of eqns touching int8/float8 avals (the
-    quantized-tier op-mix pin — NOT a primitive, so it never feeds
-    ``eqns_total``). One extra trace, no compile. ``fn`` may be jitted
-    or plain."""
+    program: ``{"eqns_total": N, "quant": Q, "mask": M, "primitives":
+    {name: count}}`` with the :data:`FINGERPRINT_COLUMNS` always
+    present, ``quant`` the count of eqns touching int8/float8 avals
+    (the quantized-tier op-mix pin) and ``mask`` the count of eqns
+    producing dense square boolean masks (the streaming-fold
+    mask-materialization pin) — neither is a primitive, so neither
+    feeds ``eqns_total``. One extra trace, no compile. ``fn`` may be
+    jitted or plain."""
     import jax
 
     closed = jax.make_jaxpr(fn)(*args, **kwargs)
     counts: Dict[str, int] = {}
     qbox = [0]
-    _count_eqns(closed.jaxpr, counts, qbox)
+    mbox = [0]
+    _count_eqns(closed.jaxpr, counts, qbox, mbox)
     for col in FINGERPRINT_COLUMNS:
         counts.setdefault(col, 0)
     return {
         "eqns_total": int(sum(counts.values())),
         "quant": int(qbox[0]),
+        "mask": int(mbox[0]),
         "primitives": {k: int(v) for k, v in sorted(counts.items())},
     }
 

@@ -874,6 +874,18 @@ class PipelineFlags(NamedTuple):
     # only resolve_plan fills it, so an empty tuple keeps dispatch
     # byte-identical to the flag-only behavior
     branch_plans: Tuple[Tuple[int, int, str, int], ...] = ()
+    # Pallas tier for the streaming-fold pair partial
+    # (ops/pallas_streaming.py): in-kernel iota masks instead of the jnp
+    # oracle's dense [H, cq, ck] mask tensors. False keeps the fold
+    # byte-identical to the jnp path (the parity oracle)
+    fold_pallas: bool = False
+    # global fold block overrides (None: DEFAULT_FOLD_BLOCK auto choice)
+    fold_block_q: Optional[int] = None
+    fold_block_k: Optional[int] = None
+    # per-fold-branch-class plan entries: (segment_length, ratio,
+    # block_q, block_k), 0 = auto. Plan-only data like branch_plans:
+    # only resolve_plan fills it
+    fold_branches: Tuple[Tuple[int, int, int, int], ...] = ()
 
 
 # field -> environment twin: the one mapping the plan resolver
@@ -893,6 +905,9 @@ FLAG_ENV = {
     "chunked_prefill": "GIGAPATH_CHUNKED_PREFILL",
     "quant_tile": "GIGAPATH_QUANT_TILE",
     "quant_pallas": "GIGAPATH_QUANT_PALLAS",
+    "fold_pallas": "GIGAPATH_FOLD_PALLAS",
+    "fold_block_q": "GIGAPATH_FOLD_BLOCK_Q",
+    "fold_block_k": "GIGAPATH_FOLD_BLOCK_K",
 }
 
 
@@ -900,8 +915,9 @@ def snapshot_flags() -> PipelineFlags:
     """Read GIGAPATH_PIPELINED_ATTN/_BWD, GIGAPATH_PIPE(_BWD)_BLOCK_K,
     GIGAPATH_PACK_DIRECT, GIGAPATH_STREAM_FUSION,
     GIGAPATH_STREAMING_FUSION, GIGAPATH_RING_ATTN,
-    GIGAPATH_CHUNKED_PREFILL, GIGAPATH_QUANT_TILE and
-    GIGAPATH_QUANT_PALLAS from the environment, once."""
+    GIGAPATH_CHUNKED_PREFILL, GIGAPATH_QUANT_TILE,
+    GIGAPATH_QUANT_PALLAS, GIGAPATH_FOLD_PALLAS and
+    GIGAPATH_FOLD_BLOCK_Q/_K from the environment, once."""
     import os
 
     from gigapath_tpu.ops.common import env_flag
@@ -926,6 +942,9 @@ def snapshot_flags() -> PipelineFlags:
         quant_tile=normalize_mode(_str("GIGAPATH_QUANT_TILE")),
         quant_pallas=env_flag("GIGAPATH_QUANT_PALLAS"),
         streaming_fusion=env_flag("GIGAPATH_STREAMING_FUSION"),
+        fold_pallas=env_flag("GIGAPATH_FOLD_PALLAS"),
+        fold_block_q=_int("GIGAPATH_FOLD_BLOCK_Q"),
+        fold_block_k=_int("GIGAPATH_FOLD_BLOCK_K"),
     )
 
 

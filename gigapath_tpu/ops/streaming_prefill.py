@@ -141,6 +141,7 @@ def pair_partial_attention(
     segment_len: int,
     ratio: int,
     valid_len=None,
+    flags=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Chunk-normalized ``(out [B,cq,H,D], lse [B,H,cq])`` of one dilated
     branch restricted to one resident key chunk — the ingest-axis twin of
@@ -157,7 +158,31 @@ def pair_partial_attention(
     mirroring ``sparse_to_dense``'s uncovered-position contract.
     ``valid_len`` (optional dynamic scalar) masks keys at global
     positions >= it — the ragged/padded tail.
+
+    ``flags``: a resolved ``PipelineFlags`` carrier (or None). With
+    ``flags.fold_pallas`` the pair runs the Pallas tier
+    (:mod:`gigapath_tpu.ops.pallas_streaming` — masks computed in-kernel
+    from iota comparisons, no dense ``[H, cq, ck]`` mask tensor ever
+    materialized); otherwise this jnp formulation below IS the dispatch
+    — byte-identical to the pre-plan behavior and the parity oracle the
+    Pallas tier is tested against. Fully-masked rows carry a
+    large-negative lse SENTINEL in both tiers (~ -1e8 here, ~ -7e19 in
+    the kernel's underflow discipline); downstream combines weight
+    either to exactly 0.
     """
+    if flags is not None and getattr(flags, "fold_pallas", False):
+        from gigapath_tpu.ops.pallas_streaming import (
+            fold_blocks,
+            pallas_pair_partial,
+        )
+
+        bq, bk = fold_blocks(flags, segment_len, ratio)
+        return pallas_pair_partial(
+            q_blk, k_blk, v_blk, q0, k0,
+            segment_len=segment_len, ratio=ratio, valid_len=valid_len,
+            block_q=bq, block_k=bk,
+            interpret=jax.default_backend() != "tpu",
+        )
     B, cq, H, Dh = q_blk.shape
     ck = k_blk.shape[1]
     scale = Dh ** -0.5
@@ -199,16 +224,21 @@ def fold_pair(
     *,
     segment_len: int,
     ratio: int,
+    flags=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """One fold step: the pair's partial merged into the running branch
     accumulator via the stored-LSE combine. ``acc_out`` stays fp32 end
     to end (``combine_partials`` returns ``out_a``'s dtype). This is the
     whole per-chunk streaming executable — its arguments and
     temporaries are all O(chunk), never O(L), which is what the XLA
-    memory-analysis pins and the jaxpr guard assert."""
+    memory-analysis pins and the jaxpr guard assert. ``flags`` (a
+    resolved ``PipelineFlags`` carrier or None, static under jit —
+    NamedTuples hash, so plan on-vs-off lands distinct jit cache
+    entries) selects the pair tier; None is the plain jnp path."""
     o, l = pair_partial_attention(
         q_blk, k_blk, v_blk, q0, k0,
         segment_len=segment_len, ratio=ratio, valid_len=valid_len,
+        flags=flags,
     )
     return combine_partials(acc_out, acc_lse, o, l)
 
@@ -277,11 +307,15 @@ class StreamingPrefillState:
         valid_len=None,
         jit_pairs: bool = True,
         fold_fn=None,
+        flags=None,
     ):
         """``fold_fn``: optional override for the per-pair fold callable
         (signature of :func:`fold_pair`) — how callers instrument the
         fold executable (e.g. a ``CompileWatchdog.wrap`` so retraces
-        land on the obs bus); default is the plain jitted fold."""
+        land on the obs bus); default is the plain jitted fold.
+        ``flags``: resolved ``PipelineFlags`` (or None) threaded into
+        every fold call as a static arg — callers resolve the plan ONCE
+        (per session/geometry), never per chunk."""
         self.bounds = tuple((int(a), int(b)) for a, b in bounds)
         assert self.bounds and all(a < b for a, b in self.bounds)
         self.total_len = int(total_len or self.bounds[-1][1])
@@ -302,11 +336,15 @@ class StreamingPrefillState:
             [None] * n for _ in self.branches
         ]
         self._next = 0
+        self._flags = flags
         if fold_fn is not None:
             self._fold_fn = fold_fn
         else:
             self._fold_fn = (
-                jax.jit(fold_pair, static_argnames=("segment_len", "ratio"))
+                jax.jit(
+                    fold_pair,
+                    static_argnames=("segment_len", "ratio", "flags"),
+                )
                 if jit_pairs else fold_pair
             )
         self.folds = 0  # fold-count telemetry for the obs/smoke layers
@@ -356,7 +394,7 @@ class StreamingPrefillState:
             acc[0], acc[1], q_blk, k_blk, v_blk,
             jnp.int32(self.bounds[qi][0]), jnp.int32(self.bounds[kj][0]),
             jnp.int32(valid),
-            segment_len=g, ratio=r,
+            segment_len=g, ratio=r, flags=self._flags,
         )
         self.folds += 1
 
@@ -542,6 +580,7 @@ def streaming_dilated_attention(
     total_len: Optional[int] = None,
     valid_len=None,
     jit_pairs: bool = True,
+    flags=None,
 ) -> List[jnp.ndarray]:
     """Drive a :class:`StreamingPrefillState` over in-memory blocks —
     the pure-function surface the parity tests and the smoke A/B use
@@ -550,6 +589,7 @@ def streaming_dilated_attention(
     state = StreamingPrefillState(
         bounds, segment_lengths, dilated_ratios,
         total_len=total_len, valid_len=valid_len, jit_pairs=jit_pairs,
+        flags=flags,
     )
     for i, (q, k, v) in enumerate(zip(q_blocks, k_blocks, v_blocks)):
         state.ingest(i, q, k, v)

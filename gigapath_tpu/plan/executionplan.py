@@ -67,6 +67,10 @@ class ExecutionPlan(NamedTuple):
     ``fusion``: cross-branch combine class — ``"stream"`` = the packed
     streaming epilogue, ``"streaming"`` = the online dense branch fold,
     ``"dense"`` = explicitly pin the stacked dense fusion.
+    ``fold_branches``: per streaming-fold branch class
+    ``(segment_length, ratio, block_q, block_k)`` — Pallas block sizes
+    for the chunk-pair fold kernel (0 = the auto choice); plan-only,
+    like ``branches``.
     """
 
     branches: Tuple[Tuple[int, int, str, int], ...] = ()
@@ -80,6 +84,10 @@ class ExecutionPlan(NamedTuple):
     chunked_prefill: Optional[bool] = None
     quant_tile: Optional[str] = None
     quant_pallas: Optional[bool] = None
+    fold_pallas: Optional[bool] = None
+    fold_block_q: Optional[int] = None
+    fold_block_k: Optional[int] = None
+    fold_branches: Tuple[Tuple[int, int, int, int], ...] = ()
 
     def as_dict(self) -> Dict[str, Any]:
         """Registry serialization: only fields with an opinion."""
@@ -88,6 +96,11 @@ class ExecutionPlan(NamedTuple):
             doc["branches"] = [
                 [int(sl), int(r), str(v), int(b)]
                 for sl, r, v, b in self.branches
+            ]
+        if self.fold_branches:
+            doc["fold_branches"] = [
+                [int(sl), int(r), int(bq), int(bk)]
+                for sl, r, bq, bk in self.fold_branches
             ]
         if self.fusion:
             doc["fusion"] = str(self.fusion)
@@ -109,13 +122,18 @@ class ExecutionPlan(NamedTuple):
             if variant not in BRANCH_VARIANTS:
                 raise ValueError(f"unknown branch variant {variant!r}")
             branches.append((int(sl), int(r), variant, int(block)))
+        fold_branches = tuple(
+            (int(sl), int(r), int(bq), int(bk))
+            for sl, r, bq, bk in doc.get("fold_branches", ()) or ()
+        )
         fusion = str(doc.get("fusion", "") or "")
         if fusion not in FUSION_CLASSES:
             raise ValueError(f"unknown fusion class {fusion!r}")
         kwargs: Dict[str, Any] = {}
         for field in _SCALAR_PLAN_FIELDS:
             if field in doc and doc[field] is not None:
-                if field in ("pipe_block_k", "pipe_bwd_block_k"):
+                if field in ("pipe_block_k", "pipe_bwd_block_k",
+                             "fold_block_q", "fold_block_k"):
                     kwargs[field] = int(doc[field])
                 elif field == "quant_tile":
                     # validate the tier spelling HERE so a digest-valid
@@ -128,13 +146,14 @@ class ExecutionPlan(NamedTuple):
                     kwargs[field] = normalize_mode(str(doc[field]))
                 else:
                     kwargs[field] = bool(doc[field])
-        return cls(branches=tuple(branches), fusion=fusion, **kwargs)
+        return cls(branches=tuple(branches), fusion=fusion,
+                   fold_branches=fold_branches, **kwargs)
 
 
 _SCALAR_PLAN_FIELDS = (
     "pipelined_fwd", "pipelined_bwd", "pipe_block_k", "pipe_bwd_block_k",
     "pack_direct", "ring_attn", "chunked_prefill", "quant_tile",
-    "quant_pallas",
+    "quant_pallas", "fold_pallas", "fold_block_q", "fold_block_k",
 )
 
 
@@ -307,6 +326,17 @@ def apply_plan(plan: ExecutionPlan, snap) -> Any:
         updates["branch_plans"] = tuple(
             (int(sl), int(r), "" if strip else str(v), int(b))
             for sl, r, v, b in plan.branches
+        )
+    if plan.fold_branches:
+        # per-fold-branch blocks: an explicitly-set global fold block
+        # env twin beats the plan's per-branch value IN THAT FIELD (the
+        # same env > plan contract the branch variants honor)
+        strip_q = _env_present(FLAG_ENV["fold_block_q"])
+        strip_k = _env_present(FLAG_ENV["fold_block_k"])
+        updates["fold_branches"] = tuple(
+            (int(sl), int(r), 0 if strip_q else int(bq),
+             0 if strip_k else int(bk))
+            for sl, r, bq, bk in plan.fold_branches
         )
     return snap._replace(**updates) if updates else snap
 

@@ -6,7 +6,15 @@ CPU-checkable metrics, and bless the winner into the plan registry.
     python scripts/autotune.py                                  # tiny demo sweep (CPU)
     python scripts/autotune.py --n 10241 --json AUTOTUNE.json   # flagship sweep (chip)
     python scripts/autotune.py --n 10241 --bless                # ... and write the winner
+    python scripts/autotune.py --surface fold --bless           # streaming-fold tier sweep
     python scripts/autotune.py --selftest                       # seeded end-to-end check
+
+``--surface fold`` sweeps the OTHER hot path: the streaming-fold tier
+(``ops/pallas_streaming.py`` vs the jnp oracle, x fold block sizes) at
+one chunk geometry, blessing the winner under the ``stream_fold`` key
+the :class:`StreamingEncoderSession` resolves once per construction.
+The decision table additionally carries the ``mask_eqns`` column (the
+golden ledger's dense-mask-materialization pin: 0 for the Pallas tier).
 
 Inner loop = the ledger/ledger_diff machinery (the ``ab_dilated``
 discipline):
@@ -75,6 +83,95 @@ def _build_fn(segs, ratios, flags, interpret):
         )
 
     return fn
+
+
+def fold_candidate_plans(classes, blocks) -> List[Tuple[str, Any]]:
+    """The fold-surface (``--surface fold``) candidates: the jnp default
+    (the parity oracle and gate baseline), the Pallas fold tier at its
+    default blocks, and one per-branch-class block table per requested
+    block size."""
+    from gigapath_tpu.plan import ExecutionPlan
+
+    cands: List[Tuple[str, Any]] = [
+        ("default", ExecutionPlan()),
+        ("fold", ExecutionPlan(fold_pallas=True)),
+    ]
+    for block in blocks:
+        branches = tuple(
+            (int(sl), int(r), int(block), int(block))
+            for sl, r in classes
+        )
+        cands.append((
+            f"fold_b{block}",
+            ExecutionPlan(fold_pallas=True, fold_branches=branches),
+        ))
+    return cands
+
+
+def _build_fold_fn(classes, valid, flags):
+    """One streaming fold step over every branch class of the schedule —
+    the per-chunk workload the fold tier exists to speed up (each class
+    folds the same resident pair into the running accumulator)."""
+    import jax.numpy as jnp
+
+    from gigapath_tpu.ops.streaming_prefill import fold_pair
+
+    def fn(acc_o, acc_l, q, k, v):
+        o, l = acc_o, acc_l
+        for g, r in classes:
+            o, l = fold_pair(
+                o, l, q, k, v,
+                jnp.int32(0), jnp.int32(0), jnp.int32(valid),
+                segment_len=g, ratio=r, flags=flags,
+            )
+        return o, l
+
+    return fn
+
+
+def evaluate_fold(name, plan, classes, valid, acc_o, acc_l, q, k, v, *,
+                  on_chip, iters) -> Dict[str, Any]:
+    """One fold-surface candidate row — same discipline as
+    :func:`evaluate`: full compile profile always, walltime only on
+    chip."""
+    from gigapath_tpu.obs.ledger import capture_profile
+    from gigapath_tpu.ops.pallas_dilated import PipelineFlags
+    from gigapath_tpu.plan import apply_plan
+
+    flags = apply_plan(plan, PipelineFlags())
+    fn = _build_fold_fn(classes, valid, flags)
+    try:
+        profile = capture_profile(fn, acc_o, acc_l, q, k, v, full=True)
+    except Exception as e:  # an untraceable candidate is a refused row
+        return {"name": name, "plan": plan.as_dict(),
+                "error": f"{type(e).__name__}: {e}"}
+    row: Dict[str, Any] = {
+        "name": name,
+        "plan": plan.as_dict(),
+        "entry": {"name": name, **profile},
+    }
+    mem = profile.get("memory") or {}
+    jaxpr = profile.get("jaxpr") or {}
+    row["eqns_total"] = jaxpr.get("eqns_total")
+    row["mask_eqns"] = jaxpr.get("mask")
+    for field in ("peak_bytes", "temp_bytes"):
+        value = mem.get(field)
+        row[field.replace("bytes", "mb")] = (
+            round(value / 2**20, 3) if value is not None else None
+        )
+    if on_chip:
+        from gigapath_tpu.utils.timing import chained_seconds_per_iter
+
+        def step(x, acc_l_, q_, k_, v_):
+            o, _ = fn(x, acc_l_, q_, k_, v_)
+            return o
+
+        sec, _ = chained_seconds_per_iter(
+            step, acc_o, args=(acc_l, q, k, v),
+            iters_low=2, iters_high=2 + iters,
+        )
+        row["wall_s"] = sec
+    return row
 
 
 def candidate_plans(segs, ratios, L, E, H, blocks) -> List[Tuple[str, Any]]:
@@ -219,6 +316,12 @@ def sweep(args) -> Dict[str, Any]:
         print(f"autotune: cleared kernel env flags for the sweep: "
               f"{sorted(k for k, v in cleared.items() if v)}")
     try:
+        if getattr(args, "surface", "dilated") == "fold":
+            if args.name == "dilated_attention":
+                # the fold surface's dispatch site is the streaming
+                # session's once-per-construction resolve
+                args.name = "stream_fold"
+            return _fold_sweep_body(args, segs, ratios, blocks, B, H, Dh)
         return _sweep_body(args, segs, ratios, blocks, B, L, H, Dh, E)
     finally:
         for name, value in cleared.items():
@@ -379,6 +482,169 @@ def _sweep_body(args, segs, ratios, blocks, B, L, H, Dh, E) -> Dict[str, Any]:
     return payload
 
 
+def _fold_sweep_body(args, segs, ratios, blocks, B, H, Dh) -> Dict[str, Any]:
+    """``--surface fold``: sweep the streaming-fold tier at one chunk
+    geometry. Same gates/adoption/bless discipline as the dilated
+    sweep; the workload is one per-chunk fold step over every branch
+    class; the key is the streaming session's ``stream_fold`` resolve."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gigapath_tpu.ops.attention import NEG_INF
+    from gigapath_tpu.plan import bless_plan, geometry_key, plan_stats
+
+    backend = jax.default_backend()
+    on_chip = backend in ("tpu", "gpu")
+    dtype = jnp.bfloat16 if on_chip else jnp.float32
+    C, valid = int(args.chunk), int(args.valid)
+    # branch class per schedule entry, with the streaming state's
+    # g = min(sl, L) clamp applied at the sweep's valid horizon
+    classes = sorted({(min(int(sl), valid), int(r))
+                      for sl, r in zip(segs, ratios)})
+
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(B, C, H, Dh)), dtype) for _ in range(3)
+    )
+    acc_o = jnp.zeros((B, C, H, Dh), jnp.float32)
+    acc_l = jnp.full((B, H, C), NEG_INF, jnp.float32)
+    key = geometry_key(args.name, (q, k, v))
+    print(f"autotune[fold]: {key} chunk={C} valid={valid} "
+          f"classes={classes} backend={backend} "
+          f"(walltime gate {'ON' if on_chip else 'OFF — CPU rows are '}"
+          f"{'' if on_chip else 'memory/eqn-gated only'})")
+
+    cands = fold_candidate_plans(classes, blocks)
+    rows: Dict[str, Dict[str, Any]] = {}
+    for name, plan in cands:
+        rows[name] = evaluate_fold(
+            name, plan, classes, valid, acc_o, acc_l, q, k, v,
+            on_chip=on_chip, iters=args.iters,
+        )
+        r = rows[name]
+        print(f"  {name:12s} eqns={r.get('eqns_total')} "
+              f"mask={r.get('mask_eqns')} "
+              f"peak_mb={r.get('peak_mb')} temp_mb={r.get('temp_mb')} "
+              f"wall_s={r.get('wall_s')} "
+              f"{'ERROR ' + r['error'] if 'error' in r else ''}")
+
+    default_row = rows["default"]
+    passing: List[str] = []
+    for name, row in rows.items():
+        if name == "default":
+            row["gates_ok"] = "error" not in row  # the baseline itself
+            continue
+        if "error" in row:
+            row["gates_ok"] = False
+            continue
+        ok, decision = gate(default_row, row, rel_tol=args.gate_rel_tol,
+                            eqn_tol=args.eqn_tol)
+        row["gates_ok"] = ok
+        if not ok:
+            row["gate_regressions"] = decision.get("regressed", [])
+        else:
+            passing.append(name)
+
+    def cpu_key(name):
+        r = rows[name]
+        return (r.get("peak_mb") or float("inf"),
+                r.get("eqns_total") or float("inf"))
+
+    best = None
+    if passing:
+        if on_chip:
+            timed = [n for n in passing if rows[n].get("wall_s") is not None]
+            best = min(timed, key=lambda n: rows[n]["wall_s"]) if timed else None
+        else:
+            best = min(passing, key=cpu_key)
+
+    adopt = False
+    reason = "no gate-passing candidate"
+    if best is not None:
+        if on_chip:
+            d_wall = default_row.get("wall_s")
+            b_wall = rows[best].get("wall_s")
+            adopt = bool(d_wall and b_wall and b_wall <= d_wall * ADOPT_GATE)
+            reason = (f"fold-step walltime {b_wall:.4f}s vs default "
+                      f"{d_wall:.4f}s" if d_wall and b_wall
+                      else "no walltime")
+        else:
+            d_peak = default_row.get("peak_mb")
+            b_peak = rows[best].get("peak_mb")
+            adopt = bool(d_peak and b_peak and b_peak <= d_peak * ADOPT_GATE)
+            reason = (f"CPU memory-only row: peak {b_peak} MB vs default "
+                      f"{d_peak} MB (walltime needs a chip)"
+                      if d_peak and b_peak else "no memory analysis")
+
+    blessed = False
+    force = bool(args.force_bless)
+    if force:
+        if args.force_bless not in rows or "error" in rows[args.force_bless]:
+            print(f"autotune: cannot --force-bless unknown/errored "
+                  f"candidate '{args.force_bless}'", file=sys.stderr)
+            force = False
+        else:
+            best = args.force_bless
+    if (args.bless and adopt and best) or (force and best):
+        registry = args.registry or None
+        bless_plan(
+            key, rows[best]["plan"], path=registry,
+            provenance={
+                "label": args.label, "backend": backend,
+                "candidate": best, "reason": reason,
+                "source": "scripts/autotune.py --surface fold",
+            },
+        )
+        blessed = True
+        print(f"autotune: blessed '{best}' into "
+              f"{registry or 'the default registry'} under {key}")
+
+    # verification resolve: same probe as the dilated sweep — does the
+    # stream_fold key now resolve to a registry entry?
+    from gigapath_tpu.plan import reset_plan_state, resolve_plan
+
+    prior = os.environ.get("GIGAPATH_PLAN_REGISTRY")
+    try:
+        if args.registry:
+            os.environ["GIGAPATH_PLAN_REGISTRY"] = args.registry
+        reset_plan_state()
+        resolve_plan(args.name, (q, k, v))
+        stats = plan_stats()
+    finally:
+        if args.registry:
+            if prior is None:
+                os.environ.pop("GIGAPATH_PLAN_REGISTRY", None)
+            else:
+                os.environ["GIGAPATH_PLAN_REGISTRY"] = prior
+        reset_plan_state()
+    payload: Dict[str, Any] = {
+        "metric": "fold_autotune",
+        "key": key,
+        "backend": backend,
+        "label": args.label,
+        "chunk": C, "valid": valid, "heads": H, "head_dim": Dh,
+        "classes": [[int(g), int(r)] for g, r in classes],
+        "candidates": len(cands),
+        "gates_passed": len(passing),
+        "rows": {
+            name: {kk: vv for kk, vv in row.items() if kk != "entry"}
+            for name, row in rows.items()
+        },
+        "plan_hit_rate": stats["plan_hit_rate"],
+        "best_wall_s": rows[best].get("wall_s") if best else None,
+        "default_wall_s": default_row.get("wall_s"),
+        "decision": {
+            "best": best,
+            "adopt_plan": adopt,
+            "reason": reason,
+            "blessed": blessed,
+        },
+        "blessed": 1.0 if blessed else 0.0,
+    }
+    return payload
+
+
 # ---------------------------------------------------------------------------
 # selftest
 # ---------------------------------------------------------------------------
@@ -430,7 +696,7 @@ def selftest() -> int:
                 head_dim=8, blocks="256", iters=2, name="dilated_fused",
                 label="selftest", registry=registry, bless=False,
                 force_bless="stream", gate_rel_tol=0.5, eqn_tol=8,
-                json="",
+                json="", surface="dilated", chunk=64, valid=256,
             )
             payload = sweep(ns)
             if not payload["decision"]["blessed"]:
@@ -530,6 +796,63 @@ def selftest() -> int:
                 print("autotune selftest FAILED: corrupt registry did not "
                       "fall back to default dispatch", file=sys.stderr)
                 return 1
+
+            # -- fold surface (--surface fold): tiny CPU sweep — every
+            # candidate ranked in the decision table, the mask-eqn A/B
+            # visible, bless round-trips through the registry, and a
+            # SECOND resolve hits the blessed entry ---------------------
+            registry_fold = os.path.join(tmp, "PLAN_REGISTRY_FOLD.json")
+            os.environ["GIGAPATH_PLAN_REGISTRY"] = registry_fold
+            reset_plan_state()
+            ns_fold = argparse.Namespace(
+                segments="16,32", ratios="1,2", n=64, batch=1, heads=4,
+                head_dim=8, blocks="128", iters=2, name="stream_fold",
+                label="selftest", registry=registry_fold, bless=True,
+                # at C=64 the interpret-mode emulation buffers dominate
+                # peak bytes; the selftest checks the machinery, so the
+                # byte gate gets generous slack here (real sweeps run at
+                # real chunk shapes where the Pallas tier is leaner)
+                force_bless="fold_b128", gate_rel_tol=10.0, eqn_tol=64,
+                json="", surface="fold", chunk=64, valid=256,
+            )
+            fold_payload = sweep(ns_fold)
+            fold_rows = fold_payload["rows"]
+            if not ({"default", "fold", "fold_b128"} <= set(fold_rows)
+                    and fold_payload["gates_passed"] >= 1
+                    and all("eqns_total" in r for r in fold_rows.values())):
+                print("autotune selftest FAILED: fold sweep candidates "
+                      "not ranked/gated", file=sys.stderr)
+                return 1
+            if not fold_payload["decision"]["blessed"] \
+                    or "adopt_plan" not in fold_payload["decision"]:
+                print("autotune selftest FAILED: fold bless did not land",
+                      file=sys.stderr)
+                return 1
+            if not (fold_rows["default"].get("mask_eqns", 0) > 0
+                    and fold_rows["fold"].get("mask_eqns") == 0):
+                print("autotune selftest FAILED: fold mask-eqn A/B wrong "
+                      f"(default={fold_rows['default'].get('mask_eqns')}, "
+                      f"fold={fold_rows['fold'].get('mask_eqns')})",
+                      file=sys.stderr)
+                return 1
+            doc = load_registry(registry_fold)  # digest must verify
+            if fold_payload["key"] not in doc["entries"]:
+                print("autotune selftest FAILED: fold key missing from "
+                      "registry", file=sys.stderr)
+                return 1
+            from gigapath_tpu.plan import plan_stats
+
+            reset_plan_state()
+            qb = jnp.zeros((1, 64, 4, 8), jnp.float32)
+            hit = resolve_plan("stream_fold", (qb, qb, qb))
+            stats = plan_stats()
+            if not getattr(hit, "fold_pallas", False) \
+                    or not getattr(hit, "fold_branches", ()) \
+                    or stats["hits"] != 1:
+                print(f"autotune selftest FAILED: second resolve did not "
+                      f"hit the blessed fold entry (stats={stats}, "
+                      f"flags={hit})", file=sys.stderr)
+                return 1
     finally:
         os.environ.pop("GIGAPATH_PLAN_REGISTRY", None)
         for name, value in saved.items():
@@ -567,6 +890,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="default: the flagship head_dim (48) — sweeping "
                     "at the wrong E blesses a key production never "
                     "resolves")
+    ap.add_argument("--surface", choices=("dilated", "fold"),
+                    default="dilated",
+                    help="what to sweep: 'dilated' (default) = dense "
+                    "dilated-attention dispatch variants; 'fold' = the "
+                    "streaming-fold tier (jnp vs Pallas x fold block "
+                    "sizes) keyed under the session's 'stream_fold' "
+                    "resolve")
+    ap.add_argument("--chunk", type=int, default=2048,
+                    help="[fold] streaming chunk rows per block "
+                    "(default 2048 — the 16k smoke's chunk shape)")
+    ap.add_argument("--valid", type=int, default=16384,
+                    help="[fold] valid-token horizon for the ragged "
+                    "mask and the g=min(sl,L) clamp (default 16384)")
     ap.add_argument("--blocks", default="512,768,1024",
                     help="comma list of per-branch block candidates "
                     "(128-multiples in [128, 1024])")

@@ -138,6 +138,15 @@ def compare(base_doc: dict, cand_doc: dict, *,
                 # held to it)
                 pairs.append(("jaxpr.quant",
                               bj.get("quant", 0), cj.get("quant", 0)))
+            if "mask" in bj:
+                # the mask-materialization pin (obs/ledger.py): a
+                # square-bool mask eqn creeping into a path pinned at 0
+                # (the Pallas fold tier) means dense [C,C] masks are
+                # being materialized again — the exact regression the
+                # fold kernels exist to remove (legacy ledgers without
+                # the column are not held to it)
+                pairs.append(("jaxpr.mask",
+                              bj.get("mask", 0), cj.get("mask", 0)))
             bp = bj.get("primitives") or {}
             cp = cj.get("primitives") or {}
             for prim in sorted(set(bp) | set(cp)):
@@ -224,7 +233,7 @@ def selftest() -> int:
         "entries": {
             "slide_fwd|f32[1,256,16]": {
                 "name": "slide_fwd",
-                "jaxpr": {"eqns_total": 121,
+                "jaxpr": {"eqns_total": 121, "mask": 0,
                           "primitives": {"transpose": 0, "reshape": 31,
                                          "pallas_call": 22, "slice": 0}},
                 "cost": {"flops": 2.1e7, "bytes_accessed": 1.6e7},
@@ -248,14 +257,15 @@ def selftest() -> int:
     entry = bad["entries"]["slide_fwd|f32[1,256,16]"]
     entry["jaxpr"]["primitives"]["transpose"] = 10     # glue reappeared
     entry["jaxpr"]["eqns_total"] += 10
+    entry["jaxpr"]["mask"] = 4                         # dense masks back
     entry["cost"]["flops"] *= 1.5                      # >2% flop growth
     entry["memory"]["donated_bytes"] = 0.0             # donation lost
     del bad["entries"]["train_step|f32[1,256,16];tree{2}"]
     verdict = compare(base, bad)
     dec = verdict["decision"]
     expect_regressed = [
-        "jaxpr.primitives.transpose", "jaxpr.eqns_total", "cost.flops",
-        "memory.donated_bytes", "entry missing",
+        "jaxpr.primitives.transpose", "jaxpr.eqns_total", "jaxpr.mask",
+        "cost.flops", "memory.donated_bytes", "entry missing",
     ]
     missing = [m for m in expect_regressed
                if not any(m in line for line in dec["regressed"])]

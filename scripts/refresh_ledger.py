@@ -63,6 +63,15 @@ SLIDE_N, SLIDE_IN_CHANS = 256, 16
 RING_SHAPE = dict(B=1, L=32, H=4, Dh=8, ndev=4)
 RING_SEGMENTS = [8, 32]
 RING_RATIOS = [1, 2]
+# streaming-fold A/B geometry: one fold step (chunk pair) of the 16k
+# smoke — C=2048 token chunks, g=2048, r=2, valid horizon 16384. The
+# jnp control materializes dense [H, C, C] masks (jaxpr.mask > 0, fat
+# temp bytes); the Pallas tier computes them in-kernel (jaxpr.mask == 0,
+# leaner temps) — both sides pinned by tests/test_pallas_streaming.py.
+FOLD_SHAPE = dict(B=1, C=2048, H=4, Dh=16)
+FOLD_SEGMENT = 2048
+FOLD_RATIO = 2
+FOLD_VALID = 16384
 
 
 def build_golden_ledger():
@@ -148,6 +157,46 @@ def build_golden_ledger():
                 rq, rq, rq,
             )
 
+    # -- streaming fold step, jnp vs Pallas (full profile: the temp-bytes
+    # A/B is half the signal; the jaxpr.mask column is the other) --------
+    from gigapath_tpu.ops.attention import NEG_INF
+    from gigapath_tpu.ops.streaming_prefill import fold_pair
+
+    fB, fC, fH, fDh = (FOLD_SHAPE[k] for k in ("B", "C", "H", "Dh"))
+    fq = jnp.ones((fB, fC, fH, fDh), jnp.float32)
+    facc_o = jnp.zeros((fB, fC, fH, fDh), jnp.float32)
+    facc_l = jnp.full((fB, fH, fC), NEG_INF, jnp.float32)
+
+    def fold_fn(flags, grad):
+        def step(acc_o, acc_l, q, k, v):
+            return fold_pair(
+                acc_o, acc_l, q, k, v,
+                jnp.int32(0), jnp.int32(0), jnp.int32(FOLD_VALID),
+                segment_len=FOLD_SEGMENT, ratio=FOLD_RATIO, flags=flags,
+            )
+
+        if not grad:
+            return step
+
+        def loss(acc_o, acc_l, q, k, v):
+            out, _ = step(acc_o, acc_l, q, k, v)
+            return (out.astype(jnp.float32) ** 2).sum()
+
+        return jax.grad(loss, argnums=(2, 3, 4))
+
+    for variant, fold_flags in (
+        ("jnp", None),
+        ("pallas", PipelineFlags(fold_pallas=True)),
+    ):
+        ledger.capture_full(
+            f"stream_fold_{variant}", fold_fn(fold_flags, grad=False),
+            facc_o, facc_l, fq, fq, fq,
+        )
+        ledger.capture_fingerprint(
+            f"stream_fold_{variant}_grad", fold_fn(fold_flags, grad=True),
+            facc_o, facc_l, fq, fq, fq,
+        )
+
     # -- slide encoder (flagship topology at smoke scale): full profile
     # with XLA cost/memory analysis --------------------------------------
     model, params = slide_encoder.create_model(
@@ -174,6 +223,8 @@ def build_golden_ledger():
         "dilated_shape": DILATED_SHAPE,
         "ring": {**RING_SHAPE, "segments": RING_SEGMENTS,
                  "ratios": RING_RATIOS},
+        "fold": {**FOLD_SHAPE, "segment": FOLD_SEGMENT,
+                 "ratio": FOLD_RATIO, "valid": FOLD_VALID},
         "slide": {"n_tokens": SLIDE_N, "in_chans": SLIDE_IN_CHANS,
                   "arch": "gigapath_slide_enc_tiny"},
         "jax_version": jax.__version__,

@@ -119,6 +119,18 @@ timeout 2400 python scripts/autotune.py --n 10241 --iters 12 \
   --label r07 --bless --json AUTOTUNE.json > /tmp/r7_autotune.log 2>&1
 tail -6 /tmp/r7_autotune.log
 
+# 11b. fold-surface autotuner (streaming-fold Pallas tier): A/B the jnp
+#     fold against the Pallas pair_partial kernels x fold block sizes
+#     at the 16k smoke chunk geometry. Same gate discipline; the
+#     winner lands under the streaming session's 'stream_fold' resolve
+#     key (resolved ONCE per session construction). The decision table
+#     lands in AUTOTUNE_FOLD.json; the ingest folds the plan|sweep
+#     trend entry: fold-step walltime down-good, hit-rate up-good.
+timeout 2400 python scripts/autotune.py --surface fold --chunk 2048 \
+  --valid 16384 --segments 2048,16384 --ratios 1,2 --iters 12 \
+  --label r07 --bless --json AUTOTUNE_FOLD.json > /tmp/r7_fold.log 2>&1
+tail -6 /tmp/r7_fold.log
+
 # 12. model-health loop (drift sentinel + anytime confidence): baseline
 #     sketch off the streaming path, clean re-serve (zero embedding_drift
 #     anomalies), chaos-shifted serve (EXACTLY ONE, with flight dump) —
@@ -133,4 +145,5 @@ tail -3 /tmp/r7_drift.log
 python scripts/perf_history.py ingest --label r07 --serve SERVE_SMOKE.json \
   --dist DIST_SMOKE.json --fleet FLEET_SMOKE.json \
   --prefill PREFILL_SMOKE.json \
-  --tile AB_TILE.json --plan AUTOTUNE.json --drift DRIFT_SMOKE.json || true
+  --tile AB_TILE.json --plan AUTOTUNE.json --autotune AUTOTUNE_FOLD.json \
+  --drift DRIFT_SMOKE.json || true

@@ -71,6 +71,14 @@ _SLOW_NODEIDS = (
     "test_dilated_attention.py::test_gradients_flow",
     "test_dilated_attention.py::test_multibranch_matches_oracle",
     "test_dilated_attention.py::test_longnet_decoder_incremental_matches_full",
+    # round-8 rebalance (durations re-measured, same >= ~7 s bar):
+    # seq-parallel ragged routing has test_seq_parallel_fused_routing_fast;
+    # the 8-mesh ring-vs-gather A/B has the single-device ragged ring
+    # parity + the golden ring-signal ledger pin; the multiclass stream
+    # state chain has the epilogue grad-parity + jaxpr siblings
+    "test_dilated_attention.py::test_seq_parallel_ragged_mask_fused_routing",
+    "test_dilated_attention.py::test_ring_matches_gather_seq_parallel",
+    "test_dilated_attention.py::TestStreamFusionEpilogue::test_multiclass_state_chain",
     "test_finetune_harness.py::test_finetune_main_end_to_end",
     "test_moe.py::TestMoEEncoder::test_train_step_moe_aux_weight",
     "test_moe.py::TestMoEEncoder::test_moe_longnet_encoder_trains_one_step",

@@ -303,6 +303,32 @@ def test_golden_covers_the_ring_signal(fresh_flagship):
     assert gather_fwd["ppermute"] == 0
 
 
+def test_golden_covers_the_fold_signal(fresh_flagship):
+    """The golden pins the Pallas streaming-fold acceptance (ISSUE 20):
+    the Pallas fold traces ZERO dense mask equations (masks become
+    in-kernel iota comparisons inside the opaque pallas_call) while the
+    jnp control still materializes square bool masks — and the compiled
+    Pallas fold lowers with strictly fewer temp bytes than the jnp fold
+    at the 16k smoke geometry."""
+    entries = fresh_flagship["entries"]
+
+    def entry(prefix):
+        return next(v for k, v in entries.items() if k.startswith(prefix))
+
+    jnp_e = entry("stream_fold_jnp|")
+    pallas_e = entry("stream_fold_pallas|")
+    assert jnp_e["jaxpr"]["mask"] > 0
+    assert pallas_e["jaxpr"]["mask"] == 0
+    assert pallas_e["jaxpr"]["primitives"]["pallas_call"] >= 1
+    assert jnp_e["jaxpr"]["primitives"].get("pallas_call", 0) == 0
+    # grads keep the discipline: stored-lse bwd, still zero dense masks
+    assert entry("stream_fold_jnp_grad")["jaxpr"]["mask"] > 0
+    assert entry("stream_fold_pallas_grad")["jaxpr"]["mask"] == 0
+    # the compiled-memory half of the acceptance pin
+    assert pallas_e["memory"]["temp_bytes"] < jnp_e["memory"]["temp_bytes"]
+    assert pallas_e["memory"]["peak_bytes"] < jnp_e["memory"]["peak_bytes"]
+
+
 def test_ring_per_shard_bytes_scale_with_chunk_not_segment(tmp_path):
     """Acceptance: ledger_diff over gather->ring compiled profiles shows
     the oversized branch's temp bytes scaling with the LOCAL CHUNK, not
